@@ -1,0 +1,17 @@
+from .events import (
+    CONTROL_STREAM,
+    ControlEvent,
+    MetadataControlEvent,
+    OperationControlEvent,
+    control_event_from_json,
+    control_event_to_json,
+)
+
+__all__ = [
+    "CONTROL_STREAM",
+    "ControlEvent",
+    "MetadataControlEvent",
+    "OperationControlEvent",
+    "control_event_from_json",
+    "control_event_to_json",
+]

@@ -1,0 +1,114 @@
+"""Per-input-stream partition inference.
+
+The TPU re-expression of ``utils/SiddhiExecutionPlanner.java:75-241``: for each
+input stream of each query, decide whether events must be key-partitioned
+(GROUPBY with a key list — queries with windows + group-by need all events of a
+key on the same shard) or may be freely sharded (SHUFFLE). The result doubles
+as the sharding spec for the device mesh (key axis) and as the routing rule for
+the ingest partitioner (router/partitioners.py).
+
+Unlike the reference, joins are NOT rejected on the dynamic path (the reference
+throws "Join is not supported now!", SiddhiExecutionPlanner.java:99-100); a
+join stream partitions by the equi-join key when one exists, else broadcast.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from . import ast
+from .lexer import SiddhiQLError
+
+
+@dataclass(frozen=True)
+class StreamPartition:
+    """Partitioning requirement for one input stream."""
+
+    kind: str  # 'groupby' | 'shuffle' | 'broadcast'
+    keys: Tuple[str, ...] = ()
+
+    def compatible(self, other: "StreamPartition") -> bool:
+        if self.kind != other.kind:
+            return False
+        return set(self.keys) == set(other.keys)
+
+
+def _equi_join_keys(
+    on: Optional[ast.Expr], left: ast.StreamInput, right: ast.StreamInput
+) -> Tuple[Optional[str], Optional[str]]:
+    """Extract a single equality join key pair from the on-condition."""
+    if not isinstance(on, ast.Binary) or on.op != "==":
+        return None, None
+    l, r = on.left, on.right
+    if not (isinstance(l, ast.Attr) and isinstance(r, ast.Attr)):
+        return None, None
+    pair = {}
+    for a in (l, r):
+        if a.qualifier == left.ref_name:
+            pair["left"] = a.name
+        elif a.qualifier == right.ref_name:
+            pair["right"] = a.name
+    if len(pair) == 2:
+        return pair["left"], pair["right"]
+    return None, None
+
+
+def infer_stream_partitions(
+    queries: Tuple[ast.Query, ...]
+) -> Dict[str, StreamPartition]:
+    """Map streamId -> partitioning across all queries in a plan, rejecting
+    incompatible requirements on the same stream (parity with
+    SiddhiExecutionPlanner.retrievePartition, :174-192)."""
+    partitions: Dict[str, StreamPartition] = {}
+
+    def put(stream_id: str, part: StreamPartition) -> None:
+        existing = partitions.get(stream_id)
+        if existing is None or existing.kind == "shuffle":
+            partitions[stream_id] = part
+        elif part.kind != "shuffle" and not existing.compatible(part):
+            raise SiddhiQLError(
+                f"stream {stream_id!r} has incompatible partitioning "
+                f"requirements: {existing} vs {part}"
+            )
+
+    for q in queries:
+        inp = q.input
+        group_keys = q.selector.group_by
+        if isinstance(inp, ast.StreamInput):
+            if group_keys:
+                # group-by forces key partitioning (the reference requires
+                # windows+groupBy, findStreamPartition :194-210; here
+                # aggregation state is keyed even without a window, so
+                # group-by alone is sufficient)
+                put(inp.stream_id, StreamPartition("groupby", group_keys))
+            else:
+                put(inp.stream_id, StreamPartition("shuffle"))
+        elif isinstance(inp, ast.JoinInput):
+            lk, rk = _equi_join_keys(inp.on, inp.left, inp.right)
+            if lk and rk:
+                put(inp.left.stream_id, StreamPartition("groupby", (lk,)))
+                put(inp.right.stream_id, StreamPartition("groupby", (rk,)))
+            else:
+                put(inp.left.stream_id, StreamPartition("broadcast"))
+                put(inp.right.stream_id, StreamPartition("broadcast"))
+        elif isinstance(inp, ast.PatternInput):
+            # pattern state is a single NFA instance over the whole stream
+            # (unless the plan wraps it in `partition with`): all events of
+            # all involved streams must reach that instance -> broadcast to
+            # its shard; group-by on selector keys only affects aggregation
+            for sid in q.input_stream_ids():
+                put(sid, StreamPartition("broadcast"))
+        else:
+            raise TypeError(type(inp))
+    return partitions
+
+
+def query_output_fields(q: ast.Query) -> List[str]:
+    """Output attribute names of a query (for typed `returns`)."""
+    if q.selector.is_star:
+        raise SiddhiQLError(
+            "select * output fields depend on the input schema; resolved "
+            "at compile time"
+        )
+    return [item.output_name() for item in q.selector.items]

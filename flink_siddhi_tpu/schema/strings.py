@@ -1,0 +1,68 @@
+"""Dictionary encoding for STRING/OBJECT attributes.
+
+The device only ever sees int32 codes; the host keeps the code<->value mapping.
+Equality predicates on strings compile to integer comparisons against codes
+interned at query-compile time, so the hot path never touches Python strings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List
+
+import numpy as np
+
+MISSING_CODE = -1  # code for "constant never seen in this table"
+
+
+class StringTable:
+    """Append-only intern table: value -> stable int32 code."""
+
+    def __init__(self) -> None:
+        self._codes: Dict[Any, int] = {}
+        self._values: List[Any] = []
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def intern(self, value: Any) -> int:
+        try:
+            code = self._codes.get(value)
+        except TypeError:  # unhashable OBJECT payload: no dedup, append-only
+            code = len(self._values)
+            self._values.append(value)
+            return code
+        if code is None:
+            code = len(self._values)
+            self._codes[value] = code
+            self._values.append(value)
+        return code
+
+    def intern_many(self, values: Iterable[Any]) -> np.ndarray:
+        return np.fromiter(
+            (self.intern(v) for v in values), dtype=np.int32
+        )
+
+    def lookup(self, value: Any) -> int:
+        """Code for a constant; MISSING_CODE if never interned (a predicate
+        against it can still become true later — compile-time interning avoids
+        that by interning query constants up front)."""
+        return self._codes.get(value, MISSING_CODE)
+
+    def value(self, code: int) -> Any:
+        if 0 <= code < len(self._values):
+            return self._values[code]
+        return None
+
+    def decode(self, codes: np.ndarray) -> List[Any]:
+        return [self.value(int(c)) for c in codes]
+
+    # -- checkpoint support -------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"values": list(self._values)}
+
+    @classmethod
+    def from_state_dict(cls, state: dict) -> "StringTable":
+        t = cls()
+        for v in state["values"]:
+            t.intern(v)
+        return t

@@ -1,0 +1,15 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+The analog of the reference's in-process Flink MiniCluster
+(SiddhiCEPITCase.java:63 extends AbstractTestBase): real multi-device sharding
+and collectives, single process, no TPU required.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,108 @@
+"""Schema/type-bridge unit tests.
+
+Model: reference schema tests (schema/StreamSchemaTest.java:33-97,
+schema/StreamSerializerTest.java:29-81, utils/SiddhiTypeFactoryTest.java,
+schema/SiddhiExecutionPlanSchemaTest.java:47-48 DDL golden test).
+"""
+
+import dataclasses
+from collections import namedtuple
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.schema import (
+    AttributeType,
+    EventBatch,
+    StreamSchema,
+    StringTable,
+)
+
+
+@dataclasses.dataclass
+class Event:  # the reference's test POJO (source/Event.java)
+    id: int
+    name: str
+    price: float
+    timestamp: int
+
+
+SCHEMA_FIELDS = [
+    ("id", "int"),
+    ("name", "string"),
+    ("price", "double"),
+    ("timestamp", "long"),
+]
+
+
+def test_field_resolution_pojo():
+    s = StreamSchema(SCHEMA_FIELDS)
+    assert s.arity == 4
+    assert s.field_index("price") == 2
+    assert s.field_type("name") == AttributeType.STRING
+    row = s.get_row(Event(1, "a", 2.5, 100))
+    assert row == (1, "a", 2.5, 100)
+
+
+def test_field_resolution_tuple_dict_namedtuple_atomic():
+    s = StreamSchema(SCHEMA_FIELDS)
+    assert s.get_row((1, "a", 2.5, 100)) == (1, "a", 2.5, 100)
+    assert (
+        s.get_row({"id": 1, "name": "a", "price": 2.5, "timestamp": 100})
+        == (1, "a", 2.5, 100)
+    )
+    NT = namedtuple("NT", ["id", "name", "price", "timestamp"])
+    assert s.get_row(NT(1, "a", 2.5, 100)) == (1, "a", 2.5, 100)
+    atomic = StreamSchema([("words", "string")])
+    assert atomic.get_row("hello") == ("hello",)
+
+
+def test_unknown_field_raises():
+    s = StreamSchema(SCHEMA_FIELDS)
+    with pytest.raises(KeyError):
+        s.field_index("unknown")
+
+
+def test_duplicate_field_raises():
+    with pytest.raises(ValueError):
+        StreamSchema([("a", "int"), ("a", "int")])
+
+
+def test_ddl_golden():
+    s = StreamSchema(SCHEMA_FIELDS)
+    assert (
+        s.ddl("inputStream")
+        == "define stream inputStream (id int, name string, price double, "
+        "timestamp long);"
+    )
+
+
+def test_string_table_roundtrip():
+    t = StringTable()
+    codes = t.intern_many(["a", "b", "a", "c"])
+    assert codes.tolist() == [0, 1, 0, 2]
+    assert t.decode(np.array([2, 0])) == ["c", "a"]
+    assert t.lookup("missing") == -1
+
+
+def test_event_batch_encode_decode():
+    s = StreamSchema(SCHEMA_FIELDS)
+    events = [Event(i, f"n{i % 2}", 1.5 * i, 1000 + i) for i in range(5)]
+    b = EventBatch.from_records(
+        "inputStream", s, events, timestamps=[1000 + i for i in range(5)]
+    )
+    assert len(b) == 5
+    assert b.columns["id"].dtype == np.int32
+    assert b.columns["name"].dtype == np.int32  # dictionary codes
+    assert b.columns["price"].dtype == np.float32
+    rec = b.record(3)
+    assert rec == {"id": 3, "name": "n1", "price": 4.5, "timestamp": 1003}
+
+
+def test_event_batch_concat_sort():
+    s = StreamSchema([("x", "int")])
+    b1 = EventBatch.from_records("s", s, [(1,), (3,)], timestamps=[10, 30])
+    b2 = EventBatch.from_records("s", s, [(2,)], timestamps=[20])
+    merged = EventBatch.concat([b1, b2]).sort_by_time()
+    assert merged.timestamps.tolist() == [10, 20, 30]
+    assert merged.columns["x"].tolist() == [1, 2, 3]
