@@ -16,6 +16,8 @@ NFA transition tables, segment-reduce window plans — that a ``jax.jit``-ed
 axis and sharded across a key axis with ``shard_map`` over a ``jax.sharding.Mesh``.
 """
 
+from .api.cep import SiddhiCEP, CEPEnvironment
+from .api.stream import ExecutionStream, Row
 from .schema.types import AttributeType
 from .schema.stream_schema import StreamSchema
 from .schema.batch import EventBatch
@@ -29,6 +31,10 @@ from .control.events import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "SiddhiCEP",
+    "CEPEnvironment",
+    "ExecutionStream",
+    "Row",
     "AttributeType",
     "StreamSchema",
     "EventBatch",

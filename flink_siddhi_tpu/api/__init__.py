@@ -1,0 +1,4 @@
+from .cep import SiddhiCEP, CEPEnvironment
+from .stream import ExecutionStream, Row
+
+__all__ = ["SiddhiCEP", "CEPEnvironment", "ExecutionStream", "Row"]

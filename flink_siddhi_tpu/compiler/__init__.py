@@ -1,0 +1,10 @@
+from .plan import CompiledPlan, compile_plan
+from .expr import CompiledExpr, compile_expr, ExprResolver
+
+__all__ = [
+    "CompiledPlan",
+    "compile_plan",
+    "CompiledExpr",
+    "compile_expr",
+    "ExprResolver",
+]

@@ -1,0 +1,77 @@
+"""Output schemas: device buffers -> typed host records.
+
+The role of StreamOutputHandler + SiddhiTypeFactory in the reference
+(operator/StreamOutputHandler.java:62-92, utils/SiddhiTypeFactory.java:114-139)
+— except output types are inferred statically from the compiled expressions,
+not by spinning up a throwaway engine (SiddhiTypeFactory.java:64-112).
+
+Two device emission layouts exist:
+
+* ``aligned``: one potential emission per tape position, gated by a mask
+  (stateless select/filter queries, per-event window outputs);
+* ``buffered``: a fixed-capacity match buffer + count (pattern matches,
+  batch-window flushes).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..schema.strings import StringTable
+from ..schema.types import AttributeType
+
+
+@dataclass(frozen=True)
+class OutputField:
+    name: str
+    atype: AttributeType
+    table: Optional[StringTable] = None  # decode dictionary when encoded
+
+    def decode(self, v) -> Any:
+        if self.table is not None:
+            return self.table.value(int(v))
+        if self.atype == AttributeType.BOOL:
+            return bool(v)
+        if self.atype in (AttributeType.INT, AttributeType.LONG):
+            return int(v)
+        if self.atype in (AttributeType.FLOAT, AttributeType.DOUBLE):
+            return float(v)
+        return v
+
+
+@dataclass
+class OutputSchema:
+    stream_id: str
+    fields: Tuple[OutputField, ...]
+
+    @property
+    def field_names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    def decode_aligned(
+        self, mask: np.ndarray, ts: np.ndarray, cols: Sequence[np.ndarray]
+    ) -> List[Tuple[int, Tuple[Any, ...]]]:
+        """(ts_ms, row) per emitted position, in tape order."""
+        idx = np.nonzero(np.asarray(mask))[0]
+        out = []
+        for i in idx:
+            row = tuple(
+                f.decode(np.asarray(c)[i]) for f, c in zip(self.fields, cols)
+            )
+            out.append((int(np.asarray(ts)[i]), row))
+        return out
+
+    def decode_buffered(
+        self, count: int, ts: np.ndarray, cols: Sequence[np.ndarray]
+    ) -> List[Tuple[int, Tuple[Any, ...]]]:
+        n = int(count)
+        out = []
+        for i in range(n):
+            row = tuple(
+                f.decode(np.asarray(c)[i]) for f, c in zip(self.fields, cols)
+            )
+            out.append((int(np.asarray(ts)[i]), row))
+        return out

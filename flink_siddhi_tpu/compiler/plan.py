@@ -1,0 +1,185 @@
+"""Whole-plan compilation: SiddhiQL text -> one jitted device step.
+
+The analog of the reference's plan pipeline — enriched-plan assembly
+(SiddhiOperatorContext.getAllEnrichedExecutionPlan, :109-119), fail-fast
+validation (AbstractSiddhiOperator.java:291-299), and per-plan runtime
+creation (startSiddhiManager, :301-313) — except the product is not N
+embedded interpreters but ONE compiled function: every query in the plan is
+an artifact contributing to a single ``step(states, tape) ->
+(states, outputs)`` that XLA fuses and the runtime jits once per tape bucket.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from ..query import ast, parse_plan
+from ..query.lexer import SiddhiQLError
+from ..query.planner import StreamPartition, infer_stream_partitions
+from ..schema.stream_schema import StreamSchema
+from ..extensions.registry import ExtensionRegistry, builtin_registry
+from ..runtime.tape import TapeSpec
+from .expr import ExprResolver
+from .select import compile_select
+
+
+@dataclass
+class CompiledPlan:
+    plan_id: str
+    spec: TapeSpec
+    artifacts: List  # QueryArtifact protocol: init_state / step / output_*
+    schemas: Dict[str, StreamSchema]
+    partitions: Dict[str, StreamPartition]
+    source_ast: ast.ExecutionPlan
+
+    def init_state(self) -> Dict:
+        return {a.name: a.init_state() for a in self.artifacts}
+
+    def step(self, states: Dict, tape) -> Tuple[Dict, Dict]:
+        """Advance every query one micro-batch. Pure; jit-able."""
+        new_states = {}
+        outputs = {}
+        for a in self.artifacts:
+            s, out = a.step(states[a.name], tape)
+            new_states[a.name] = s
+            outputs[a.name] = out
+        return new_states, outputs
+
+    @property
+    def input_stream_ids(self) -> List[str]:
+        return list(self.spec.stream_codes)
+
+    def artifact(self, name: str):
+        for a in self.artifacts:
+            if a.name == name:
+                return a
+        raise KeyError(name)
+
+    def output_streams(self) -> Dict[str, List]:
+        by_stream: Dict[str, List] = {}
+        for a in self.artifacts:
+            by_stream.setdefault(a.output_schema.stream_id, []).append(a)
+        return by_stream
+
+
+def compile_plan(
+    plan_text: str,
+    schemas: Dict[str, StreamSchema],
+    extensions: Optional[ExtensionRegistry] = None,
+    plan_id: str = "plan",
+) -> CompiledPlan:
+    """Parse + validate + compile a full execution plan.
+
+    ``schemas``: externally registered streams (SiddhiCEP.registerStream
+    parity); ``define stream`` DDL inside the plan text adds to them.
+    """
+    if extensions is None:
+        extensions = builtin_registry()
+    parsed = parse_plan(plan_text)
+
+    all_schemas = dict(schemas)
+    for sd in parsed.stream_defs:
+        if sd.stream_id not in all_schemas:
+            all_schemas[sd.stream_id] = StreamSchema(list(sd.fields))
+
+    if not parsed.queries:
+        raise SiddhiQLError("execution plan contains no queries")
+
+    # fail fast on undefined inputs (UndefinedStreamException parity,
+    # SiddhiCEP.java:134-140)
+    input_ids: List[str] = []
+    for q in parsed.queries:
+        for sid in q.input_stream_ids():
+            if sid not in all_schemas:
+                raise SiddhiQLError(
+                    f"input stream {sid!r} is not defined or registered"
+                )
+            if sid not in input_ids:
+                input_ids.append(sid)
+
+    stream_codes = {sid: i for i, sid in enumerate(input_ids)}
+    # materialize every field of every input stream (simple and correct;
+    # column pruning to referenced fields is a later optimization)
+    columns = []
+    column_types = {}
+    for sid in input_ids:
+        sch = all_schemas[sid]
+        for fname, ftype in zip(sch.field_names, sch.field_types):
+            key = f"{sid}.{fname}"
+            columns.append(key)
+            column_types[key] = ftype
+    spec = TapeSpec(stream_codes, tuple(columns), column_types)
+
+    artifacts = []
+    used_names = set()
+    for qi, q in enumerate(parsed.queries):
+        qname = q.name or f"query_{qi}"
+        if qname in used_names:
+            raise SiddhiQLError(f"duplicate query name {qname!r}")
+        used_names.add(qname)
+        artifacts.append(
+            _compile_query(q, qname, all_schemas, stream_codes, extensions)
+        )
+
+    partitions = infer_stream_partitions(parsed.queries)
+    return CompiledPlan(
+        plan_id=plan_id,
+        spec=spec,
+        artifacts=artifacts,
+        schemas=all_schemas,
+        partitions=partitions,
+        source_ast=parsed,
+    )
+
+
+def _compile_query(
+    q: ast.Query,
+    name: str,
+    schemas: Dict[str, StreamSchema],
+    stream_codes: Dict[str, int],
+    extensions: ExtensionRegistry,
+):
+    inp = q.input
+    if isinstance(inp, ast.StreamInput):
+        has_agg = any(
+            ast.contains_aggregate(i.expr) for i in q.selector.items
+        )
+        if inp.windows or has_agg or q.selector.group_by:
+            from .window import compile_window_query
+
+            return compile_window_query(
+                q, name, schemas, stream_codes, extensions
+            )
+        ref = inp.ref_name
+        resolver = ExprResolver(
+            {ref: (inp.stream_id, schemas[inp.stream_id])},
+            default_scope=ref,
+        )
+        if ref != inp.stream_id:
+            resolver = ExprResolver(
+                {
+                    ref: (inp.stream_id, schemas[inp.stream_id]),
+                    inp.stream_id: (inp.stream_id, schemas[inp.stream_id]),
+                },
+                default_scope=ref,
+            )
+        return compile_select(
+            q, name, resolver, schemas, stream_codes[inp.stream_id],
+            extensions,
+        )
+    if isinstance(inp, ast.PatternInput):
+        from .nfa import compile_pattern_query
+
+        return compile_pattern_query(
+            q, name, schemas, stream_codes, extensions
+        )
+    if isinstance(inp, ast.JoinInput):
+        from .join import compile_join_query
+
+        return compile_join_query(
+            q, name, schemas, stream_codes, extensions
+        )
+    raise SiddhiQLError(f"unsupported input clause {type(inp).__name__}")

@@ -1,0 +1,3 @@
+from .registry import ExtensionRegistry, Extension, builtin_registry
+
+__all__ = ["ExtensionRegistry", "Extension", "builtin_registry"]

@@ -1,0 +1,102 @@
+"""User function extensions.
+
+Parity with ``SiddhiCEP.registerExtension`` (SiddhiCEP.java:201-206) and the
+``FunctionExecutor`` contract (test fixture
+extension/CustomPlusFunctionExtension.java:30-107: ``init`` validates argument
+types, ``execute`` computes, ``getReturnType`` drives output typing). Here an
+extension is a **JAX-traceable callable over column arrays** — it runs inside
+the jitted batch step, fused by XLA, instead of a per-event JVM virtual call.
+The return type is either fixed or derived from argument types (the reference
+fixture returns DOUBLE for any numeric mix; builtins below promote instead).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Callable, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..schema.types import AttributeType
+
+
+@dataclass
+class Extension:
+    """A device-traceable scalar/elementwise function."""
+
+    name: str  # 'namespace:fn' or bare 'fn'
+    fn: Callable[..., jnp.ndarray]
+    # fixed return type, or callable(arg_types) -> AttributeType
+    return_type: object = None
+
+    def resolve_return_type(
+        self, arg_types: Sequence[AttributeType]
+    ) -> AttributeType:
+        rt = self.return_type
+        if rt is None:
+            return _promote_numeric(arg_types)
+        if callable(rt):
+            return rt(arg_types)
+        return rt
+
+
+def _promote_numeric(arg_types: Sequence[AttributeType]) -> AttributeType:
+    order = [
+        AttributeType.INT,
+        AttributeType.LONG,
+        AttributeType.FLOAT,
+        AttributeType.DOUBLE,
+    ]
+    best = AttributeType.INT
+    for t in arg_types:
+        if t in order and order.index(t) > order.index(best):
+            best = t
+    return best
+
+
+class ExtensionRegistry:
+    def __init__(self, parent: Optional["ExtensionRegistry"] = None):
+        self._parent = parent
+        self._by_name: Dict[str, Extension] = {}
+
+    def register(
+        self,
+        name: str,
+        fn: Callable[..., jnp.ndarray],
+        return_type: object = None,
+    ) -> None:
+        self._by_name[name] = Extension(name, fn, return_type)
+
+    def lookup(self, name: str) -> Optional[Extension]:
+        ext = self._by_name.get(name)
+        if ext is None and self._parent is not None:
+            return self._parent.lookup(name)
+        return ext
+
+    def child(self) -> "ExtensionRegistry":
+        return ExtensionRegistry(parent=self)
+
+
+def builtin_registry() -> ExtensionRegistry:
+    """Built-in scalar functions (subset of siddhi-core's math/str builtins)."""
+    r = ExtensionRegistry()
+    D = AttributeType.DOUBLE
+    r.register("math:abs", jnp.abs)
+    r.register("math:sqrt", jnp.sqrt, D)
+    r.register("math:log", jnp.log, D)
+    r.register("math:exp", jnp.exp, D)
+    r.register("math:floor", jnp.floor, D)
+    r.register("math:ceil", jnp.ceil, D)
+    r.register("math:power", jnp.power)
+    r.register("math:round", jnp.round)
+    r.register("math:min", jnp.minimum)
+    r.register("math:max", jnp.maximum)
+    r.register("abs", jnp.abs)
+    r.register(
+        "ifThenElse",
+        lambda c, a, b: jnp.where(c, a, b),
+        lambda ts: _promote_numeric(ts[1:]) if len(ts) > 1 else D,
+    )
+    r.register("coalesce", lambda a, b: a)  # nulls are masked upstream
+    return r

@@ -1,0 +1,195 @@
+"""The host streaming runtime: micro-batcher + device step driver.
+
+Plays the role of the reference's operator lifecycle + hot loop
+(AbstractSiddhiOperator.open/processElement/processWatermark,
+AbstractSiddhiOperator.java:274-278,209-247) re-shaped for an accelerator:
+
+* events are pulled from sources in chunks, not pushed one at a time;
+* event-time ordering happens once per micro-batch in a host reorder buffer
+  gated by the min-watermark across sources (reference: per-element priority
+  queue offer/poll);
+* the compiled plan advances in ONE jitted device call per micro-batch;
+* outputs decode from fixed-capacity device buffers to typed host records.
+"""
+
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..compiler.plan import CompiledPlan
+from ..schema.batch import EventBatch
+from .sources import Source
+from .tape import Tape, bucket_size, build_tape
+
+MAX_WM = np.iinfo(np.int64).max
+
+
+@dataclass
+class _PlanRuntime:
+    plan: CompiledPlan
+    states: Dict
+    jitted: Callable
+    enabled: bool = True
+
+
+class Job:
+    """One running pipeline: sources -> compiled plan(s) -> collectors/sinks."""
+
+    def __init__(
+        self,
+        plans: Sequence[CompiledPlan],
+        sources: Sequence[Source],
+        batch_size: int = 4096,
+        time_mode: str = "event",  # 'event' | 'processing'
+    ) -> None:
+        if time_mode not in ("event", "processing"):
+            raise ValueError(time_mode)
+        self.batch_size = batch_size
+        self.time_mode = time_mode
+        self._sources = list(sources)
+        self._source_wm: List[int] = [-(2**62)] * len(self._sources)
+        self._source_done: List[bool] = [False] * len(self._sources)
+        # reorder buffer: stream_id -> pending EventBatches (event time)
+        self._pending: Dict[str, List[EventBatch]] = {}
+        self._epoch_ms: Optional[int] = None
+        self._plans: Dict[str, _PlanRuntime] = {}
+        for p in plans:
+            self.add_plan(p)
+        # output_stream -> list[(ts, row_tuple)] and field names
+        self.collected: Dict[str, List[Tuple[int, Tuple]]] = {}
+        self.output_fields: Dict[str, List[str]] = {}
+        self._sinks: Dict[str, List[Callable]] = {}
+        self.processed_events = 0  # observability (reference logs per runtime)
+
+    # -- plan management (dynamic control plane hooks) ----------------------
+    def add_plan(self, plan: CompiledPlan) -> None:
+        self._plans[plan.plan_id] = _PlanRuntime(
+            plan=plan,
+            states=plan.init_state(),
+            jitted=jax.jit(plan.step),
+        )
+
+    def remove_plan(self, plan_id: str) -> None:
+        self._plans.pop(plan_id, None)
+
+    def add_sink(self, output_stream: str, fn: Callable) -> None:
+        self._sinks.setdefault(output_stream, []).append(fn)
+
+    # -- run loop ------------------------------------------------------------
+    def run(self, max_cycles: Optional[int] = None) -> None:
+        cycles = 0
+        while not self.finished:
+            self.run_cycle()
+            cycles += 1
+            if max_cycles is not None and cycles >= max_cycles:
+                break
+
+    @property
+    def finished(self) -> bool:
+        return all(self._source_done) and not any(
+            batches for batches in self._pending.values()
+        )
+
+    def run_cycle(self) -> int:
+        """Pull, reorder, step, decode. Returns events processed."""
+        self._pull_sources()
+        ready = self._release_ready()
+        if not ready:
+            return 0
+        total = sum(len(b) for b in ready)
+        self.processed_events += total
+        if self._epoch_ms is None:
+            self._epoch_ms = min(int(b.timestamps.min()) for b in ready)
+        for rt in list(self._plans.values()):
+            self._step_plan(rt, ready)
+        return total
+
+    def _pull_sources(self) -> None:
+        for i, src in enumerate(self._sources):
+            if self._source_done[i]:
+                continue
+            batch, wm, done = src.poll(self.batch_size)
+            if batch is not None and len(batch):
+                self._pending.setdefault(src.stream_id, []).append(batch)
+            if wm is not None:
+                self._source_wm[i] = max(self._source_wm[i], wm)
+            if done:
+                self._source_done[i] = True
+                self._source_wm[i] = MAX_WM
+
+    def _release_ready(self) -> List[EventBatch]:
+        """Watermark gate: release per-stream prefixes with ts <= min
+        watermark (processing mode releases everything)."""
+        if self.time_mode == "processing":
+            ready = [
+                EventBatch.concat(bs).sort_by_time()
+                for bs in self._pending.values()
+                if bs
+            ]
+            self._pending.clear()
+            return ready
+        wm = min(self._source_wm) if self._source_wm else MAX_WM
+        ready: List[EventBatch] = []
+        for sid in list(self._pending):
+            merged = EventBatch.concat(self._pending[sid]).sort_by_time()
+            n_ready = int(np.searchsorted(merged.timestamps, wm, side="right"))
+            if n_ready:
+                ready.append(merged.slice(0, n_ready))
+            rest = merged.slice(n_ready, len(merged))
+            if len(rest):
+                self._pending[sid] = [rest]
+            else:
+                del self._pending[sid]
+        return ready
+
+    def _step_plan(
+        self, rt: _PlanRuntime, ready: List[EventBatch]
+    ) -> None:
+        plan = rt.plan
+        involved = [
+            b for b in ready if b.stream_id in plan.spec.stream_codes
+        ]
+        if not involved:
+            return
+        tape, _prov = build_tape(plan.spec, involved, self._epoch_ms)
+        rt.states, outputs = rt.jitted(rt.states, tape)
+        self._decode_outputs(plan, outputs)
+
+    def _decode_outputs(self, plan: CompiledPlan, outputs: Dict) -> None:
+        for a in plan.artifacts:
+            out = outputs[a.name]
+            schema = a.output_schema
+            if a.output_mode == "aligned":
+                mask, ts, cols = out
+                mask = np.asarray(mask)
+                if not mask.any():
+                    continue
+                rows = schema.decode_aligned(mask, np.asarray(ts), cols)
+            else:  # buffered
+                count, ts, cols = out
+                if int(count) == 0:
+                    continue
+                rows = schema.decode_buffered(
+                    int(count), np.asarray(ts), cols
+                )
+            sid = schema.stream_id
+            self.output_fields.setdefault(sid, schema.field_names)
+            bucket = self.collected.setdefault(sid, [])
+            epoch = self._epoch_ms or 0
+            for rel_ts, row in rows:
+                abs_ts = epoch + rel_ts
+                bucket.append((abs_ts, row))
+                for sink in self._sinks.get(sid, ()):
+                    sink(abs_ts, row)
+
+    # -- results -------------------------------------------------------------
+    def results(self, output_stream: str) -> List[Tuple]:
+        return [row for _, row in self.collected.get(output_stream, [])]
+
+    def results_with_ts(self, output_stream: str) -> List[Tuple[int, Tuple]]:
+        return list(self.collected.get(output_stream, []))

@@ -1,0 +1,134 @@
+"""The device tape: one timestamp-merged columnar micro-batch.
+
+The physical event representation the jitted step consumes. Where the
+reference funnels each event through ``Tuple2<StreamRoute, Object>`` and a
+per-event serializer (SiddhiStreamOperator.java:51-54, StreamSerializer.java:
+38-66), the tape packs a whole micro-batch: all involved streams merged in
+timestamp order, one device array per referenced (stream, field), plus stream
+codes, rebased int32 timestamps, and a validity mask. Padded to bucketed
+lengths so XLA compiles a handful of shapes, not one per batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..schema.batch import EventBatch
+from ..schema.types import AttributeType
+
+MIN_BUCKET = 128
+
+
+def bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass(frozen=True)
+class TapeSpec:
+    """What the step needs materialized."""
+
+    stream_codes: Dict[str, int]  # stream_id -> dense code
+    columns: Tuple[str, ...]  # "stream.field" keys
+    column_types: Dict[str, AttributeType]
+
+    def code_of(self, stream_id: str) -> int:
+        return self.stream_codes[stream_id]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Tape:
+    ts: object  # int32[E] ms since job epoch
+    stream: object  # int32[E]
+    valid: object  # bool[E]
+    cols: Dict[str, object]  # "stream.field" -> array[E]
+
+    @property
+    def capacity(self) -> int:
+        return self.ts.shape[-1]
+
+    def tree_flatten(self):
+        keys = tuple(sorted(self.cols))
+        children = (self.ts, self.stream, self.valid) + tuple(
+            self.cols[k] for k in keys
+        )
+        return children, keys
+
+    @classmethod
+    def tree_unflatten(cls, keys, children):
+        ts, stream, valid = children[:3]
+        cols = dict(zip(keys, children[3:]))
+        return cls(ts, stream, valid, cols)
+
+
+def build_tape(
+    spec: TapeSpec,
+    batches: Sequence[EventBatch],
+    epoch_ms: int,
+    capacity: int | None = None,
+) -> Tuple[Tape, np.ndarray]:
+    """Merge per-stream batches into one padded, ts-sorted host tape.
+
+    Returns (tape, order) where order[i] = (batch_idx, row_idx) provenance of
+    merged position i (sinks use it to reach host-only payloads).
+    Arrays are numpy; the jitted step's donate/commit moves them to device.
+    """
+    total = sum(len(b) for b in batches)
+    cap = capacity if capacity is not None else bucket_size(total)
+    if total > cap:
+        raise ValueError(f"{total} events exceed tape capacity {cap}")
+
+    ts_all = np.empty(total, dtype=np.int64)
+    stream_all = np.empty(total, dtype=np.int32)
+    prov = np.empty((total, 2), dtype=np.int64)
+    offset = 0
+    for bi, b in enumerate(batches):
+        n = len(b)
+        if b.stream_id not in spec.stream_codes:
+            raise KeyError(f"stream {b.stream_id!r} not in tape spec")
+        ts_all[offset : offset + n] = b.timestamps
+        stream_all[offset : offset + n] = spec.stream_codes[b.stream_id]
+        prov[offset : offset + n, 0] = bi
+        prov[offset : offset + n, 1] = np.arange(n)
+        offset += n
+
+    order = np.argsort(ts_all, kind="stable")
+    ts_sorted = ts_all[order]
+    stream_sorted = stream_all[order]
+    prov = prov[order]
+
+    ts = np.zeros(cap, dtype=np.int32)
+    ts[:total] = (ts_sorted - epoch_ms).astype(np.int32)
+    # padding gets the max timestamp so time-window logic never treats
+    # padding as "newest event"
+    if total and total < cap:
+        ts[total:] = ts[total - 1]
+    stream = np.full(cap, -1, dtype=np.int32)
+    stream[:total] = stream_sorted
+    valid = np.zeros(cap, dtype=np.bool_)
+    valid[:total] = True
+
+    cols: Dict[str, np.ndarray] = {}
+    for key in spec.columns:
+        stream_id, field = key.split(".", 1)
+        dtype = spec.column_types[key].device_dtype
+        col = np.zeros(cap, dtype=dtype)
+        # scatter this stream's values into merged order
+        merged_vals = np.zeros(total, dtype=dtype)
+        offset = 0
+        for bi, b in enumerate(batches):
+            n = len(b)
+            if b.stream_id == stream_id and n:
+                merged_vals[offset : offset + n] = b.columns[field]
+            offset += n
+        col[:total] = merged_vals[order]
+        cols[key] = col
+
+    return Tape(ts, stream, valid, cols), prov

@@ -29,6 +29,7 @@ class StreamSchema:
     def __init__(
         self,
         fields: Sequence[Tuple[str, Any]] | Mapping[str, Any],
+        shared_strings: Optional[StringTable] = None,
     ) -> None:
         if isinstance(fields, Mapping):
             items = list(fields.items())
@@ -48,9 +49,11 @@ class StreamSchema:
         self._index: Dict[str, int] = {
             n: i for i, n in enumerate(self.field_names)
         }
-        # one intern table per encoded field (string/object)
+        # one intern table per encoded field (string/object); a CEP
+        # environment passes one shared table so cross-stream string
+        # comparisons (joins, unions) are sound code comparisons
         self.string_tables: Dict[str, StringTable] = {
-            n: StringTable()
+            n: (shared_strings if shared_strings is not None else StringTable())
             for n, t in zip(self.field_names, self.field_types)
             if t.is_encoded
         }

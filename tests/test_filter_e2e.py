@@ -1,0 +1,195 @@
+"""End-to-end: stateless filter/projection queries on the device path.
+
+Mirrors the reference's simple integration cases
+(SiddhiCEPITCase.java:160-179 filter/select round-trips, :280-300 union,
+:394-410 custom extension) against the compiled micro-batch engine.
+"""
+
+import dataclasses
+
+import pytest
+
+from flink_siddhi_tpu import SiddhiCEP, CEPEnvironment
+
+
+@dataclasses.dataclass
+class Event:
+    id: int
+    name: str
+    price: float
+    timestamp: int
+
+
+def make_events(n, start_ts=1000):
+    # deterministic timestamps like RandomEventSource.java:55-64
+    return [
+        Event(i % 4, f"name_{i % 3}", float(i), start_ts + 1000 * i)
+        for i in range(n)
+    ]
+
+
+FIELDS = ["id", "name", "price", "timestamp"]
+
+
+def test_select_projection():
+    events = make_events(5)
+    out = (
+        SiddhiCEP.define("inputStream", events, FIELDS)
+        .cql(
+            "from inputStream select timestamp, id, name, price "
+            "insert into  outputStream"
+        )
+        .returns("outputStream")
+    )
+    assert len(out) == 5
+    assert out[0] == (1000, 0, "name_0", 0.0)
+    assert out[3] == (4000, 3, "name_0", 3.0)
+
+
+def test_filter_query():
+    events = make_events(20)
+    out = (
+        SiddhiCEP.define("inputStream", events, FIELDS)
+        .cql(
+            "from inputStream[id == 2] select name, id insert into out"
+        )
+        .returns("out")
+    )
+    assert len(out) == 5  # ids cycle 0..3 over 20 events
+    assert all(row[1] == 2 for row in out)
+    assert out[0][0] == "name_2"
+
+
+def test_compound_filter_arithmetic():
+    events = make_events(20)
+    out = (
+        SiddhiCEP.define("inputStream", events, FIELDS)
+        .cql(
+            "from inputStream[id == 2 and price > 5.0] "
+            "select price * 2.0 as doubled, name insert into out"
+        )
+        .returns("out")
+    )
+    expected = [
+        (e.price * 2.0, e.name)
+        for e in events
+        if e.id == 2 and e.price > 5.0
+    ]
+    assert out == expected
+
+
+def test_string_equality_filter():
+    events = make_events(9)
+    out = (
+        SiddhiCEP.define("inputStream", events, FIELDS)
+        .cql(
+            "from inputStream[name == 'name_1'] select id insert into out"
+        )
+        .returns("out")
+    )
+    assert len(out) == 3
+
+
+def test_select_star():
+    events = make_events(4)
+    out = (
+        SiddhiCEP.define("inputStream", events, FIELDS)
+        .cql("from inputStream insert into  outputStream")
+        .returns("outputStream")
+    )
+    assert len(out) == 4
+    assert out[0] == (0, "name_0", 0.0, 1000)  # schema field order
+
+
+def test_union_multiple_streams():
+    # SiddhiCEPITCase.java:280-300 — three streams into one output
+    env = CEPEnvironment()
+    s = (
+        SiddhiCEP.define(
+            "inputStream1", make_events(3), FIELDS, env=env
+        )
+        .union("inputStream2", make_events(4, start_ts=1500), FIELDS)
+        .union("inputStream3", make_events(5, start_ts=1700), FIELDS)
+    )
+    out = s.cql(
+        "from inputStream1 select timestamp, id, name, price insert into "
+        "outputStream;"
+        "from inputStream2 select timestamp, id, name, price insert into "
+        "outputStream;"
+        "from inputStream3 select timestamp, id, name, price insert into "
+        "outputStream;"
+    ).returns("outputStream")
+    assert len(out) == 12
+
+
+def test_return_as_map_and_row_and_pojo():
+    events = make_events(3)
+    es = SiddhiCEP.define("inputStream", events, FIELDS).cql(
+        "from inputStream select id, name insert into out"
+    )
+    maps = es.return_as_map("out")
+    assert maps[0] == {"id": 0, "name": "name_0"}
+    rows = es.return_as_row("out")
+    assert list(rows[1]) == [1, "name_1"]
+
+    @dataclasses.dataclass
+    class OutEvent:
+        id: int
+        name: str
+
+    pojos = es.returns_pojo("out", OutEvent)
+    assert pojos[2] == OutEvent(2, "name_2")
+
+
+def test_custom_extension():
+    # SiddhiCEPITCase.java:394-410 + CustomPlusFunctionExtension
+    env = CEPEnvironment()
+    env.register_extension("custom:plus", lambda a, b: a + b)
+    out = (
+        SiddhiCEP.define(
+            "inputStream", make_events(4), FIELDS, env=env
+        )
+        .cql(
+            "from inputStream select timestamp, id, name, "
+            "custom:plus(price,price) as doubled_price insert into  "
+            "outputStream"
+        )
+        .returns("outputStream")
+    )
+    assert [r[3] for r in out] == [0.0, 2.0, 4.0, 6.0]
+
+
+def test_undefined_stream_fails():
+    # SiddhiCEPITCase.java:441-463
+    from flink_siddhi_tpu.query.lexer import SiddhiQLError
+
+    with pytest.raises(SiddhiQLError):
+        SiddhiCEP.define("inputStream", make_events(2), FIELDS).cql(
+            "from unknownStream select id insert into out"
+        )
+
+
+def test_types_explicit_registration():
+    env = CEPEnvironment()
+    env.register_stream(
+        "s",
+        [(1, "a"), (2, "b")],
+        fields=["id", "tag"],
+        types=["int", "string"],
+        ts_field=None,
+    )
+    from flink_siddhi_tpu.api.stream import SingleStream
+
+    out = SingleStream(env, "s").cql(
+        "from s[id == 2] select tag insert into o"
+    ).returns("o")
+    assert out == [("b",)]
+
+
+def test_duplicate_stream_rejected():
+    from flink_siddhi_tpu.api.cep import DuplicatedStreamError
+
+    env = CEPEnvironment()
+    env.register_stream("s", [(1,)], fields=["x"], types=["int"])
+    with pytest.raises(DuplicatedStreamError):
+        env.register_stream("s", [(2,)], fields=["x"], types=["int"])
