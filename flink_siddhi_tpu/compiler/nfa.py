@@ -1,0 +1,717 @@
+"""Pattern / sequence queries compiled to dense, batch-parallel matchers.
+
+The reference gets its pattern engine (``every s1 = A[p] -> s2 = B[q]``,
+``A+ , B? within t``) from the embedded JVM ``siddhi-core`` state machines,
+fed one event at a time (AbstractSiddhiOperator.java:209-233 ->
+InputHandler.send). Here a pattern compiles to one of two TPU formulations,
+both consuming the whole micro-batch tape in a single jitted call:
+
+* **Chain matcher** (fast path) — for ``[every] e0 -> e1 -> ... -> eK`` where
+  every element is a plain (1,1) occurrence. Per-element predicates are
+  evaluated once for the whole batch on the VPU; "next match at/after
+  position p" becomes a reverse associative-scan (cummin) per element; every
+  partial match then advances through the *whole* chain with K gathers —
+  no per-event loop at all. Partial matches that outlive the batch carry in
+  a fixed pool of slots.
+
+* **Slot NFA** (general path) — for sequences (``,`` strict continuity) and
+  counting quantifiers (``+ ? * <m:n>``). A ``lax.scan`` walks the tape once;
+  the carry is a fixed array of partial-match slots advanced with vectorized
+  transition rules (greedy absorb-before-advance, optional-skip via
+  min-count prefix sums), plus a fixed-capacity match buffer.
+
+Match semantics implemented (pinned against the reference's integration
+tests, SiddhiCEPITCase.java:333-382):
+
+* ``every``: each occurrence of the first element starts an independent
+  partial match; one event may participate in many partials (A1 A2 B1
+  yields (A1,B1) *and* (A2,B1)).
+* without ``every``: the pattern matches exactly once (earliest start,
+  earliest completion), then disarms.
+* ``->`` (pattern): unrelated events between steps are ignored.
+* ``,`` (sequence): an event that neither extends the current element nor
+  starts the next one kills the partial (after emitting if all remaining
+  elements are optional).
+* quantifiers are greedy: extending the current element wins over advancing.
+* ``within t``: total first-to-last span bounded; expired partials are
+  reclaimed (their slots freed) as soon as the watermark proves they can
+  never complete.
+* Indexed capture refs ``s[0].x`` / ``s[last].x`` resolve to the first/last
+  event absorbed by a quantified element; a bare ``s.x`` means ``s[0].x``.
+
+Both engines respect the control plane's enable gate: a disabled query
+neither starts nor advances partials (reference: send gated on enabled,
+AbstractSiddhiOperator.java:127-132).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query import ast
+from ..query.lexer import SiddhiQLError
+from ..schema.types import AttributeType
+from .expr import ColumnEnv, ExprResolver, ResolvedAttr, compile_expr
+from .output import OutputField, OutputSchema
+
+DEFAULT_PARTIAL_POOL = 1024  # chain matcher: carried partial matches
+DEFAULT_SLOTS = 64  # slot NFA: concurrent partial matches
+_BIG = np.int32(2**30)
+
+
+# --------------------------------------------------------------------------
+# Capture resolution: select-clause refs -> captured-value env keys
+# --------------------------------------------------------------------------
+
+def _cap_key(alias: str, which: str, name: str) -> str:
+    return f"{alias}@{which}.{name}"
+
+
+class CaptureResolver:
+    """Resolves select/having attribute refs against pattern captures.
+
+    ``s1.x`` / ``s1[0].x`` -> first absorbed event's value;
+    ``s1[last].x`` -> last absorbed event's value. Bare names resolve
+    uniquely across elements (ambiguity is an error, as in Siddhi).
+    """
+
+    def __init__(self, elements, schemas):
+        # alias -> (element index, stream_id, schema)
+        self._by_alias: Dict[str, Tuple[int, str, object]] = {}
+        for i, el in enumerate(elements):
+            self._by_alias[el.alias] = (i, el.stream_id, schemas[el.stream_id])
+        self.referenced: List[Tuple[int, str, str]] = []  # (elem, col, which)
+
+    def _note(self, elem: int, col: str, which: str) -> None:
+        key = (elem, col, which)
+        if key not in self.referenced:
+            self.referenced.append(key)
+
+    def resolve(self, attr: ast.Attr) -> ResolvedAttr:
+        if attr.qualifier is None:
+            hits = [
+                (alias, info)
+                for alias, info in self._by_alias.items()
+                if attr.name in info[2]
+            ]
+            if not hits:
+                raise SiddhiQLError(f"unknown attribute {attr.name!r}")
+            if len(hits) > 1:
+                raise SiddhiQLError(
+                    f"ambiguous attribute {attr.name!r}; qualify it with a "
+                    "pattern alias"
+                )
+            alias, (idx, _sid, schema) = hits[0]
+            which = "first"
+        else:
+            if attr.qualifier not in self._by_alias:
+                raise SiddhiQLError(
+                    f"unknown pattern alias {attr.qualifier!r}"
+                )
+            alias = attr.qualifier
+            idx, _sid, schema = self._by_alias[alias]
+            if attr.index is None or attr.index == 0:
+                which = "first"
+            elif attr.index == "last":
+                which = "last"
+            else:
+                raise SiddhiQLError(
+                    f"indexed capture {alias}[{attr.index}] is not supported; "
+                    "use [0] or [last]"
+                )
+            if attr.name not in schema:
+                raise SiddhiQLError(
+                    f"stream of alias {alias!r} has no attribute {attr.name!r}"
+                )
+        atype = schema.field_type(attr.name)
+        table = schema.string_tables.get(attr.name)
+        self._note(idx, attr.name, which)
+        return ResolvedAttr(_cap_key(alias, which, attr.name), atype, table)
+
+
+# --------------------------------------------------------------------------
+# Shared compile-time pieces
+# --------------------------------------------------------------------------
+
+@dataclass
+class _PatternSpec:
+    elements: Tuple[ast.PatternElement, ...]
+    kind: str  # 'pattern' | 'sequence'
+    every: bool
+    within: Optional[int]
+    pred_fns: List[Callable[[ColumnEnv], jnp.ndarray]]
+    stream_code_of: List[int]
+    # captures: (elem idx, col name, 'first'|'last'); col key per element
+    captures: List[Tuple[int, str, str]]
+    cap_dtype: Dict[Tuple[int, str], np.dtype]
+    cap_src_key: Dict[Tuple[int, str], str]  # tape column key
+    proj_fns: List
+    out_fields: Tuple[OutputField, ...]
+    output_stream: str
+
+    @property
+    def n_elements(self) -> int:
+        return len(self.elements)
+
+
+def _build_spec(
+    q: ast.Query,
+    schemas,
+    stream_codes: Dict[str, int],
+    extensions,
+) -> _PatternSpec:
+    inp = q.input
+    assert isinstance(inp, ast.PatternInput)
+    aliases = [el.alias for el in inp.elements]
+    if len(set(aliases)) != len(aliases):
+        raise SiddhiQLError("pattern aliases must be unique")
+    for el in inp.elements:
+        if el.negated:
+            raise SiddhiQLError(
+                "absence ('not') pattern elements are not supported yet"
+            )
+        if el.stream_id not in stream_codes:
+            raise SiddhiQLError(f"stream {el.stream_id!r} is not defined")
+
+    # per-element predicate kernels (current-event only; cross-element
+    # capture references in element filters are a later milestone)
+    pred_fns = []
+    for el in inp.elements:
+        schema = schemas[el.stream_id]
+        scopes = {
+            el.alias: (el.stream_id, schema),
+            el.stream_id: (el.stream_id, schema),
+        }
+        resolver = ExprResolver(scopes, default_scope=el.alias)
+        if el.filter is not None:
+            ce = compile_expr(el.filter, resolver, extensions)
+            if ce.atype != AttributeType.BOOL:
+                raise SiddhiQLError("pattern element filter must be boolean")
+            pred_fns.append(ce.fn)
+        else:
+            pred_fns.append(None)
+
+    cap_resolver = CaptureResolver(inp.elements, schemas)
+    if q.selector.is_star:
+        raise SiddhiQLError(
+            "select * is not valid for pattern queries; name the captures"
+        )
+    proj_fns, out_fields = [], []
+    for item in q.selector.items:
+        if ast.contains_aggregate(item.expr):
+            raise SiddhiQLError(
+                "aggregations over pattern matches are not supported"
+            )
+        ce = compile_expr(item.expr, cap_resolver, extensions)
+        proj_fns.append(ce.fn)
+        out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
+    if q.selector.having is not None:
+        raise SiddhiQLError("having is not valid on pattern queries")
+
+    captures = list(cap_resolver.referenced)
+    cap_dtype, cap_src = {}, {}
+    for elem, col, _which in captures:
+        el = inp.elements[elem]
+        atype = schemas[el.stream_id].field_type(col)
+        cap_dtype[(elem, col)] = atype.device_dtype
+        cap_src[(elem, col)] = f"{el.stream_id}.{col}"
+
+    return _PatternSpec(
+        elements=inp.elements,
+        kind=inp.kind,
+        every=inp.every_,
+        within=inp.within,
+        pred_fns=pred_fns,
+        stream_code_of=[stream_codes[el.stream_id] for el in inp.elements],
+        captures=captures,
+        cap_dtype=cap_dtype,
+        cap_src_key=cap_src,
+        proj_fns=proj_fns,
+        out_fields=tuple(out_fields),
+        output_stream=q.output_stream,
+    )
+
+
+def _cap_pairs(spec: _PatternSpec) -> List[Tuple[int, str]]:
+    seen: List[Tuple[int, str]] = []
+    for elem, col, _w in spec.captures:
+        if (elem, col) not in seen:
+            seen.append((elem, col))
+    return seen
+
+
+def _skey(prefix: str, elem: int, col: str) -> str:
+    """Flat string key for state dicts (jit pytrees need uniform key types)."""
+    return f"{prefix}:{elem}:{col}"
+
+
+def _element_preds(spec: _PatternSpec, tape, enabled) -> List[jnp.ndarray]:
+    """bool[E] match mask per element, fused over the whole batch."""
+    env: ColumnEnv = dict(tape.cols)
+    preds = []
+    for k in range(spec.n_elements):
+        m = tape.valid & (tape.stream == spec.stream_code_of[k])
+        fn = spec.pred_fns[k]
+        if fn is not None:
+            m = m & fn(env)
+        preds.append(m & enabled)
+    return preds
+
+
+def _emit_env(spec: _PatternSpec, cap_arrays: Dict) -> ColumnEnv:
+    """Capture buffers -> env for the projection kernels."""
+    env: ColumnEnv = {}
+    for elem, col, which in spec.captures:
+        alias = spec.elements[elem].alias
+        env[_cap_key(alias, which, col)] = cap_arrays[(elem, col, which)]
+    return env
+
+
+# --------------------------------------------------------------------------
+# Engine 1: vectorized chain matcher (all-(1,1) `->` patterns)
+# --------------------------------------------------------------------------
+
+def _is_chain(spec: _PatternSpec) -> bool:
+    return spec.kind == "pattern" and all(
+        el.min_count == 1 and el.max_count == 1 for el in spec.elements
+    )
+
+
+@dataclass
+class ChainPatternArtifact:
+    """``[every] e0 -> e1 -> ... -> eK``, each element exactly once.
+
+    step() is loop-free over events: per-element "next match at/after p"
+    indexes come from one reverse cummin each, and every partial (carried +
+    newly started) advances through all remaining steps with K gathers.
+    """
+
+    name: str
+    spec: _PatternSpec
+    output_schema: OutputSchema
+    output_mode: str = "buffered"
+    pool: int = DEFAULT_PARTIAL_POOL
+
+    def init_state(self) -> Dict:
+        P = self.pool
+        K = self.spec.n_elements
+        state = {
+            "enabled": jnp.asarray(True),
+            "active": jnp.zeros(P, dtype=bool),
+            "step": jnp.ones(P, dtype=jnp.int32),  # next element to match
+            "start": jnp.zeros(P, dtype=jnp.int32),
+            "done": jnp.asarray(False),  # non-every: already matched
+            "overflow": jnp.asarray(0, dtype=jnp.int32),
+        }
+        for pair in _cap_pairs(self.spec):
+            state[_skey("cap", *pair)] = jnp.zeros(
+                P, dtype=self.spec.cap_dtype[pair]
+            )
+        return state
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        spec = self.spec
+        K = spec.n_elements
+        E = tape.capacity
+        P = self.pool
+        V = P + E  # virtual partial set: carried pool ++ fresh starts
+        pairs = _cap_pairs(spec)
+
+        preds = _element_preds(spec, tape, state["enabled"])
+        arange = jnp.arange(E, dtype=jnp.int32)
+
+        # next_idx[k][p] = min q >= p with preds[k][q], else E; padded so a
+        # gather at position E (or beyond-batch) safely reads "no match".
+        nxt = []
+        for k in range(1, K):
+            idx = jnp.where(preds[k], arange, E)
+            scanned = jax.lax.associative_scan(
+                jnp.minimum, idx, reverse=True
+            )
+            nxt.append(jnp.concatenate(
+                [scanned, jnp.asarray([E], dtype=jnp.int32)]
+            ))
+        ts_pad = jnp.concatenate(
+            [tape.ts, jnp.asarray([0], dtype=jnp.int32)]
+        )
+        env_pad = {
+            key: jnp.concatenate(
+                [tape.cols[key], jnp.zeros(1, dtype=tape.cols[key].dtype)]
+            )
+            for key in {spec.cap_src_key[p] for p in pairs}
+        }
+
+        # fresh starts: one candidate per tape position matching element 0
+        starts = preds[0] & ~(jnp.asarray(not spec.every) & state["done"])
+        v_active = jnp.concatenate([state["active"], starts])
+        v_step = jnp.concatenate(
+            [state["step"], jnp.ones(E, dtype=jnp.int32)]
+        )
+        # search position: carried partials resume at batch start
+        v_pos = jnp.concatenate(
+            [jnp.zeros(P, dtype=jnp.int32), arange + 1]
+        )
+        v_start = jnp.concatenate([state["start"], tape.ts])
+        v_emit_ts = jnp.zeros(V, dtype=jnp.int32)
+        caps = {}
+        for pair in pairs:
+            elem, col = pair
+            src = env_pad[spec.cap_src_key[pair]][:E]
+            fresh = (
+                src
+                if elem == 0
+                else jnp.zeros(E, dtype=spec.cap_dtype[pair])
+            )
+            caps[pair] = jnp.concatenate([state[_skey("cap", *pair)], fresh])
+
+        # advance every partial through all remaining elements (K-1 gathers)
+        for k in range(1, K):
+            at_k = v_active & (v_step == k)
+            j = nxt[k - 1][jnp.clip(v_pos, 0, E)]
+            found = at_k & (j < E)
+            ts_j = ts_pad[j]
+            if spec.within is not None:
+                ok = (ts_j - v_start) <= jnp.int32(spec.within)
+                dead = found & ~ok
+                found = found & ok
+                v_active = v_active & ~dead
+            for pair in pairs:
+                if pair[0] == k:
+                    v = env_pad[spec.cap_src_key[pair]][j]
+                    caps[pair] = jnp.where(found, v, caps[pair])
+            v_step = jnp.where(found, k + 1, v_step)
+            v_pos = jnp.where(found, j + 1, v_pos)
+            if k == K - 1:
+                v_emit_ts = jnp.where(found, ts_j, v_emit_ts)
+
+        complete = v_active & (v_step == K)
+        if not spec.every:
+            # exactly one match: earliest start, then earliest completion
+            # (two-stage int32 argmin; device has no int64)
+            start_key = jnp.where(complete, v_start, _BIG)
+            min_start = jnp.min(start_key)
+            emit_key = jnp.where(
+                complete & (v_start == min_start), v_emit_ts, _BIG
+            )
+            winner = jnp.argmin(emit_key)
+            one = jnp.zeros(V, dtype=bool).at[winner].set(True)
+            complete = complete & one & ~state["done"]
+            new_done = state["done"] | complete.any()
+        else:
+            new_done = state["done"]
+
+        # emit matches sorted by completion time
+        n_matches = complete.sum().astype(jnp.int32)
+        emit_key = jnp.where(complete, v_emit_ts, _BIG)
+        order = jnp.argsort(emit_key, stable=True)
+        emit_env = _emit_env(
+            spec,
+            {
+                (elem, col, which): caps[(elem, col)][order]
+                for elem, col, which in spec.captures
+            },
+        )
+        out_cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(emit_env)), (V,))
+            for p in spec.proj_fns
+        )
+        out_ts = v_emit_ts[order]
+
+        # survivors -> new pool (oldest starts first; overflow dropped)
+        survive = v_active & (v_step < K)
+        if spec.within is not None:
+            batch_max = jnp.max(jnp.where(tape.valid, tape.ts, -_BIG))
+            survive = survive & (
+                (batch_max - v_start) <= jnp.int32(spec.within)
+            )
+        pool_key = jnp.where(survive, v_start, _BIG)
+        pool_order = jnp.argsort(pool_key, stable=True)[:P]
+        kept = survive[pool_order]
+        n_survive = survive.sum().astype(jnp.int32)
+        new_state = {
+            "enabled": state["enabled"],
+            "active": kept,
+            "step": v_step[pool_order],
+            "start": v_start[pool_order],
+            "done": new_done,
+            "overflow": state["overflow"]
+            + jnp.maximum(n_survive - P, 0).astype(jnp.int32),
+        }
+        for pair in pairs:
+            new_state[_skey("cap", *pair)] = caps[pair][pool_order]
+        return new_state, (n_matches, out_ts, out_cols)
+
+
+# --------------------------------------------------------------------------
+# Engine 2: slot NFA (sequences, quantifiers)
+# --------------------------------------------------------------------------
+
+@dataclass
+class SlotNFAArtifact:
+    """General pattern/sequence matcher: lax.scan over the tape advancing a
+    fixed pool of partial-match slots with greedy quantifier semantics."""
+
+    name: str
+    spec: _PatternSpec
+    output_schema: OutputSchema
+    output_mode: str = "buffered"
+    slots: int = DEFAULT_SLOTS
+
+    def __post_init__(self):
+        spec = self.spec
+        K = spec.n_elements
+        last = spec.elements[-1]
+        if spec.kind == "pattern" and last.max_count < 0:
+            raise SiddhiQLError(
+                "a '->' pattern cannot end with an unbounded quantifier "
+                "(the match would never complete); bound it with <m:n>"
+            )
+        self._mins = np.array(
+            [el.min_count for el in spec.elements], dtype=np.int32
+        )
+        maxs = [
+            el.max_count if el.max_count >= 0 else 2**30
+            for el in spec.elements
+        ]
+        self._maxs = np.array(maxs, dtype=np.int32)
+        # prefix[i] = sum of min counts of elements [0, i); lets
+        # "all elements in (a, b] optional" be a subtraction
+        self._min_prefix = np.concatenate(
+            [[0], np.cumsum(self._mins)]
+        ).astype(np.int32)
+
+    def init_state(self) -> Dict:
+        S = self.slots
+        state = {
+            "enabled": jnp.asarray(True),
+            "active": jnp.zeros(S, dtype=bool),
+            "step": jnp.zeros(S, dtype=jnp.int32),
+            "count": jnp.zeros(S, dtype=jnp.int32),
+            "start": jnp.zeros(S, dtype=jnp.int32),
+            "last": jnp.zeros(S, dtype=jnp.int32),
+            "done": jnp.asarray(False),
+            "started": jnp.asarray(False),
+            "overflow": jnp.asarray(0, dtype=jnp.int32),
+        }
+        for pair in _cap_pairs(self.spec):
+            dt = self.spec.cap_dtype[pair]
+            state[_skey("first", *pair)] = jnp.zeros(S, dtype=dt)
+            state[_skey("last", *pair)] = jnp.zeros(S, dtype=dt)
+        return state
+
+    # -- transition helpers (all vectorized over slots) ---------------------
+    def _skipfree(self, a, b):
+        """True when every element with index in (a, b) has min_count 0."""
+        pre = jnp.asarray(self._min_prefix)
+        return (pre[b] - pre[jnp.clip(a + 1, 0, len(self._mins))]) == 0
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        spec = self.spec
+        K = spec.n_elements
+        S = self.slots
+        E = tape.capacity
+        M = E + S  # match buffer capacity
+        pairs = _cap_pairs(spec)
+        mins = jnp.asarray(self._mins)
+        maxs = jnp.asarray(self._maxs)
+
+        preds = _element_preds(spec, tape, state["enabled"])
+        pred_mat = jnp.stack(preds, axis=1)  # [E, K]
+        cap_srcs = {
+            pair: tape.cols[spec.cap_src_key[pair]] for pair in pairs
+        }
+
+        buf_init = {
+            "ts": jnp.zeros(M, dtype=jnp.int32),
+            "n": jnp.asarray(0, jnp.int32),
+        }
+        for elem, col, which in spec.captures:
+            buf_init[_skey(which, elem, col)] = jnp.zeros(
+                M, dtype=spec.cap_dtype[(elem, col)]
+            )
+
+        def body(carry, x):
+            st, buf = carry
+            ts_e, valid_e, m, caps_e = x  # m: bool[K]
+
+            active = st["active"]
+            step = st["step"]
+            count = st["count"]
+
+            if spec.within is not None:
+                alive = (ts_e - st["start"]) <= jnp.int32(spec.within)
+                active = active & (alive | ~valid_e)
+            m_at = m[jnp.clip(step, 0, K - 1)]  # pred of current element
+            absorb = active & valid_e & m_at & (count < maxs[step])
+
+            # advance target: smallest t > step whose predicate matches,
+            # with only optional elements skipped in between
+            can_leave = count >= mins[step]
+            adv_t = jnp.full(S, K, dtype=jnp.int32)
+            for t in range(K - 1, 0, -1):
+                reach = (
+                    active
+                    & valid_e
+                    & (step < t)
+                    & can_leave
+                    & self._skipfree(step, t)
+                    & m[t]
+                )
+                adv_t = jnp.where(reach, t, adv_t)
+            advance = ~absorb & (adv_t < K)  # greedy: absorb wins
+
+            # completion from current position: all later elements optional
+            completable = active & can_leave & self._skipfree(step, K)
+            at_last_full = (
+                active
+                & (step == K - 1)
+                & (count + absorb.astype(jnp.int32) >= maxs[K - 1])
+                & (count + absorb.astype(jnp.int32) >= mins[K - 1])
+            )
+            moved_to_last = advance & (adv_t == K - 1) & (maxs[K - 1] == 1)
+
+            if spec.kind == "sequence":
+                miss = active & valid_e & ~absorb & ~advance
+                emit_on_break = miss & completable
+                killed = miss
+            else:
+                emit_on_break = jnp.zeros(S, dtype=bool)
+                killed = jnp.zeros(S, dtype=bool)
+
+            emit = emit_on_break | at_last_full | moved_to_last
+
+            # apply absorb/advance
+            new_count = jnp.where(absorb, count + 1, count)
+            new_step = jnp.where(advance, adv_t, step)
+            new_count = jnp.where(advance, 1, new_count)
+            new_last = jnp.where(absorb | advance, ts_e, st["last"])
+
+            new_first = {}
+            new_lastc = {}
+            for pair in pairs:
+                elem = pair[0]
+                f = st[_skey("first", *pair)]
+                l = st[_skey("last", *pair)]
+                took = (absorb & (step == elem)) | (advance & (adv_t == elem))
+                first_take = (advance & (adv_t == elem)) | (
+                    absorb & (step == elem) & (count == 0)
+                )
+                new_first[pair] = jnp.where(first_take, caps_e[_skey("src", *pair)], f)
+                new_lastc[pair] = jnp.where(took, caps_e[_skey("src", *pair)], l)
+
+            # emissions: scatter completed slots into the match buffer
+            emit_ts = jnp.where(
+                emit_on_break, st["last"], ts_e
+            )  # break emits as-of previous event
+            n0 = buf["n"]
+            offs = jnp.cumsum(emit.astype(jnp.int32)) - 1
+            pos = jnp.where(emit, n0 + offs, M)  # M = dropped (overflow)
+            new_buf = dict(buf)
+            new_buf["ts"] = buf["ts"].at[pos].set(emit_ts, mode="drop")
+            for elem, col, which in spec.captures:
+                bkey = _skey(which, elem, col)
+                vals = (
+                    new_first[(elem, col)]
+                    if which == "first"
+                    else new_lastc[(elem, col)]
+                )
+                new_buf[bkey] = buf[bkey].at[pos].set(vals, mode="drop")
+            new_buf["n"] = jnp.minimum(
+                n0 + emit.sum().astype(jnp.int32), M
+            )
+
+            freed = emit | killed
+            active2 = active & ~freed
+
+            # arm a new slot on a first-element match
+            if spec.every:
+                any_done = st["done"]
+                want_start = m[0] & valid_e
+            else:
+                any_done = st["done"] | emit.any()
+                want_start = m[0] & valid_e & ~st["started"] & ~st["done"]
+            free_slot = jnp.argmin(active2.astype(jnp.int32))
+            has_free = ~active2[free_slot]
+            do_start = want_start & has_free
+            one_hot = (
+                jnp.zeros(S, dtype=bool).at[free_slot].set(True) & do_start
+            )
+            active3 = active2 | one_hot
+            new_step = jnp.where(one_hot, 0, new_step)
+            new_count = jnp.where(one_hot, 1, new_count)
+            new_start = jnp.where(one_hot, ts_e, st["start"])
+            new_last = jnp.where(one_hot, ts_e, new_last)
+            for pair in pairs:
+                if pair[0] == 0:
+                    new_first[pair] = jnp.where(
+                        one_hot, caps_e[_skey("src", *pair)], new_first[pair]
+                    )
+                    new_lastc[pair] = jnp.where(
+                        one_hot, caps_e[_skey("src", *pair)], new_lastc[pair]
+                    )
+            # a start-element event that fully satisfies a 1-element pattern
+            # (K==1, max 1) completes immediately on the next event's break /
+            # absorb logic; K==1 plain patterns use the chain engine anyway.
+
+            new_st = dict(st)
+            new_st.update(
+                active=active3,
+                step=new_step,
+                count=new_count,
+                start=new_start,
+                last=new_last,
+                done=any_done,
+                started=st["started"] | want_start,
+                overflow=st["overflow"]
+                + (want_start & ~has_free).astype(jnp.int32),
+            )
+            for pair in pairs:
+                new_st[_skey("first", *pair)] = new_first[pair]
+                new_st[_skey("last", *pair)] = new_lastc[pair]
+            return (new_st, new_buf), None
+
+        xs = (
+            tape.ts,
+            tape.valid,
+            pred_mat,
+            {_skey("src", *pair): cap_srcs[pair] for pair in pairs},
+        )
+        (new_state, buf), _ = jax.lax.scan(body, (state, buf_init), xs)
+
+        emit_env = _emit_env(
+            spec,
+            {
+                (elem, col, which): buf[_skey(which, elem, col)]
+                for elem, col, which in spec.captures
+            },
+        )
+        out_cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(emit_env)), (M,))
+            for p in spec.proj_fns
+        )
+        return new_state, (buf["n"], buf["ts"], out_cols)
+
+
+# --------------------------------------------------------------------------
+# Entry point
+# --------------------------------------------------------------------------
+
+def compile_pattern_query(
+    q: ast.Query,
+    name: str,
+    schemas,
+    stream_codes: Dict[str, int],
+    extensions,
+):
+    spec = _build_spec(q, schemas, stream_codes, extensions)
+    out_schema = OutputSchema(spec.output_stream, spec.out_fields)
+    if _is_chain(spec):
+        return ChainPatternArtifact(
+            name=name, spec=spec, output_schema=out_schema
+        )
+    return SlotNFAArtifact(name=name, spec=spec, output_schema=out_schema)

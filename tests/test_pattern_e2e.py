@@ -1,0 +1,235 @@
+"""Pattern / sequence matching end-to-end.
+
+Pins the match semantics of the reference's pattern and sequence integration
+tests (SiddhiCEPITCase.java:333-357 simple pattern, :363-382 sequence with
+quantifiers + within) against both compiled engines: the vectorized chain
+matcher and the slot NFA.
+"""
+
+import dataclasses
+
+import pytest
+
+from flink_siddhi_tpu import CEPEnvironment, SiddhiCEP
+
+
+@dataclasses.dataclass
+class Event:
+    id: int
+    name: str
+    price: float
+    timestamp: int
+
+
+FIELDS = ["id", "name", "price", "timestamp"]
+
+
+def ev(id, ts, name="test_event", price=0.0):
+    return Event(id, name, price, ts)
+
+
+def run_pattern(cql, stream1, stream2=None, batch_size=4096):
+    env = CEPEnvironment(batch_size=batch_size)
+    s = SiddhiCEP.define(
+        "inputStream1", stream1, FIELDS, env=env
+    )
+    if stream2 is not None:
+        s = s.union("inputStream2", stream2, FIELDS)
+    return s.cql(cql).return_as_map("outputStream")
+
+
+TWO_STEP = (
+    "from every s1 = inputStream1[id == 2] -> s2 = inputStream2[id == 3] "
+    "select s1.id as id_1, s1.name as name_1, s2.id as id_2, s2.name as "
+    "name_2 insert into outputStream"
+)
+
+
+def test_simple_pattern_match():
+    # SiddhiCEPITCase.java:333-357: ids 0..49 on both streams -> one match
+    s1 = [ev(i % 50, 1000 + 1000 * i) for i in range(50)]
+    s2 = [ev(i % 50, 1000 + 1000 * i) for i in range(50)]
+    out = run_pattern(TWO_STEP, s1, s2)
+    assert out == [
+        {"id_1": 2, "name_1": "test_event", "id_2": 3, "name_2": "test_event"}
+    ]
+
+
+def test_every_multiplicity():
+    # A@2 A@2 B@3: every start pairs with the next completion -> 2 matches
+    s1 = [ev(2, 1000), ev(2, 2000)]
+    s2 = [ev(3, 3000)]
+    out = run_pattern(TWO_STEP, s1, s2)
+    assert len(out) == 2
+    assert {m["id_1"] for m in out} == {2}
+
+
+def test_every_exact_pairs():
+    # A B A B -> two matches, each A pairing its following B
+    env = CEPEnvironment()
+    s1 = [ev(2, 1000), ev(2, 3000)]
+    s2 = [ev(3, 2000), ev(3, 4000)]
+    s = SiddhiCEP.define(
+        "inputStream1", s1, FIELDS, env=env
+    ).union("inputStream2", s2, FIELDS)
+    out = s.cql(
+        "from every s1 = inputStream1[id == 2] -> s2 = inputStream2[id == 3] "
+        "select s1.timestamp as t1, s2.timestamp as t2 "
+        "insert into outputStream"
+    ).returns("outputStream")
+    assert out == [(1000, 2000), (3000, 4000)]
+
+
+def test_no_every_matches_once():
+    s1 = [ev(2, 1000), ev(2, 3000)]
+    s2 = [ev(3, 2000), ev(3, 4000)]
+    out = run_pattern(
+        "from s1 = inputStream1[id == 2] -> s2 = inputStream2[id == 3] "
+        "select s1.timestamp as t1, s2.timestamp as t2 "
+        "insert into outputStream",
+        s1,
+        s2,
+    )
+    assert out == [{"t1": 1000, "t2": 2000}]
+
+
+def test_three_step_pattern():
+    # the north-star shape: every s1 -> s2 -> s3
+    s1 = [ev(1, 1000), ev(1, 5000), ev(1, 9000)]
+    s2 = [ev(2, 2000), ev(2, 6000), ev(2, 10000)]
+    # reuse inputStream1 for step 3 via a third id
+    env = CEPEnvironment()
+    s3 = [ev(3, 3000), ev(3, 7000), ev(3, 11000)]
+    s = (
+        SiddhiCEP.define(
+            "inputStream1", s1, FIELDS, env=env
+        )
+        .union("inputStream2", s2, FIELDS)
+        .union("inputStream3", s3, FIELDS)
+    )
+    out = s.cql(
+        "from every s1 = inputStream1[id == 1] -> s2 = inputStream2[id == 2]"
+        " -> s3 = inputStream3[id == 3] "
+        "select s1.timestamp as t1, s2.timestamp as t2, s3.timestamp as t3 "
+        "insert into outputStream"
+    ).returns("outputStream")
+    assert out == [
+        (1000, 2000, 3000),
+        (5000, 6000, 7000),
+        (9000, 10000, 11000),
+    ]
+
+
+def test_pattern_within_expires():
+    s1 = [ev(2, 1000)]
+    s2 = [ev(3, 500000)]  # arrives too late for `within 100 sec`
+    out = run_pattern(
+        "from every s1 = inputStream1[id == 2] -> s2 = inputStream2[id == 3]"
+        " within 100 sec "
+        "select s1.id as a, s2.id as b insert into outputStream",
+        s1,
+        s2,
+    )
+    assert out == []
+
+
+def test_pattern_within_allows():
+    s1 = [ev(2, 1000)]
+    s2 = [ev(3, 50000)]
+    out = run_pattern(
+        "from every s1 = inputStream1[id == 2] -> s2 = inputStream2[id == 3]"
+        " within 100 sec "
+        "select s1.id as a, s2.id as b insert into outputStream",
+        s1,
+        s2,
+    )
+    assert len(out) == 1
+
+
+def test_pattern_cross_batch_carry():
+    # force the partial to straddle micro-batches (batch_size=2 -> the
+    # start and completion land in different device steps)
+    s1 = [ev(2, 1000), ev(0, 2000), ev(0, 3000), ev(0, 4000)]
+    s2 = [ev(0, 1500), ev(0, 2500), ev(0, 3500), ev(3, 5000)]
+    out = run_pattern(TWO_STEP, s1, s2, batch_size=2)
+    assert len(out) == 1
+    assert out[0]["id_1"] == 2 and out[0]["id_2"] == 3
+
+
+def test_pattern_interleaved_ignores_unrelated():
+    # '->' skips unrelated events between steps
+    s1 = [ev(2, 1000), ev(7, 1500), ev(9, 1800)]
+    s2 = [ev(1, 2000), ev(3, 3000)]
+    out = run_pattern(TWO_STEP, s1, s2)
+    assert len(out) == 1
+
+
+def test_sequence_reference_shape():
+    # SiddhiCEPITCase.java:363-382: every s1 = A[id==2]+ , s2 = B[id==3]?
+    # within 1000 sec over ids 0..4 duplicated on both streams -> 1 match
+    evs = [ev(i, 1000 + 1000 * i) for i in range(5)]
+    out = run_pattern(
+        "from every s1 = inputStream1[id == 2]+ , "
+        "s2 = inputStream2[id == 3]? within 1000 second "
+        "select s1[0].name as n1, s2.name as n2 insert into outputStream",
+        evs,
+        list(evs),
+    )
+    assert len(out) == 1
+    assert out[0]["n1"] == "test_event"
+
+
+def test_sequence_strict_continuity_breaks():
+    # sequence s1 = A[id==2], s2 = A[id==3]: an intervening non-matching
+    # event kills the partial
+    evs = [ev(2, 1000), ev(7, 2000), ev(3, 3000)]
+    out = run_pattern(
+        "from every s1 = inputStream1[id == 2] , s2 = inputStream1[id == 3] "
+        "select s1.timestamp as t1, s2.timestamp as t2 "
+        "insert into outputStream",
+        evs,
+    )
+    assert out == []
+
+
+def test_sequence_adjacent_matches():
+    evs = [ev(2, 1000), ev(3, 2000), ev(2, 3000), ev(3, 4000)]
+    out = run_pattern(
+        "from every s1 = inputStream1[id == 2] , s2 = inputStream1[id == 3] "
+        "select s1.timestamp as t1, s2.timestamp as t2 "
+        "insert into outputStream",
+        evs,
+    )
+    assert [(m["t1"], m["t2"]) for m in out] == [(1000, 2000), (3000, 4000)]
+
+
+def test_sequence_plus_quantifier_first_and_last():
+    # s1 = A[id==2]+ , s2 = A[id==3]: greedy absorb of consecutive id==2
+    evs = [
+        ev(2, 1000, price=1.0),
+        ev(2, 2000, price=2.0),
+        ev(2, 3000, price=3.0),
+        ev(3, 4000, price=9.0),
+    ]
+    out = run_pattern(
+        "from s1 = inputStream1[id == 2]+ , s2 = inputStream1[id == 3] "
+        "select s1[0].price as first_p, s1[last].price as last_p, "
+        "s2.price as close_p insert into outputStream",
+        evs,
+    )
+    assert len(out) == 1
+    assert out[0] == {"first_p": 1.0, "last_p": 3.0, "close_p": 9.0}
+
+
+def test_pattern_with_quantified_middle():
+    # pattern kind with a bounded quantifier runs on the slot NFA
+    evs = [ev(2, 1000), ev(5, 1500), ev(2, 2000), ev(3, 3000)]
+    out = run_pattern(
+        "from every s1 = inputStream1[id == 2]<2:2> -> "
+        "s2 = inputStream1[id == 3] "
+        "select s1[0].timestamp as t1, s1[last].timestamp as t2, "
+        "s2.timestamp as t3 insert into outputStream",
+        evs,
+    )
+    assert len(out) == 1
+    assert out[0] == {"t1": 1000, "t2": 2000, "t3": 3000}
