@@ -357,7 +357,12 @@ class ChainPatternArtifact:
             [jnp.zeros(P, dtype=jnp.int32), arange + 1]
         )
         v_start = jnp.concatenate([state["start"], tape.ts])
-        v_emit_ts = jnp.zeros(V, dtype=jnp.int32)
+        # fresh starts already completed element 0 at their own position, so
+        # a single-element pattern (K == 1) emits at the start event's ts;
+        # K > 1 overwrites this on the final advance
+        v_emit_ts = jnp.concatenate(
+            [jnp.zeros(P, dtype=jnp.int32), tape.ts]
+        )
         caps = {}
         for pair in pairs:
             elem, col = pair
@@ -628,13 +633,17 @@ class SlotNFAArtifact:
             freed = emit | killed
             active2 = active & ~freed
 
-            # arm a new slot on a first-element match
+            # arm a new slot on a first-element match; for non-every,
+            # "started" only holds while the armed partial is still alive
+            # (or the single match is done) — a killed/expired partial
+            # re-arms matching on the next start event
+            started_now = st["started"] & (active2.any() | st["done"])
             if spec.every:
                 any_done = st["done"]
                 want_start = m[0] & valid_e
             else:
                 any_done = st["done"] | emit.any()
-                want_start = m[0] & valid_e & ~st["started"] & ~st["done"]
+                want_start = m[0] & valid_e & ~started_now & ~any_done
             free_slot = jnp.argmin(active2.astype(jnp.int32))
             has_free = ~active2[free_slot]
             do_start = want_start & has_free
@@ -666,7 +675,7 @@ class SlotNFAArtifact:
                 start=new_start,
                 last=new_last,
                 done=any_done,
-                started=st["started"] | want_start,
+                started=started_now | want_start,
                 overflow=st["overflow"]
                 + (want_start & ~has_free).astype(jnp.int32),
             )

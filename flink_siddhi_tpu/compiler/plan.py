@@ -48,6 +48,28 @@ class CompiledPlan:
             outputs[a.name] = out
         return new_states, outputs
 
+    def grow_state(self, states: Dict) -> Dict:
+        """Re-bucket group-state tables after host interning discovered new
+        groups (triggers a one-off retrace, amortized across the run)."""
+        out = dict(states)
+        for a in self.artifacts:
+            grow = getattr(a, "grow_state", None)
+            if grow is not None:
+                out[a.name] = grow(states[a.name])
+        return out
+
+    def flush(self, states: Dict) -> Tuple[Dict, Dict]:
+        """End-of-stream flush (timeBatch final windows etc.)."""
+        new_states = dict(states)
+        outputs = {}
+        for a in self.artifacts:
+            fl = getattr(a, "flush", None)
+            if fl is not None:
+                s, out = fl(states[a.name])
+                new_states[a.name] = s
+                outputs[a.name] = out
+        return new_states, outputs
+
     @property
     def input_stream_ids(self) -> List[str]:
         return list(self.spec.stream_codes)
@@ -111,18 +133,22 @@ def compile_plan(
             key = f"{sid}.{fname}"
             columns.append(key)
             column_types[key] = ftype
-    spec = TapeSpec(stream_codes, tuple(columns), column_types)
 
     artifacts = []
     used_names = set()
+    encoded = []
     for qi, q in enumerate(parsed.queries):
         qname = q.name or f"query_{qi}"
         if qname in used_names:
             raise SiddhiQLError(f"duplicate query name {qname!r}")
         used_names.add(qname)
-        artifacts.append(
-            _compile_query(q, qname, all_schemas, stream_codes, extensions)
-        )
+        art = _compile_query(q, qname, all_schemas, stream_codes, extensions)
+        encoded.extend(getattr(art, "encoded_columns", ()))
+        artifacts.append(art)
+
+    spec = TapeSpec(
+        stream_codes, tuple(columns), column_types, tuple(encoded)
+    )
 
     partitions = infer_stream_partitions(parsed.queries)
     return CompiledPlan(

@@ -1,0 +1,1197 @@
+"""Windows + aggregations + group-by/having compiled to segment reductions.
+
+Reference semantics being re-expressed (SURVEY.md §2.10): Siddhi sliding
+windows emit one aggregated row per *arriving* event over the events currently
+in the window (``#window.length(n)``, ``#window.time(t)``, used at
+SiddhiCEPITCase.java:315-316,427-428 and group-by at :492-504); batch windows
+(``lengthBatch``/``timeBatch``) emit per-group rows when a window tumbles;
+aggregation with no window is cumulative from stream start. The reference gets
+all of this from per-event JVM hash maps inside siddhi-core; here each shape
+becomes a data-parallel device plan:
+
+* sliding windows: ring buffer of the last C matching events carried across
+  micro-batches; per batch ONE (E, C) gather builds every event's window, and
+  masked reductions over the window axis produce every aggregate at once;
+* cumulative: dense group codes (host-interned, schema/encoders.py) + a
+  sort-based segmented prefix scan for per-event running values + a
+  ``segment_sum``/``min``/``max`` update of the per-group state table;
+* batch windows: events map to a (batch-slot, group) segment grid;
+  ``segment_*`` reductions aggregate the grid, completed rows flush to a
+  fixed-capacity output buffer, the incomplete row is the carry.
+
+Everything is static-shape, branch-free, and jit-compatible: data-dependent
+structure (how many events match, how many groups, how many flushes) lives in
+masks and fixed-capacity buffers, never in shapes (SURVEY.md §7 hard part 1).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..query import ast
+from ..query.lexer import SiddhiQLError
+from ..schema.encoders import GroupEncoder
+from ..schema.types import AttributeType
+from ..runtime.tape import EncodedColumn
+from .expr import (
+    ColumnEnv,
+    CompiledExpr,
+    ExprResolver,
+    ResolvedAttr,
+    compile_expr,
+    promote,
+)
+from .output import OutputField, OutputSchema
+
+# Bounded slot counts for data-dependent structures (documented limits; a
+# production config system can raise them per plan).
+TIME_WINDOW_CAPACITY = 512  # max events concurrently inside a #window.time
+TIME_BATCH_SLOTS = 64  # max distinct timeBatch windows touched per micro-batch
+MIN_GROUP_CAPACITY = 64
+
+
+# --------------------------------------------------------------------------
+# Aggregate extraction / expression rewriting
+# --------------------------------------------------------------------------
+
+_SUMLIKE_TYPES = {
+    AttributeType.INT: AttributeType.LONG,
+    AttributeType.LONG: AttributeType.LONG,
+    AttributeType.FLOAT: AttributeType.DOUBLE,
+    AttributeType.DOUBLE: AttributeType.DOUBLE,
+}
+
+
+@dataclass
+class _Agg:
+    kind: str  # sum count avg min max stddev distinctcount
+    arg_idx: int  # index into distinct arg expressions; -1 = none (count())
+    out_type: AttributeType
+    slot: str  # env key "@aggN"
+
+
+class _AggCollector:
+    """Dedups aggregate calls and their argument expressions."""
+
+    def __init__(self, resolver: ExprResolver, extensions) -> None:
+        self.resolver = resolver
+        self.extensions = extensions
+        self.aggs: List[_Agg] = []
+        self.arg_fns: List[Callable] = []
+        self.arg_types: List[AttributeType] = []
+        self._agg_keys: Dict[str, int] = {}
+        self._arg_keys: Dict[str, int] = {}
+
+    def _arg_index(self, expr: ast.Expr) -> Tuple[int, AttributeType]:
+        key = repr(expr)
+        if key in self._arg_keys:
+            i = self._arg_keys[key]
+            return i, self.arg_types[i]
+        ce = compile_expr(expr, self.resolver, self.extensions)
+        if not ce.atype.is_numeric and ce.atype != AttributeType.STRING:
+            raise SiddhiQLError(
+                f"cannot aggregate over type {ce.atype.value}"
+            )
+        i = len(self.arg_fns)
+        self._arg_keys[key] = i
+        self.arg_fns.append(ce.fn)
+        self.arg_types.append(ce.atype)
+        return i, ce.atype
+
+    def intern(self, call: ast.Call) -> _Agg:
+        key = repr(call)
+        if key in self._agg_keys:
+            return self.aggs[self._agg_keys[key]]
+        kind = call.name.lower()
+        if kind == "count":
+            if len(call.args) > 1:
+                raise SiddhiQLError("count() takes at most one argument")
+            arg_idx, out_type = -1, AttributeType.LONG
+        else:
+            if len(call.args) != 1:
+                raise SiddhiQLError(f"{kind}() takes exactly one argument")
+            arg_idx, arg_type = self._arg_index(call.args[0])
+            if kind == "sum":
+                if arg_type not in _SUMLIKE_TYPES:
+                    raise SiddhiQLError("sum() needs a numeric argument")
+                out_type = _SUMLIKE_TYPES[arg_type]
+            elif kind in ("avg", "stddev"):
+                if not arg_type.is_numeric:
+                    raise SiddhiQLError(f"{kind}() needs a numeric argument")
+                out_type = AttributeType.DOUBLE
+            elif kind in ("min", "max"):
+                if not arg_type.is_numeric:
+                    raise SiddhiQLError(f"{kind}() needs a numeric argument")
+                out_type = arg_type
+            elif kind == "distinctcount":
+                out_type = AttributeType.LONG
+            else:
+                raise SiddhiQLError(f"unknown aggregation {call.name!r}")
+        agg = _Agg(kind, arg_idx, out_type, f"@agg{len(self.aggs)}")
+        self._agg_keys[key] = len(self.aggs)
+        self.aggs.append(agg)
+        return agg
+
+    def rewrite(self, expr: ast.Expr) -> ast.Expr:
+        """Replace aggregate calls with slot references."""
+        if ast.is_aggregate_call(expr):
+            return ast.Attr(self.intern(expr).slot)
+        if isinstance(expr, ast.Unary):
+            return ast.Unary(expr.op, self.rewrite(expr.operand))
+        if isinstance(expr, ast.Binary):
+            return ast.Binary(
+                expr.op, self.rewrite(expr.left), self.rewrite(expr.right)
+            )
+        if isinstance(expr, ast.Call):
+            return ast.Call(
+                expr.name,
+                tuple(self.rewrite(a) for a in expr.args),
+                expr.namespace,
+            )
+        return expr
+
+
+class _SlotResolver:
+    """Resolver layering synthetic env slots (@aggN, select aliases) over the
+    stream resolver."""
+
+    def __init__(self, base, slots: Dict[str, AttributeType]) -> None:
+        self._base = base
+        self._slots = dict(slots)
+
+    def resolve(self, attr: ast.Attr) -> ResolvedAttr:
+        if attr.qualifier is None and attr.index is None:
+            if attr.name in self._slots:
+                return ResolvedAttr(attr.name, self._slots[attr.name], None)
+        return self._base.resolve(attr)
+
+
+def _referenced_keys(
+    expr: ast.Expr, resolver, out: Dict[str, AttributeType]
+) -> None:
+    """Collect tape column keys a rewritten expression reads (skips slots)."""
+    if isinstance(expr, ast.Attr):
+        if not expr.name.startswith("@"):
+            r = resolver.resolve(expr)
+            out[r.key] = r.atype
+        return
+    if isinstance(expr, ast.Unary):
+        _referenced_keys(expr.operand, resolver, out)
+    elif isinstance(expr, ast.Binary):
+        _referenced_keys(expr.left, resolver, out)
+        _referenced_keys(expr.right, resolver, out)
+    elif isinstance(expr, ast.Call):
+        for a in expr.args:
+            _referenced_keys(a, resolver, out)
+
+
+# --------------------------------------------------------------------------
+# Shared reduction helpers
+# --------------------------------------------------------------------------
+
+def _identity(kind: str, dtype) -> jnp.ndarray:
+    if kind == "min":
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(jnp.inf, dtype)
+        return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+    if kind == "max":
+        if jnp.issubdtype(dtype, jnp.floating):
+            return jnp.asarray(-jnp.inf, dtype)
+        return jnp.asarray(jnp.iinfo(dtype).min, dtype)
+    return jnp.asarray(0, dtype)
+
+
+def _seg_scan(flags, vals, combine_vals):
+    """Inclusive segmented scan: runs restart where ``flags`` is True."""
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, combine_vals(va, vb))
+
+    _, out = lax.associative_scan(comb, (flags, vals))
+    return out
+
+
+def _acc_stats_for(aggs: Sequence[_Agg]) -> Dict[int, set]:
+    """arg_idx -> set of accumulator stats needed ('sum','sumsq','min','max')."""
+    need: Dict[int, set] = {}
+    for a in aggs:
+        if a.arg_idx < 0:
+            continue
+        s = need.setdefault(a.arg_idx, set())
+        if a.kind in ("sum", "avg"):
+            s.add("sum")
+        elif a.kind == "stddev":
+            s.update(("sum", "sumsq"))
+        elif a.kind in ("min", "max"):
+            s.add(a.kind)
+        elif a.kind == "distinctcount":
+            raise SiddhiQLError(
+                "distinctCount() requires a sliding window "
+                "(#window.length/#window.time)"
+            )
+    return need
+
+
+# --------------------------------------------------------------------------
+# Sliding windows (length / time / externalTime): (E, C) window-matrix plan
+# --------------------------------------------------------------------------
+
+@dataclass
+class SlidingWindowArtifact:
+    name: str
+    output_schema: OutputSchema
+    stream_code: int
+    filter_fns: List
+    window_mode: str  # 'length' | 'time'
+    capacity: int  # ring slots C (== W for length windows)
+    time_ms: Optional[int]  # window span for 'time'
+    ts_key: Optional[str]  # externalTime attribute column; None -> tape ts
+    aggs: List[_Agg]
+    arg_fns: List[Callable]
+    arg_types: List[AttributeType]
+    group_fns: List[Callable]
+    group_dtypes: List
+    proj_fns: List
+    proj_types: List[AttributeType]
+    having_fn: Optional[Callable]
+    output_mode: str = "aligned"
+
+    def init_state(self) -> Dict:
+        C = self.capacity
+        ring = {
+            "ts": jnp.zeros(C, jnp.int32),
+            "valid": jnp.zeros(C, bool),
+        }
+        for j, t in enumerate(self.arg_types):
+            ring[f"a{j}"] = jnp.zeros(C, t.device_dtype)
+        for j, dt in enumerate(self.group_dtypes):
+            ring[f"g{j}"] = jnp.zeros(C, dt)
+        return {"enabled": jnp.asarray(True), "ring": ring}
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        env: ColumnEnv = dict(tape.cols)
+        mask = tape.valid & (tape.stream == self.stream_code)
+        for f in self.filter_fns:
+            mask = mask & f(env)
+        mask = mask & state["enabled"]
+        E = tape.capacity
+        C = self.capacity
+        ring = state["ring"]
+
+        order = jnp.argsort(jnp.logical_not(mask))  # matching first, stable
+        M = mask.sum()
+        rank = jnp.cumsum(mask) - 1  # per-position compacted index
+
+        def cat(ring_col, col):
+            col = jnp.broadcast_to(jnp.asarray(col), (E,))
+            return jnp.concatenate(
+                [ring_col, col[order].astype(ring_col.dtype)]
+            )
+
+        c_cols: Dict[str, jnp.ndarray] = {}
+        for j, fn in enumerate(self.arg_fns):
+            c_cols[f"a{j}"] = cat(ring[f"a{j}"], fn(env))
+        for j, fn in enumerate(self.group_fns):
+            c_cols[f"g{j}"] = cat(ring[f"g{j}"], fn(env))
+        ts_col = env[self.ts_key] if self.ts_key else tape.ts
+        c_cols["ts"] = cat(ring["ts"], ts_col)
+        cval = jnp.concatenate([ring["valid"], jnp.arange(E) < M])
+
+        # every row k = the last C matching events ending at compacted k
+        idx = jnp.arange(E)[:, None] + 1 + jnp.arange(C)[None, :]
+        win = {k: v[idx] for k, v in c_cols.items()}
+        member = cval[idx]
+        if self.window_mode == "time":
+            cur_ts = win["ts"][:, -1:]
+            member = member & (win["ts"] > cur_ts - self.time_ms)
+        for j in range(len(self.group_fns)):
+            g = win[f"g{j}"]
+            member = member & (g == g[:, -1:])
+
+        def unsort(rows, dtype):
+            r = rows[jnp.clip(rank, 0)]
+            return jnp.where(mask, r, 0).astype(dtype)
+
+        slot_types: Dict[str, AttributeType] = {}
+        for agg in self.aggs:
+            rows = self._reduce(agg, member, win)
+            env[agg.slot] = unsort(rows, agg.out_type.device_dtype)
+            slot_types[agg.slot] = agg.out_type
+
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(env)), (E,))
+            for p in self.proj_fns
+        )
+        out_mask = mask
+        if self.having_fn is not None:
+            henv = dict(env)
+            for f, c in zip(self.output_schema.fields, cols):
+                henv[f"@out:{f.name}"] = c
+            out_mask = out_mask & self.having_fn(henv)
+
+        new_ring = {
+            k: lax.dynamic_slice(v, (M,), (C,)) for k, v in c_cols.items()
+        }
+        new_ring["valid"] = lax.dynamic_slice(cval, (M,), (C,))
+        new_state = {"enabled": state["enabled"], "ring": new_ring}
+        return new_state, (out_mask, tape.ts, cols)
+
+    def _reduce(self, agg: _Agg, member, win):
+        if agg.kind == "count":
+            return member.sum(axis=1)
+        vals = win[f"a{agg.arg_idx}"]
+        if agg.kind == "sum":
+            return jnp.where(member, vals, 0).sum(axis=1)
+        if agg.kind in ("min", "max"):
+            ident = _identity(agg.kind, vals.dtype)
+            masked = jnp.where(member, vals, ident)
+            return masked.min(axis=1) if agg.kind == "min" else masked.max(
+                axis=1
+            )
+        if agg.kind == "avg":
+            s = jnp.where(member, vals, 0).astype(jnp.float32).sum(axis=1)
+            c = jnp.maximum(member.sum(axis=1), 1)
+            return s / c
+        if agg.kind == "stddev":
+            v = vals.astype(jnp.float32)
+            s = jnp.where(member, v, 0).sum(axis=1)
+            s2 = jnp.where(member, v * v, 0).sum(axis=1)
+            c = jnp.maximum(member.sum(axis=1), 1)
+            mean = s / c
+            return jnp.sqrt(jnp.maximum(s2 / c - mean * mean, 0.0))
+        if agg.kind == "distinctcount":
+            # first-occurrence count within each row's window
+            eq = vals[:, :, None] == vals[:, None, :]
+            both = member[:, :, None] & member[:, None, :]
+            earlier = jnp.tril(jnp.ones((eq.shape[1],) * 2, bool), k=-1)
+            dup = (eq & both & earlier[None]).any(axis=2)
+            return (member & ~dup).sum(axis=1)
+        raise AssertionError(agg.kind)
+
+
+# --------------------------------------------------------------------------
+# Cumulative aggregation (no window): per-group state table + segmented scan
+# --------------------------------------------------------------------------
+
+@dataclass
+class CumulativeAggArtifact:
+    name: str
+    output_schema: OutputSchema
+    stream_code: int
+    filter_fns: List
+    aggs: List[_Agg]
+    arg_fns: List[Callable]
+    arg_types: List[AttributeType]
+    code_key: Optional[str]  # encoded group column; None -> single group
+    encoder: Optional[GroupEncoder]
+    proj_fns: List
+    having_fn: Optional[Callable]
+    output_mode: str = "aligned"
+
+    def _stats(self) -> Dict[int, set]:
+        return _acc_stats_for(self.aggs)
+
+    def init_state(self) -> Dict:
+        G = (
+            _bucket(len(self.encoder), MIN_GROUP_CAPACITY)
+            if self.encoder is not None
+            else 1
+        )
+        st = {"enabled": jnp.asarray(True), "cnt": jnp.zeros(G, jnp.int32)}
+        for arg_idx, stats in self._stats().items():
+            dt = self.arg_types[arg_idx].device_dtype
+            for s in stats:
+                if s in ("sum", "sumsq"):
+                    adt = (
+                        jnp.float32
+                        if jnp.issubdtype(dt, jnp.floating) or s == "sumsq"
+                        else jnp.int32
+                    )
+                    st[f"{s}{arg_idx}"] = jnp.zeros(G, adt)
+                else:
+                    st[f"{s}{arg_idx}"] = jnp.full(
+                        G, _identity(s, dt), dt
+                    )
+        return st
+
+    def grow_state(self, state: Dict) -> Dict:
+        if self.encoder is None:
+            return state
+        G = state["cnt"].shape[0]
+        need = _bucket(len(self.encoder), MIN_GROUP_CAPACITY)
+        if need <= G:
+            return state
+        out = dict(state)
+        for k, v in state.items():
+            if k == "enabled":
+                continue
+            pad_val = (
+                _identity(k[:3], v.dtype)
+                if k.startswith(("min", "max"))
+                else jnp.asarray(0, v.dtype)
+            )
+            out[k] = jnp.concatenate(
+                [v, jnp.full(need - G, pad_val, v.dtype)]
+            )
+        return out
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        env: ColumnEnv = dict(tape.cols)
+        mask = tape.valid & (tape.stream == self.stream_code)
+        for f in self.filter_fns:
+            mask = mask & f(env)
+        mask = mask & state["enabled"]
+        E = tape.capacity
+        G = state["cnt"].shape[0]
+
+        if self.code_key is not None:
+            g = env[self.code_key].astype(jnp.int32)
+        else:
+            g = jnp.zeros(E, jnp.int32)
+        segkey = jnp.where(mask, g, G)
+        order = jnp.argsort(segkey)  # stable: groups contiguous, pad last
+        inv = jnp.argsort(order)
+        g_s = segkey[order]
+        flags = jnp.concatenate(
+            [jnp.ones(1, bool), g_s[1:] != g_s[:-1]]
+        )
+        gather_g = jnp.clip(g_s, 0, G - 1)
+
+        # per-event running count (prefix within batch + carried totals)
+        ones = jnp.ones(E, jnp.int32)
+        pre_cnt = _seg_scan(flags, ones, jnp.add) + state["cnt"][gather_g]
+        stats_env: Dict[str, jnp.ndarray] = {"cnt": pre_cnt[inv]}
+
+        seg_tot_cnt = jax.ops.segment_sum(
+            mask.astype(jnp.int32), segkey, num_segments=G + 1
+        )[:G]
+        new_state = dict(state)
+        new_state["cnt"] = state["cnt"] + seg_tot_cnt
+
+        for arg_idx, stats in self._stats().items():
+            v = self.arg_fns[arg_idx](env)
+            v = jnp.broadcast_to(jnp.asarray(v), (E,))
+            v_s = v[order]
+            for s in stats:
+                key = f"{s}{arg_idx}"
+                acc = state[key]
+                if s in ("sum", "sumsq"):
+                    vv_s = v_s.astype(acc.dtype)
+                    if s == "sumsq":
+                        vv_s = vv_s * vv_s
+                    vv_s = jnp.where(mask[order], vv_s, 0)
+                    pre = _seg_scan(flags, vv_s, jnp.add) + acc[gather_g]
+                    stats_env[key] = pre[inv]
+                    tot = jax.ops.segment_sum(
+                        jnp.where(mask, v.astype(acc.dtype), 0)
+                        if s == "sum"
+                        else jnp.where(
+                            mask,
+                            v.astype(acc.dtype) * v.astype(acc.dtype),
+                            0,
+                        ),
+                        segkey,
+                        num_segments=G + 1,
+                    )[:G]
+                    new_state[key] = acc + tot
+                else:
+                    ident = _identity(s, acc.dtype)
+                    comb = jnp.minimum if s == "min" else jnp.maximum
+                    vv_s = jnp.where(
+                        mask[order], v_s.astype(acc.dtype), ident
+                    )
+                    pre = comb(
+                        _seg_scan(flags, vv_s, comb), acc[gather_g]
+                    )
+                    stats_env[key] = pre[inv]
+                    seg_fn = (
+                        jax.ops.segment_min
+                        if s == "min"
+                        else jax.ops.segment_max
+                    )
+                    tot = seg_fn(
+                        jnp.where(mask, v.astype(acc.dtype), ident),
+                        segkey,
+                        num_segments=G + 1,
+                    )[:G]
+                    new_state[key] = comb(acc, tot)
+
+        for agg in self.aggs:
+            env[agg.slot] = _agg_from_stats(agg, stats_env).astype(
+                agg.out_type.device_dtype
+            )
+
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(env)), (E,))
+            for p in self.proj_fns
+        )
+        out_mask = mask
+        if self.having_fn is not None:
+            henv = dict(env)
+            for f, c in zip(self.output_schema.fields, cols):
+                henv[f"@out:{f.name}"] = c
+            out_mask = out_mask & self.having_fn(henv)
+        return new_state, (out_mask, tape.ts, cols)
+
+
+def _agg_from_stats(agg: _Agg, stats: Dict[str, jnp.ndarray]):
+    cnt = stats["cnt"]
+    if agg.kind == "count":
+        return cnt
+    key = lambda s: stats[f"{s}{agg.arg_idx}"]
+    if agg.kind == "sum":
+        return key("sum")
+    if agg.kind in ("min", "max"):
+        return key(agg.kind)
+    safe_cnt = jnp.maximum(cnt, 1)
+    if agg.kind == "avg":
+        return key("sum").astype(jnp.float32) / safe_cnt
+    if agg.kind == "stddev":
+        mean = key("sum").astype(jnp.float32) / safe_cnt
+        m2 = key("sumsq").astype(jnp.float32) / safe_cnt
+        return jnp.sqrt(jnp.maximum(m2 - mean * mean, 0.0))
+    raise AssertionError(agg.kind)
+
+
+# --------------------------------------------------------------------------
+# Batch (tumbling) windows: lengthBatch / timeBatch segment grids
+# --------------------------------------------------------------------------
+
+@dataclass
+class BatchWindowArtifact:
+    name: str
+    output_schema: OutputSchema
+    stream_code: int
+    filter_fns: List
+    window_mode: str  # 'lengthBatch' | 'timeBatch'
+    length: Optional[int]  # lengthBatch n
+    time_ms: Optional[int]  # timeBatch span
+    aggs: List[_Agg]
+    arg_fns: List[Callable]
+    arg_types: List[AttributeType]
+    code_key: Optional[str]
+    encoder: Optional[GroupEncoder]
+    # non-aggregate projection inputs: "last event of the group in the
+    # window" values, keyed by tape column
+    last_keys: List[str]
+    last_types: List[AttributeType]
+    proj_fns: List
+    having_fn: Optional[Callable]
+    output_mode: str = "buffered"
+
+    def _G(self, state) -> int:
+        return state["cnt"].shape[0]
+
+    def _stats(self) -> Dict[int, set]:
+        return _acc_stats_for(self.aggs)
+
+    def init_state(self) -> Dict:
+        G = (
+            _bucket(len(self.encoder), MIN_GROUP_CAPACITY)
+            if self.encoder is not None
+            else 1
+        )
+        st = {
+            "enabled": jnp.asarray(True),
+            # current (incomplete) window accumulators, per group
+            "cnt": jnp.zeros(G, jnp.int32),
+            "ts": jnp.zeros(G, jnp.int32),
+            "seen": jnp.asarray(0, jnp.int32),  # total matching ever
+            "batch": jnp.asarray(-1, jnp.int32),  # current window ordinal
+            "t0": jnp.asarray(-1, jnp.int32),  # first-ever event ts
+        }
+        for arg_idx, stats in self._stats().items():
+            dt = self.arg_types[arg_idx].device_dtype
+            for s in stats:
+                if s in ("sum", "sumsq"):
+                    adt = (
+                        jnp.float32
+                        if jnp.issubdtype(dt, jnp.floating) or s == "sumsq"
+                        else jnp.int32
+                    )
+                    st[f"{s}{arg_idx}"] = jnp.zeros(G, adt)
+                else:
+                    st[f"{s}{arg_idx}"] = jnp.full(G, _identity(s, dt), dt)
+        for j, t in enumerate(self.last_types):
+            st[f"last{j}"] = jnp.zeros(G, t.device_dtype)
+        return st
+
+    def grow_state(self, state: Dict) -> Dict:
+        if self.encoder is None:
+            return state
+        G = self._G(state)
+        need = _bucket(len(self.encoder), MIN_GROUP_CAPACITY)
+        if need <= G:
+            return state
+        out = dict(state)
+        for k, v in state.items():
+            if v.ndim == 0:
+                continue
+            pad_val = (
+                _identity(k[:3], v.dtype)
+                if k.startswith(("min", "max"))
+                else jnp.asarray(0, v.dtype)
+            )
+            out[k] = jnp.concatenate(
+                [v, jnp.full(need - G, pad_val, v.dtype)]
+            )
+        return out
+
+    # -- helpers ------------------------------------------------------------
+
+    def _grid_shape(self, E: int) -> int:
+        if self.window_mode == "lengthBatch":
+            return E // self.length + 2
+        return TIME_BATCH_SLOTS + 1
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        env: ColumnEnv = dict(tape.cols)
+        mask = tape.valid & (tape.stream == self.stream_code)
+        for f in self.filter_fns:
+            mask = mask & f(env)
+        mask = mask & state["enabled"]
+        E = tape.capacity
+        G = self._G(state)
+        B = self._grid_shape(E)
+        NS = B * G
+
+        if self.code_key is not None:
+            g = env[self.code_key].astype(jnp.int32)
+        else:
+            g = jnp.zeros(E, jnp.int32)
+
+        M = mask.sum()
+        rank = jnp.cumsum(mask) - 1  # 0-based matching ordinal in tape
+
+        if self.window_mode == "lengthBatch":
+            n = self.length
+            seq = state["seen"] + rank  # global matching ordinal
+            abs_batch = seq // n
+            first_batch = jnp.maximum(state["batch"], 0)
+            row = abs_batch - first_batch  # carry merges into row 0
+            new_seen = state["seen"] + M
+            new_batch = jnp.where(
+                new_seen > 0, new_seen // n, jnp.asarray(-1)
+            )
+            t0 = state["t0"]
+            # row r (abs batch first_batch+r) is complete when its last
+            # ordinal exists: (first_batch+r+1)*n <= new_seen
+            rows = jnp.arange(B, dtype=jnp.int32)
+            completed = (first_batch + rows + 1) * n <= new_seen
+        else:
+            T = self.time_ms
+            ts = tape.ts
+            first_ts = jnp.where(
+                M > 0,
+                jnp.min(jnp.where(mask, ts, jnp.iinfo(jnp.int32).max)),
+                0,
+            )
+            t0 = jnp.where(state["t0"] >= 0, state["t0"], first_ts)
+            abs_batch = jnp.where(mask, (ts - t0) // T, 0).astype(jnp.int32)
+            # dense-rank distinct windows in this tape; carry window is row 0
+            # (merging when the tape still starts in the carried window)
+            sortable = jnp.where(mask, abs_batch, jnp.iinfo(jnp.int32).max)
+            order = jnp.argsort(sortable)
+            inv = jnp.argsort(order)
+            ab_s = sortable[order]
+            newrun = jnp.concatenate(
+                [jnp.zeros(1, jnp.int32), (ab_s[1:] != ab_s[:-1]).astype(jnp.int32)]
+            )
+            rank_s = jnp.cumsum(newrun)
+            dense = rank_s[inv]  # dense window index within tape, 0-based
+            carry_batch = state["batch"]
+            tape_first_batch = jnp.where(M > 0, ab_s[0], carry_batch)
+            shift = jnp.where(
+                (carry_batch >= 0) & (tape_first_batch != carry_batch), 1, 0
+            )
+            row = dense + shift
+            first_batch = jnp.where(carry_batch >= 0, carry_batch, tape_first_batch)
+            # absolute batch per row, for completion checks
+            rows = jnp.arange(B, dtype=jnp.int32)
+            row_batch = jax.ops.segment_max(
+                jnp.where(mask, abs_batch, -(2 ** 31) + 1),
+                jnp.where(mask, row, B).astype(jnp.int32),
+                num_segments=B + 1,
+            )[:B]
+            row_batch = row_batch.at[0].set(
+                jnp.where(carry_batch >= 0, carry_batch, row_batch[0])
+            )
+            last_ts = jnp.max(jnp.where(mask, ts, -(2 ** 31) + 1))
+            # a window is complete once an event at/after its end exists
+            completed = (
+                (row_batch > -(2 ** 31) + 1)
+                & (last_ts >= t0 + (row_batch + 1) * T)
+            )
+            new_seen = state["seen"] + M
+            max_tape_batch = jnp.max(
+                jnp.where(mask, abs_batch, -(2 ** 31) + 1)
+            )
+            new_batch = jnp.where(
+                M > 0, jnp.maximum(carry_batch, max_tape_batch), carry_batch
+            )
+
+        row = jnp.clip(row, 0, B - 1)
+        seg = jnp.where(mask, row * G + g, NS).astype(jnp.int32)
+
+        # --- aggregate the (row, group) grid -------------------------------
+        tape_cnt = jax.ops.segment_sum(
+            mask.astype(jnp.int32), seg, num_segments=NS + 1
+        )[:NS].reshape(B, G)
+        had_tape = tape_cnt > 0
+        cnt_grid = tape_cnt.at[0].add(state["cnt"])
+        ts_grid = jax.ops.segment_max(
+            jnp.where(mask, tape.ts, -(2 ** 31) + 1),
+            seg,
+            num_segments=NS + 1,
+        )[:NS].reshape(B, G)
+        ts_grid = ts_grid.at[0].set(
+            jnp.maximum(ts_grid[0], jnp.where(state["cnt"] > 0, state["ts"], -(2 ** 31) + 1))
+        )
+
+        stat_grids: Dict[str, jnp.ndarray] = {}
+        for arg_idx, stats in self._stats().items():
+            v = jnp.broadcast_to(
+                jnp.asarray(self.arg_fns[arg_idx](env)), (E,)
+            )
+            for s in stats:
+                key = f"{s}{arg_idx}"
+                acc = state[key]
+                if s in ("sum", "sumsq"):
+                    vv = v.astype(acc.dtype)
+                    if s == "sumsq":
+                        vv = vv * vv
+                    grid = jax.ops.segment_sum(
+                        jnp.where(mask, vv, 0), seg, num_segments=NS + 1
+                    )[:NS].reshape(B, G)
+                    grid = grid.at[0].add(acc)
+                else:
+                    ident = _identity(s, acc.dtype)
+                    seg_fn = (
+                        jax.ops.segment_min
+                        if s == "min"
+                        else jax.ops.segment_max
+                    )
+                    comb = jnp.minimum if s == "min" else jnp.maximum
+                    grid = seg_fn(
+                        jnp.where(mask, v.astype(acc.dtype), ident),
+                        seg,
+                        num_segments=NS + 1,
+                    )[:NS].reshape(B, G)
+                    grid = grid.at[0].set(comb(grid[0], acc))
+                stat_grids[key] = grid
+
+        # last-event values per cell (for non-aggregate projections)
+        ord_grid = jax.ops.segment_max(
+            jnp.where(mask, rank, -1), seg, num_segments=NS + 1
+        )[:NS]
+        last_grids: Dict[str, jnp.ndarray] = {}
+        for j, key in enumerate(self.last_keys):
+            v = env[key]
+            winner = mask & (rank == ord_grid[jnp.clip(seg, 0, NS - 1)])
+            sum_dtype = jnp.int32 if v.dtype == bool else v.dtype
+            tape_last = jax.ops.segment_sum(
+                jnp.where(winner, v, 0).astype(sum_dtype),
+                seg,
+                num_segments=NS + 1,
+            )[:NS].reshape(B, G).astype(v.dtype)
+            merged = jnp.where(had_tape, tape_last, 0)
+            merged = merged.at[0].set(
+                jnp.where(had_tape[0], tape_last[0], state[f"last{j}"])
+            )
+            last_grids[key] = merged
+
+        # --- flush completed cells ----------------------------------------
+        flush = (cnt_grid > 0) & completed[:, None]  # (B, G)
+        flat = flush.reshape(NS)
+        fenv: ColumnEnv = {}
+        for agg in self.aggs:
+            stats_flat = {
+                k: v.reshape(NS) for k, v in stat_grids.items()
+            }
+            stats_flat["cnt"] = cnt_grid.reshape(NS)
+            fenv[agg.slot] = _agg_from_stats(agg, stats_flat).astype(
+                agg.out_type.device_dtype
+            )
+        for key, grid in last_grids.items():
+            fenv[key] = grid.reshape(NS)
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(fenv)), (NS,))
+            for p in self.proj_fns
+        )
+        out_mask = flat
+        if self.having_fn is not None:
+            henv = dict(fenv)
+            for f, c in zip(self.output_schema.fields, cols):
+                henv[f"@out:{f.name}"] = c
+            out_mask = out_mask & self.having_fn(henv)
+
+        ford = jnp.argsort(jnp.logical_not(out_mask))
+        count = out_mask.sum()
+        out_ts = ts_grid.reshape(NS)[ford]
+        out_cols = tuple(c[ford] for c in cols)
+
+        # --- carry: the last (incomplete) window ---------------------------
+        new_state = dict(state)
+        new_state["seen"] = new_seen
+        new_state["batch"] = new_batch
+        new_state["t0"] = t0
+        # the incomplete window's row index
+        if self.window_mode == "lengthBatch":
+            inc_row = jnp.clip(new_batch - first_batch, 0, B - 1)
+            inc_live = jnp.ones((), bool)
+        else:
+            inc_row = jnp.clip(
+                jnp.where(M > 0, rank_s[jnp.clip(M - 1, 0)] + shift, 0),
+                0,
+                B - 1,
+            )
+            inc_live = ~completed[inc_row]
+
+        def carry_of(grid, zero):
+            rowv = grid[inc_row]
+            return jnp.where(inc_live, rowv, zero)
+
+        new_state["cnt"] = carry_of(cnt_grid, jnp.zeros(G, jnp.int32))
+        new_state["ts"] = carry_of(ts_grid, jnp.zeros(G, jnp.int32)).astype(
+            jnp.int32
+        )
+        for key, grid in stat_grids.items():
+            if key.startswith(("min", "max")):
+                zero = jnp.full(G, _identity(key[:3], grid.dtype), grid.dtype)
+            else:
+                zero = jnp.zeros(G, grid.dtype)
+            new_state[key] = carry_of(grid, zero)
+        for j, key in enumerate(self.last_keys):
+            new_state[f"last{j}"] = carry_of(
+                last_grids[key], jnp.zeros(G, last_grids[key].dtype)
+            ).astype(state[f"last{j}"].dtype)
+        return new_state, (count, out_ts, out_cols)
+
+    def flush(self, state: Dict) -> Tuple[Dict, Tuple]:
+        """End-of-stream flush of the carried incomplete window (timeBatch
+        semantics: the final timer fires; lengthBatch does not flush partial
+        windows, matching Siddhi)."""
+        G = self._G(state)
+        if self.window_mode != "timeBatch":
+            empty = (
+                jnp.asarray(0, jnp.int32),
+                jnp.zeros(G, jnp.int32),
+                tuple(
+                    jnp.zeros(G, f.atype.device_dtype)
+                    for f in self.output_schema.fields
+                ),
+            )
+            return state, empty
+        flushable = state["cnt"] > 0
+        stats_flat = {"cnt": state["cnt"]}
+        fenv: ColumnEnv = {}
+        for key in state:
+            if key[:3] in ("sum", "min", "max") or key.startswith("sumsq"):
+                stats_flat[key] = state[key]
+        for agg in self.aggs:
+            fenv[agg.slot] = _agg_from_stats(agg, stats_flat).astype(
+                agg.out_type.device_dtype
+            )
+        for j, key in enumerate(self.last_keys):
+            fenv[key] = state[f"last{j}"]
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(fenv)), (G,))
+            for p in self.proj_fns
+        )
+        out_mask = flushable
+        if self.having_fn is not None:
+            henv = dict(fenv)
+            for f, c in zip(self.output_schema.fields, cols):
+                henv[f"@out:{f.name}"] = c
+            out_mask = out_mask & self.having_fn(henv)
+        ford = jnp.argsort(jnp.logical_not(out_mask))
+        count = out_mask.sum()
+        # closing the window early: every accumulator resets, or the next
+        # step would re-add the flushed totals into row 0
+        new_state = dict(state)
+        for k, v in state.items():
+            if v.ndim == 0:
+                continue
+            if k.startswith(("min", "max")):
+                new_state[k] = jnp.full(G, _identity(k[:3], v.dtype), v.dtype)
+            else:
+                new_state[k] = jnp.zeros(G, v.dtype)
+        return new_state, (
+            count,
+            state["ts"][ford],
+            tuple(c[ford] for c in cols),
+        )
+
+
+def _bucket(n: int, minimum: int) -> int:
+    b = minimum
+    while b < max(n, 1):
+        b *= 2
+    return b
+
+
+# --------------------------------------------------------------------------
+# Entry point
+# --------------------------------------------------------------------------
+
+def _window_of(inp: ast.StreamInput):
+    """Classify the (at most one) window handler on a stream input."""
+    if not inp.windows:
+        return None
+    if len(inp.windows) > 1:
+        raise SiddhiQLError("at most one #window handler per stream input")
+    w = inp.windows[0]
+    name = w.name.split(".")[-1]
+    lname = name.lower()
+    if lname in ("length", "lengthbatch"):
+        if len(w.args) != 1 or not isinstance(w.args[0], ast.Literal):
+            raise SiddhiQLError(f"#window.{name} needs one integer argument")
+        return ("length" if lname == "length" else "lengthBatch",
+                int(w.args[0].value))
+    if lname in ("time", "timebatch"):
+        if len(w.args) != 1:
+            raise SiddhiQLError(f"#window.{name} needs one time argument")
+        return ("time" if lname == "time" else "timeBatch",
+                _time_arg(w.args[0]))
+    if lname == "externaltime":
+        if len(w.args) != 2 or not isinstance(w.args[0], ast.Attr):
+            raise SiddhiQLError(
+                "#window.externalTime needs (tsAttribute, duration)"
+            )
+        return ("externalTime", (w.args[0], _time_arg(w.args[1])))
+    raise SiddhiQLError(f"unsupported window #window.{w.name}")
+
+
+def _time_arg(a: ast.Expr) -> int:
+    if isinstance(a, ast.TimeLiteral):
+        return a.ms
+    if isinstance(a, ast.Literal) and isinstance(a.value, int):
+        return a.value
+    raise SiddhiQLError("expected a time duration argument")
+
+
+def compile_window_query(
+    q: ast.Query,
+    name: str,
+    schemas,
+    stream_codes: Dict[str, int],
+    extensions,
+):
+    inp = q.input
+    assert isinstance(inp, ast.StreamInput)
+    ref = inp.ref_name
+    scopes = {ref: (inp.stream_id, schemas[inp.stream_id])}
+    if ref != inp.stream_id:
+        scopes[inp.stream_id] = (inp.stream_id, schemas[inp.stream_id])
+    resolver = ExprResolver(scopes, default_scope=ref)
+
+    filter_fns = []
+    for f in inp.filters:
+        ce = compile_expr(f, resolver, extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError("stream filter must be boolean")
+        filter_fns.append(ce.fn)
+
+    items = q.selector.items
+    schema = schemas[inp.stream_id]
+    if q.selector.is_star:
+        items = tuple(
+            ast.SelectItem(ast.Attr(n), None) for n in schema.field_names
+        )
+
+    group_names = q.selector.group_by
+    collector = _AggCollector(resolver, extensions)
+    rewritten = [
+        ast.SelectItem(collector.rewrite(i.expr), i.alias) for i in items
+    ]
+    having_re = (
+        collector.rewrite(q.selector.having)
+        if q.selector.having is not None
+        else None
+    )
+
+    window = _window_of(inp)
+    if not collector.aggs and not group_names:
+        # window with plain projection: current-event output == stateless
+        # select (Siddhi emits arriving events unchanged for `insert into`)
+        from .select import compile_select
+
+        return compile_select(
+            q, name, resolver, schemas, stream_codes[inp.stream_id],
+            extensions,
+        )
+
+    slot_types = {a.slot: a.out_type for a in collector.aggs}
+    slot_resolver = _SlotResolver(resolver, slot_types)
+
+    proj_fns: List = []
+    out_fields: List[OutputField] = []
+    for item in rewritten:
+        ce = compile_expr(item.expr, slot_resolver, extensions)
+        proj_fns.append(ce.fn)
+        out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
+
+    having_fn = None
+    if having_re is not None:
+        # having may reference select aliases; map alias -> @out slot
+        alias_slots = {f.name: f.atype for f in out_fields}
+
+        class _HavingResolver:
+            def resolve(self, attr: ast.Attr) -> ResolvedAttr:
+                if attr.qualifier is None and attr.index is None:
+                    if attr.name in slot_types:
+                        return ResolvedAttr(
+                            attr.name, slot_types[attr.name], None
+                        )
+                    if attr.name in alias_slots:
+                        return ResolvedAttr(
+                            f"@out:{attr.name}", alias_slots[attr.name], None
+                        )
+                return resolver.resolve(attr)
+
+        ce = compile_expr(having_re, _HavingResolver(), extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError("having clause must be boolean")
+        having_fn = ce.fn
+
+    out_schema = OutputSchema(q.output_stream, tuple(out_fields))
+    sc = stream_codes[inp.stream_id]
+
+    group_resolved = [resolver.resolve(ast.Attr(n)) for n in group_names]
+
+    if window is None or window[0] in ("length", "time", "externalTime"):
+        if window is None:
+            mode, cap, time_ms, ts_key = "cumulative", 0, None, None
+        elif window[0] == "length":
+            mode, cap, time_ms, ts_key = "length", window[1], None, None
+        elif window[0] == "time":
+            mode, cap, time_ms, ts_key = (
+                "time", TIME_WINDOW_CAPACITY, window[1], None,
+            )
+        else:  # externalTime
+            ts_attr, dur = window[1]
+            r = resolver.resolve(ts_attr)
+            mode, cap, time_ms, ts_key = (
+                "time", TIME_WINDOW_CAPACITY, dur, r.key,
+            )
+        if mode == "cumulative":
+            code_key, encoder, encoded = _group_encoding(
+                name, group_resolved, sc, filter_fns
+            )
+            art = CumulativeAggArtifact(
+                name=name,
+                output_schema=out_schema,
+                stream_code=sc,
+                filter_fns=filter_fns,
+                aggs=collector.aggs,
+                arg_fns=collector.arg_fns,
+                arg_types=collector.arg_types,
+                code_key=code_key,
+                encoder=encoder,
+                proj_fns=proj_fns,
+                having_fn=having_fn,
+            )
+            art.encoded_columns = encoded
+            return art
+        group_fns = []
+        group_dtypes = []
+        for r in group_resolved:
+            key = r.key
+            group_fns.append(lambda env, k=key: env[k])
+            group_dtypes.append(r.atype.device_dtype)
+        art = SlidingWindowArtifact(
+            name=name,
+            output_schema=out_schema,
+            stream_code=sc,
+            filter_fns=filter_fns,
+            window_mode="length" if mode == "length" else "time",
+            capacity=cap,
+            time_ms=time_ms,
+            ts_key=ts_key,
+            aggs=collector.aggs,
+            arg_fns=collector.arg_fns,
+            arg_types=collector.arg_types,
+            group_fns=group_fns,
+            group_dtypes=group_dtypes,
+            proj_fns=proj_fns,
+            proj_types=[f.atype for f in out_fields],
+            having_fn=having_fn,
+        )
+        art.encoded_columns = ()
+        return art
+
+    # batch windows
+    mode, arg = window
+    code_key, encoder, encoded = _group_encoding(
+        name, group_resolved, sc, filter_fns
+    )
+    # non-aggregate projection inputs need per-cell "last event" values
+    last_types_map: Dict[str, AttributeType] = {}
+    for item in rewritten:
+        _referenced_keys(item.expr, resolver, last_types_map)
+    if having_re is not None:
+        _referenced_keys(having_re, resolver, last_types_map)
+    last_keys = sorted(last_types_map)
+    art = BatchWindowArtifact(
+        name=name,
+        output_schema=out_schema,
+        stream_code=sc,
+        filter_fns=filter_fns,
+        window_mode=mode,
+        length=arg if mode == "lengthBatch" else None,
+        time_ms=arg if mode == "timeBatch" else None,
+        aggs=collector.aggs,
+        arg_fns=collector.arg_fns,
+        arg_types=collector.arg_types,
+        code_key=code_key,
+        encoder=encoder,
+        last_keys=last_keys,
+        last_types=[last_types_map[k] for k in last_keys],
+        proj_fns=proj_fns,
+        having_fn=having_fn,
+    )
+    art.encoded_columns = encoded
+    return art
+
+
+def _group_encoding(
+    name: str,
+    group_resolved: List[ResolvedAttr],
+    stream_code: int,
+    filter_fns: Sequence[Callable] = (),
+):
+    """Dense group codes for state-table artifacts. Single-column int-like
+    keys could index directly, but interning keeps tables dense for arbitrary
+    key distributions and multi-column keys. Interning respects the query's
+    filters so rejected events never grow the table."""
+    if not group_resolved:
+        return None, None, ()
+    encoder = GroupEncoder()
+    out_key = f"@group:{name}"
+    select_fn = None
+    if filter_fns:
+        fns = list(filter_fns)
+
+        def select_fn(cols, _fns=fns):
+            import numpy as _np
+
+            m = _np.ones(len(next(iter(cols.values()))), dtype=bool)
+            for f in _fns:
+                m = m & _np.asarray(f(cols))
+            return m
+
+    enc = EncodedColumn(
+        out_key=out_key,
+        in_keys=tuple(r.key for r in group_resolved),
+        stream_code=stream_code,
+        encoder=encoder,
+        select_fn=select_fn,
+    )
+    return out_key, encoder, (enc,)

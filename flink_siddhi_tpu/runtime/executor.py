@@ -88,6 +88,18 @@ class Job:
             cycles += 1
             if max_cycles is not None and cycles >= max_cycles:
                 break
+        if self.finished:
+            self.flush()
+
+    def flush(self) -> None:
+        """End-of-stream: fire final timer-driven emissions (timeBatch
+        windows carry their last incomplete window out)."""
+        for rt in self._plans.values():
+            rt.states, outputs = rt.plan.flush(rt.states)
+            if outputs:
+                self._decode_outputs(
+                    rt.plan, outputs, only=set(outputs)
+                )
 
     @property
     def finished(self) -> bool:
@@ -157,11 +169,18 @@ class Job:
         if not involved:
             return
         tape, _prov = build_tape(plan.spec, involved, self._epoch_ms)
+        # host interning may have discovered new group keys: re-bucket state
+        # tables before the jit call (shape change -> one-off retrace)
+        rt.states = plan.grow_state(rt.states)
         rt.states, outputs = rt.jitted(rt.states, tape)
         self._decode_outputs(plan, outputs)
 
-    def _decode_outputs(self, plan: CompiledPlan, outputs: Dict) -> None:
+    def _decode_outputs(
+        self, plan: CompiledPlan, outputs: Dict, only=None
+    ) -> None:
         for a in plan.artifacts:
+            if only is not None and a.name not in only:
+                continue
             out = outputs[a.name]
             schema = a.output_schema
             if a.output_mode == "aligned":

@@ -31,12 +31,31 @@ def bucket_size(n: int, minimum: int = MIN_BUCKET) -> int:
 
 
 @dataclass(frozen=True)
+class EncodedColumn:
+    """A host-computed dense-code column: rows of ``in_keys`` (for events of
+    ``stream_code``) interned through ``encoder`` into ``out_key``. Used for
+    group-by state tables (schema/encoders.py).
+
+    ``select_fn`` (cols -> bool mask), when set, restricts interning to rows
+    the owning query's filters accept — otherwise a heavily filtered query
+    over a high-cardinality stream would grow its group table (and retrace)
+    for groups that can never emit."""
+
+    out_key: str
+    in_keys: Tuple[str, ...]
+    stream_code: int
+    encoder: object  # GroupEncoder
+    select_fn: object = None
+
+
+@dataclass(frozen=True)
 class TapeSpec:
     """What the step needs materialized."""
 
     stream_codes: Dict[str, int]  # stream_id -> dense code
     columns: Tuple[str, ...]  # "stream.field" keys
     column_types: Dict[str, AttributeType]
+    encoded: Tuple[EncodedColumn, ...] = ()
 
     def code_of(self, stream_id: str) -> int:
         return self.stream_codes[stream_id]
@@ -130,5 +149,17 @@ def build_tape(
             offset += n
         col[:total] = merged_vals[order]
         cols[key] = col
+
+    for enc in spec.encoded:
+        select = stream[:total] == enc.stream_code
+        if enc.select_fn is not None:
+            view = {k: v[:total] for k, v in cols.items()}
+            select = select & np.asarray(enc.select_fn(view))
+        codes = enc.encoder.intern_rows(
+            [cols[k][:total] for k in enc.in_keys], select
+        )
+        col = np.zeros(cap, dtype=np.int32)
+        col[:total] = codes
+        cols[enc.out_key] = col
 
     return Tape(ts, stream, valid, cols), prov

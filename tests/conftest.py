@@ -3,13 +3,29 @@
 The analog of the reference's in-process Flink MiniCluster
 (SiddhiCEPITCase.java:63 extends AbstractTestBase): real multi-device sharding
 and collectives, single process, no TPU required.
+
+The environment may pre-register an accelerator PJRT plugin whose lazy
+initialization dials a remote tunnel; tests must never depend on that tunnel
+being alive, so non-CPU backend factories are dropped before any backend
+initializes (``jax.backends()`` would otherwise try to init them all).
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+# jax may already be imported (an interpreter-startup hook importing it
+# captures JAX_PLATFORMS before this file runs), so set the config directly.
+jax.config.update("jax_platforms", "cpu")
+
+for _name in list(_xb._backend_factories):
+    if _name != "cpu":
+        del _xb._backend_factories[_name]

@@ -233,3 +233,29 @@ def test_pattern_with_quantified_middle():
     )
     assert len(out) == 1
     assert out[0] == {"t1": 1000, "t2": 2000, "t3": 3000}
+
+
+def test_sequence_rearms_after_break():
+    # non-every sequence: a broken partial must not disarm matching forever
+    # (Siddhi still emits the later (2,3) match)
+    events = [ev(2, 1000), ev(9, 2000), ev(2, 3000), ev(3, 4000)]
+    out = run_pattern(
+        "from s1 = inputStream1[id == 2] , s2 = inputStream1[id == 3] "
+        "select s1.id as a, s2.id as b insert into outputStream",
+        events,
+    )
+    assert out == [{"a": 2, "b": 3}]
+
+
+def test_single_element_every_pattern_timestamps():
+    # K == 1 chain: each match emits at its own event's timestamp
+    env = CEPEnvironment()
+    es = SiddhiCEP.define(
+        "inputStream1", [ev(2, 5000), ev(1, 7000), ev(2, 9000)], FIELDS,
+        env=env,
+    ).cql(
+        "from every s1 = inputStream1[id == 2] select s1.id as a "
+        "insert into outputStream"
+    )
+    rows = es.execute().results_with_ts("outputStream")
+    assert rows == [(5000, (2,)), (9000, (2,))]

@@ -1,0 +1,346 @@
+"""End-to-end: windows, aggregations, group-by, having.
+
+Pins the reference's window/aggregation surface (SiddhiCEPITCase.java:
+315-318 windowed aggregation, :492-504 group-by; siddhi-core semantics per
+SURVEY.md §2.10) against pure-Python oracles: sliding windows emit one row per
+arriving event over the current window contents; batch windows emit per-group
+rows when the window tumbles; no window = cumulative aggregation.
+"""
+
+import dataclasses
+import math
+
+import pytest
+
+from flink_siddhi_tpu import SiddhiCEP, CEPEnvironment
+
+
+@dataclasses.dataclass
+class Event:
+    id: int
+    name: str
+    price: float
+    timestamp: int
+
+
+FIELDS = ["id", "name", "price", "timestamp"]
+
+
+def make_events(n, start_ts=1000, id_mod=4, step=1000):
+    return [
+        Event(i % id_mod, f"name_{i % 3}", float(i), start_ts + step * i)
+        for i in range(n)
+    ]
+
+
+def run(events, cql, out="out", batch_size=4096):
+    env = CEPEnvironment(batch_size=batch_size)
+    return (
+        SiddhiCEP.define("inputStream", events, FIELDS, env=env)
+        .cql(cql)
+        .returns(out)
+    )
+
+
+# --------------------------------------------------------------------------
+# sliding length windows
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("batch_size", [4096, 7])
+def test_length_window_sum(batch_size):
+    events = make_events(20)
+    out = run(
+        events,
+        "from inputStream#window.length(5) "
+        "select sum(price) as total insert into out",
+        batch_size=batch_size,
+    )
+    expected = []
+    for i in range(len(events)):
+        w = events[max(0, i - 4) : i + 1]
+        expected.append((sum(e.price for e in w),))
+    assert out == expected
+
+
+@pytest.mark.parametrize("batch_size", [4096, 7])
+def test_length_window_group_by(batch_size):
+    events = make_events(24)
+    out = run(
+        events,
+        "from inputStream#window.length(6) "
+        "select id, sum(price) as total, count() as c "
+        "group by id insert into out",
+        batch_size=batch_size,
+    )
+    expected = []
+    for i in range(len(events)):
+        w = events[max(0, i - 5) : i + 1]
+        grp = [e for e in w if e.id == events[i].id]
+        expected.append(
+            (events[i].id, sum(e.price for e in grp), len(grp))
+        )
+    assert out == expected
+
+
+def test_length_window_min_max_avg():
+    events = make_events(15)
+    out = run(
+        events,
+        "from inputStream#window.length(4) "
+        "select min(price) as lo, max(price) as hi, avg(price) as mean "
+        "insert into out",
+    )
+    for i, row in enumerate(out):
+        w = [e.price for e in events[max(0, i - 3) : i + 1]]
+        assert row[0] == min(w)
+        assert row[1] == max(w)
+        assert row[2] == pytest.approx(sum(w) / len(w))
+
+
+def test_length_window_stddev_distinctcount():
+    events = make_events(12, id_mod=3)
+    out = run(
+        events,
+        "from inputStream#window.length(5) "
+        "select stddev(price) as sd, distinctCount(id) as dc "
+        "insert into out",
+    )
+    for i, row in enumerate(out):
+        w = events[max(0, i - 4) : i + 1]
+        vals = [e.price for e in w]
+        mean = sum(vals) / len(vals)
+        var = sum((v - mean) ** 2 for v in vals) / len(vals)
+        assert row[0] == pytest.approx(math.sqrt(var), abs=1e-4)
+        assert row[1] == len({e.id for e in w})
+
+
+def test_length_window_with_filter():
+    events = make_events(30)
+    out = run(
+        events,
+        "from inputStream[id == 2]#window.length(3) "
+        "select sum(price) as total insert into out",
+    )
+    matching = [e for e in events if e.id == 2]
+    expected = []
+    for i in range(len(matching)):
+        w = matching[max(0, i - 2) : i + 1]
+        expected.append((sum(e.price for e in w),))
+    assert out == expected
+
+
+# --------------------------------------------------------------------------
+# sliding time windows
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("batch_size", [4096, 5])
+def test_time_window_sum(batch_size):
+    events = make_events(20)
+    out = run(
+        events,
+        "from inputStream#window.time(3 sec) "
+        "select sum(price) as total, count() as c insert into out",
+        batch_size=batch_size,
+    )
+    expected = []
+    for i, cur in enumerate(events):
+        w = [
+            e
+            for e in events[: i + 1]
+            if e.timestamp > cur.timestamp - 3000
+        ]
+        expected.append((sum(e.price for e in w), len(w)))
+    assert out == expected
+
+
+def test_time_window_group_by():
+    events = make_events(18, id_mod=3)
+    out = run(
+        events,
+        "from inputStream#window.time(4000) "
+        "select id, avg(price) as mean group by id insert into out",
+    )
+    for i, row in enumerate(out):
+        cur = events[i]
+        w = [
+            e
+            for e in events[: i + 1]
+            if e.timestamp > cur.timestamp - 4000 and e.id == cur.id
+        ]
+        assert row[0] == cur.id
+        assert row[1] == pytest.approx(
+            sum(e.price for e in w) / len(w)
+        )
+
+
+# --------------------------------------------------------------------------
+# cumulative (no window)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("batch_size", [4096, 6])
+def test_cumulative_sum_count(batch_size):
+    events = make_events(20)
+    out = run(
+        events,
+        "from inputStream select sum(price) as s, count() as c "
+        "insert into out",
+        batch_size=batch_size,
+    )
+    run_sum = 0.0
+    for i, row in enumerate(out):
+        run_sum += events[i].price
+        assert row == (run_sum, i + 1)
+
+
+@pytest.mark.parametrize("batch_size", [4096, 6])
+def test_cumulative_group_by(batch_size):
+    events = make_events(24)
+    out = run(
+        events,
+        "from inputStream select id, sum(price) as s, min(price) as lo, "
+        "max(price) as hi group by id insert into out",
+        batch_size=batch_size,
+    )
+    for i, row in enumerate(out):
+        grp = [e for e in events[: i + 1] if e.id == events[i].id]
+        assert row == (
+            events[i].id,
+            sum(e.price for e in grp),
+            min(e.price for e in grp),
+            max(e.price for e in grp),
+        )
+
+
+def test_cumulative_group_by_string_key():
+    events = make_events(15)
+    out = run(
+        events,
+        "from inputStream select name, count() as c group by name "
+        "insert into out",
+    )
+    for i, row in enumerate(out):
+        grp = [e for e in events[: i + 1] if e.name == events[i].name]
+        assert row == (events[i].name, len(grp))
+
+
+# --------------------------------------------------------------------------
+# batch (tumbling) windows
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("batch_size", [4096, 7])
+def test_length_batch_sum(batch_size):
+    events = make_events(23)
+    out = run(
+        events,
+        "from inputStream#window.lengthBatch(5) "
+        "select sum(price) as total, count() as c insert into out",
+        batch_size=batch_size,
+    )
+    expected = []
+    for start in range(0, 20, 5):  # only complete batches flush
+        chunk = events[start : start + 5]
+        expected.append((sum(e.price for e in chunk), 5))
+    assert out == expected
+
+
+@pytest.mark.parametrize("batch_size", [4096, 9])
+def test_length_batch_group_by(batch_size):
+    events = make_events(20, id_mod=2)
+    out = run(
+        events,
+        "from inputStream#window.lengthBatch(4) "
+        "select id, sum(price) as total group by id insert into out",
+        batch_size=batch_size,
+    )
+    expected = set()
+    for start in range(0, 20, 4):
+        chunk = events[start : start + 4]
+        for gid in sorted({e.id for e in chunk}):
+            grp = [e for e in chunk if e.id == gid]
+            expected.add((gid, sum(e.price for e in grp)))
+    assert len(out) == len(expected)
+    assert set(out) == expected
+
+
+@pytest.mark.parametrize("batch_size", [4096, 5])
+def test_time_batch(batch_size):
+    events = make_events(12)  # ts 1000..12000 step 1000
+    out = run(
+        events,
+        "from inputStream#window.timeBatch(3 sec) "
+        "select sum(price) as total, count() as c insert into out",
+        batch_size=batch_size,
+    )
+    # windows of 3s anchored at first event ts=1000: [1000,4000) [4000,7000)
+    # [7000,10000) [10000,13000); the last flushes at end-of-stream
+    expected = []
+    t0 = events[0].timestamp
+    k = 0
+    while True:
+        lo, hi = t0 + k * 3000, t0 + (k + 1) * 3000
+        chunk = [e for e in events if lo <= e.timestamp < hi]
+        if not chunk:
+            break
+        expected.append((sum(e.price for e in chunk), len(chunk)))
+        k += 1
+    assert out == expected
+
+
+# --------------------------------------------------------------------------
+# having / expression-of-aggregates
+# --------------------------------------------------------------------------
+
+def test_having_on_alias():
+    events = make_events(20)
+    out = run(
+        events,
+        "from inputStream#window.length(5) "
+        "select sum(price) as total having total > 30.0 insert into out",
+    )
+    expected = []
+    for i in range(len(events)):
+        w = events[max(0, i - 4) : i + 1]
+        t = sum(e.price for e in w)
+        if t > 30.0:
+            expected.append((t,))
+    assert out == expected
+
+
+def test_having_group_by():
+    events = make_events(24)
+    out = run(
+        events,
+        "from inputStream select id, count() as c group by id "
+        "having c >= 3 insert into out",
+    )
+    expected = []
+    for i in range(len(events)):
+        grp = [e for e in events[: i + 1] if e.id == events[i].id]
+        if len(grp) >= 3:
+            expected.append((events[i].id, len(grp)))
+    assert out == expected
+
+
+def test_aggregate_in_expression():
+    events = make_events(10)
+    out = run(
+        events,
+        "from inputStream#window.length(4) "
+        "select sum(price) / count() as mean, timestamp "
+        "insert into out",
+    )
+    for i, row in enumerate(out):
+        w = [e.price for e in events[max(0, i - 3) : i + 1]]
+        assert row[0] == pytest.approx(sum(w) / len(w))
+        assert row[1] == events[i].timestamp
+
+
+def test_window_passthrough_projection():
+    # window + plain select: current events pass through unchanged
+    events = make_events(6)
+    out = run(
+        events,
+        "from inputStream#window.length(3) select id, price "
+        "insert into out",
+    )
+    assert out == [(e.id, e.price) for e in events]
