@@ -1,0 +1,338 @@
+"""Windowed two-stream joins compiled to masked pair matrices.
+
+Reference surface: windowed joins with ``on`` conditions
+(SiddhiCEPITCase.java:306-327, 413-439 — ``from A#window.length(5) join
+B#window.time(500) on a.x == b.y``), which siddhi-core evaluates per arriving
+event against the opposite window's buffered events. Note the reference's
+*dynamic* path rejects joins outright (SiddhiExecutionPlanner.java:99-100);
+static-path support is the parity bar.
+
+Device shape: each side keeps a ring of its last C matching events (columns
+referenced by the join + projections, carried across micro-batches). Per
+micro-batch, each direction builds ONE (E, C+E) pair mask — arriving events
+of one side × the other side's combined ring+batch — with window membership
+expressed as global-ordinal bounds (length windows) or timestamp bounds (time
+windows), the ``on`` condition evaluated by broadcasting the compiled
+expression over (E,1)×(1,C+E) column views, and matching pairs compacted into
+a fixed-capacity output buffer. Every ordered pair is emitted exactly once:
+by whichever event arrives later.
+
+Outer joins emit the arriving event with zero-filled columns for the missing
+side (the engine has no device-side null; SURVEY.md §7 hard part 1 applies —
+a null-mask column is a planned refinement).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..query import ast
+from ..query.lexer import SiddhiQLError
+from ..schema.types import AttributeType
+from .expr import ColumnEnv, ExprResolver, compile_expr
+from .output import OutputField, OutputSchema
+from .window import _window_of, _referenced_keys
+
+JOIN_WINDOW_CAPACITY = 128  # ring slots per side when the window is
+# unbounded or time-based (bounded-slot policy, SURVEY.md §7 hard part 2)
+JOIN_OUT_FACTOR = 4  # output buffer capacity = factor * tape capacity
+
+
+@dataclass
+class _Side:
+    stream_id: str
+    ref: str
+    stream_code: int
+    filter_fns: List[Callable]
+    window_mode: str  # 'length' | 'time'
+    window_n: int  # length bound (ring capacity for time/unbounded)
+    time_ms: Optional[int]
+    cols: List[str]  # tape column keys buffered in this side's ring
+    col_types: List[AttributeType]
+    outer: bool  # emit this side's unmatched arrivals
+
+
+@dataclass
+class JoinArtifact:
+    name: str
+    output_schema: OutputSchema
+    left: _Side
+    right: _Side
+    on_fn: Optional[Callable]
+    within: Optional[int]
+    proj_fns: List[Callable]
+    output_mode: str = "buffered"
+
+    def init_state(self) -> Dict:
+        st = {"enabled": jnp.asarray(True),
+              "overflow": jnp.asarray(0, jnp.int32)}
+        for tag, side in (("l", self.left), ("r", self.right)):
+            C = side.window_n
+            st[f"{tag}_valid"] = jnp.zeros(C, bool)
+            st[f"{tag}_ts"] = jnp.zeros(C, jnp.int32)
+            st[f"{tag}_seen"] = jnp.asarray(0, jnp.int32)
+            for j, t in enumerate(side.col_types):
+                st[f"{tag}_c{j}"] = jnp.zeros(C, t.device_dtype)
+        return st
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        env: ColumnEnv = dict(tape.cols)
+        E = tape.capacity
+
+        sides = {}
+        for tag, side in (("l", self.left), ("r", self.right)):
+            mask = tape.valid & (tape.stream == side.stream_code)
+            for f in side.filter_fns:
+                mask = mask & f(env)
+            mask = mask & state["enabled"]
+            order = jnp.argsort(jnp.logical_not(mask))
+            M = mask.sum()
+            C = side.window_n
+            carry = state[f"{tag}_seen"]
+            comb = {
+                key: jnp.concatenate(
+                    [state[f"{tag}_c{j}"],
+                     env[key][order].astype(state[f"{tag}_c{j}"].dtype)]
+                )
+                for j, key in enumerate(side.cols)
+            }
+            ts_comb = jnp.concatenate(
+                [state[f"{tag}_ts"], tape.ts[order]]
+            )
+            valid_comb = jnp.concatenate(
+                [state[f"{tag}_valid"], jnp.arange(E) < M]
+            )
+            # global ordinal of each combined entry (ring holds the last C)
+            ord_comb = jnp.concatenate(
+                [carry - C + jnp.arange(C, dtype=jnp.int32),
+                 carry + jnp.arange(E, dtype=jnp.int32)]
+            )
+            sides[tag] = dict(
+                side=side, mask=mask, M=M, comb=comb, ts=ts_comb,
+                valid=valid_comb, ords=ord_comb,
+                cum=carry + jnp.cumsum(mask).astype(jnp.int32),
+            )
+
+        segs = []  # (flags, ts, cols) per emission segment
+        for atag, btag in (("l", "r"), ("r", "l")):
+            segs.extend(
+                self._direction(sides[atag], sides[btag], env, tape.ts, E)
+            )
+
+        # concatenate all segments and compact into the output buffer
+        cap = JOIN_OUT_FACTOR * E
+        flags = jnp.concatenate([s[0] for s in segs])
+        ts_all = jnp.concatenate([s[1] for s in segs])
+        cols_all = tuple(
+            jnp.concatenate([s[2][i] for s in segs])
+            for i in range(len(self.proj_fns))
+        )
+        order = jnp.argsort(jnp.logical_not(flags))[:cap]
+        n = flags.sum().astype(jnp.int32)
+        out = (
+            jnp.minimum(n, cap),
+            ts_all[order],
+            tuple(c[order] for c in cols_all),
+        )
+
+        new_state = dict(state)
+        new_state["overflow"] = state["overflow"] + jnp.maximum(n - cap, 0)
+        for tag in ("l", "r"):
+            s = sides[tag]
+            C = s["side"].window_n
+            M = s["M"]
+            for j, key in enumerate(s["side"].cols):
+                new_state[f"{tag}_c{j}"] = lax.dynamic_slice(
+                    s["comb"][key], (M,), (C,)
+                )
+            new_state[f"{tag}_ts"] = lax.dynamic_slice(s["ts"], (M,), (C,))
+            new_state[f"{tag}_valid"] = lax.dynamic_slice(
+                s["valid"], (M,), (C,)
+            )
+            new_state[f"{tag}_seen"] = state[f"{tag}_seen"] + M
+        return new_state, out
+
+    def _direction(self, a, b, env: ColumnEnv, ts_i, E: int):
+        """Pairs emitted when an ``a``-side event arrives: each arriving
+        a-event (tape position i) × the b-side window as of that event.
+        Window membership is ordinal bounds: a b-entry is visible iff its
+        global ordinal is below the b-count at position i (arrival-before,
+        which also dedups in-batch pairs across the two directions) and
+        within the last-n for length windows."""
+        aside: _Side = a["side"]
+        bside: _Side = b["side"]
+        member = b["valid"][None, :] & a["mask"][:, None]
+        member = member & (b["ords"][None, :] < b["cum"][:, None])
+        if bside.window_mode == "length":
+            member = member & (
+                b["ords"][None, :] >= b["cum"][:, None] - bside.window_n
+            )
+        else:  # time window
+            member = member & (
+                b["ts"][None, :] > ts_i[:, None] - bside.time_ms
+            )
+        if self.within is not None:
+            member = member & (
+                jnp.abs(ts_i[:, None] - b["ts"][None, :]) <= self.within
+            )
+
+        pair_env: ColumnEnv = {}
+        for key in aside.cols:
+            pair_env[key] = env[key][:, None]
+        for j, key in enumerate(bside.cols):
+            pair_env[key] = b["comb"][key][None, :]
+        if self.on_fn is not None:
+            member = member & self.on_fn(pair_env)
+
+        N = member.shape[1]
+        flags = member.reshape(-1)
+        ts_mat = jnp.broadcast_to(ts_i[:, None], (E, N)).reshape(-1)
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(pair_env)), (E, N)).reshape(-1)
+            for p in self.proj_fns
+        )
+        segs = [(flags, ts_mat, cols)]
+
+        if aside.outer:
+            unmatched = a["mask"] & ~member.any(axis=1)
+            null_env: ColumnEnv = {}
+            for key in aside.cols:
+                null_env[key] = env[key]
+            for j, key in enumerate(bside.cols):
+                null_env[key] = jnp.zeros(
+                    1, b["comb"][key].dtype
+                )
+            ncols = tuple(
+                jnp.broadcast_to(jnp.asarray(p(null_env)), (E,))
+                for p in self.proj_fns
+            )
+            segs.append((unmatched, ts_i, ncols))
+        return segs
+
+
+def compile_join_query(
+    q: ast.Query,
+    name: str,
+    schemas,
+    stream_codes: Dict[str, int],
+    extensions,
+):
+    inp = q.input
+    assert isinstance(inp, ast.JoinInput)
+    li, ri = inp.left, inp.right
+    if li.stream_id == ri.stream_id:
+        raise SiddhiQLError(
+            "self-joins (same stream on both sides) are not supported yet"
+        )
+
+    scopes = {
+        li.ref_name: (li.stream_id, schemas[li.stream_id]),
+        ri.ref_name: (ri.stream_id, schemas[ri.stream_id]),
+    }
+    for si in (li, ri):
+        if si.ref_name != si.stream_id:
+            scopes.setdefault(
+                si.stream_id, (si.stream_id, schemas[si.stream_id])
+            )
+    resolver = ExprResolver(scopes, default_scope=None)
+
+    def side_of(si: ast.StreamInput, outer: bool) -> _Side:
+        sres = ExprResolver(
+            {si.ref_name: (si.stream_id, schemas[si.stream_id])},
+            default_scope=si.ref_name,
+        )
+        fns = []
+        for f in si.filters:
+            ce = compile_expr(f, sres, extensions)
+            if ce.atype != AttributeType.BOOL:
+                raise SiddhiQLError("stream filter must be boolean")
+            fns.append(ce.fn)
+        w = _window_of(si)
+        if w is None:
+            mode, n, tms = "length", JOIN_WINDOW_CAPACITY, None
+        elif w[0] == "length":
+            mode, n, tms = "length", w[1], None
+        elif w[0] == "time":
+            mode, n, tms = "time", JOIN_WINDOW_CAPACITY, w[1]
+        else:
+            raise SiddhiQLError(
+                f"window #{w[0]} is not supported on a join input "
+                "(length/time only)"
+            )
+        return _Side(
+            stream_id=si.stream_id,
+            ref=si.ref_name,
+            stream_code=stream_codes[si.stream_id],
+            filter_fns=fns,
+            window_mode=mode,
+            window_n=n,
+            time_ms=tms,
+            cols=[],
+            col_types=[],
+            outer=outer,
+        )
+
+    jt = inp.join_type
+    left = side_of(li, jt in ("left outer join", "full outer join"))
+    right = side_of(ri, jt in ("right outer join", "full outer join"))
+
+    items = q.selector.items
+    if q.selector.is_star:
+        items = tuple(
+            ast.SelectItem(ast.Attr(f, qualifier=si.ref_name), f"{si.ref_name}_{f}")
+            for si in (li, ri)
+            for f in schemas[si.stream_id].field_names
+        )
+    for item in items:
+        if ast.contains_aggregate(item.expr):
+            raise SiddhiQLError(
+                "aggregations over join outputs are not supported yet; "
+                "join into an intermediate stream and aggregate that"
+            )
+    if q.selector.group_by or q.selector.having is not None:
+        raise SiddhiQLError(
+            "group by / having on a join query is not supported yet"
+        )
+
+    # which tape columns each side must buffer in its ring
+    refs: Dict[str, AttributeType] = {}
+    for item in items:
+        _referenced_keys(item.expr, resolver, refs)
+    if inp.on is not None:
+        _referenced_keys(inp.on, resolver, refs)
+    for key, atype in sorted(refs.items()):
+        sid = key.split(".", 1)[0]
+        for side in (left, right):
+            if side.stream_id == sid:
+                side.cols.append(key)
+                side.col_types.append(atype)
+
+    on_fn = None
+    if inp.on is not None:
+        ce = compile_expr(inp.on, resolver, extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError("join 'on' condition must be boolean")
+        on_fn = ce.fn
+
+    proj_fns = []
+    out_fields = []
+    for item in items:
+        ce = compile_expr(item.expr, resolver, extensions)
+        proj_fns.append(ce.fn)
+        out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
+
+    art = JoinArtifact(
+        name=name,
+        output_schema=OutputSchema(q.output_stream, tuple(out_fields)),
+        left=left,
+        right=right,
+        on_fn=on_fn,
+        within=inp.within,
+        proj_fns=proj_fns,
+    )
+    art.encoded_columns = ()
+    return art

@@ -34,18 +34,35 @@ class CompiledPlan:
     schemas: Dict[str, StreamSchema]
     partitions: Dict[str, StreamPartition]
     source_ast: ast.ExecutionPlan
+    table_schemas: Dict[str, StreamSchema] = field(default_factory=dict)
 
     def init_state(self) -> Dict:
-        return {a.name: a.init_state() for a in self.artifacts}
+        from .table import init_table_state
+
+        states = {a.name: a.init_state() for a in self.artifacts}
+        if self.table_schemas:
+            states["@tables"] = {
+                tid: init_table_state(tid, sch)
+                for tid, sch in self.table_schemas.items()
+            }
+        return states
 
     def step(self, states: Dict, tape) -> Tuple[Dict, Dict]:
-        """Advance every query one micro-batch. Pure; jit-able."""
+        """Advance every query one micro-batch. Pure; jit-able. Tables are
+        threaded through the artifacts in query order, so later queries see
+        earlier queries' table writes (batch-granular sequencing)."""
         new_states = {}
         outputs = {}
+        tables = states.get("@tables", {})
         for a in self.artifacts:
-            s, out = a.step(states[a.name], tape)
+            if getattr(a, "uses_tables", False):
+                s, tables, out = a.step_tables(states[a.name], tables, tape)
+            else:
+                s, out = a.step(states[a.name], tape)
             new_states[a.name] = s
             outputs[a.name] = out
+        if "@tables" in states:
+            new_states["@tables"] = tables
         return new_states, outputs
 
     def grow_state(self, states: Dict) -> Dict:
@@ -102,10 +119,33 @@ def compile_plan(
         extensions = builtin_registry()
     parsed = parse_plan(plan_text)
 
+    # plan-internal DDL shares the environment's string dictionary (taken
+    # from any registered schema) so string codes are comparable across
+    # streams, tables, and query constants
+    shared_strings = None
+    for sch in schemas.values():
+        for t in sch.string_tables.values():
+            shared_strings = t
+            break
+        if shared_strings is not None:
+            break
+    if shared_strings is None:
+        from ..schema.strings import StringTable
+
+        shared_strings = StringTable()
+
     all_schemas = dict(schemas)
     for sd in parsed.stream_defs:
         if sd.stream_id not in all_schemas:
-            all_schemas[sd.stream_id] = StreamSchema(list(sd.fields))
+            all_schemas[sd.stream_id] = StreamSchema(
+                list(sd.fields), shared_strings=shared_strings
+            )
+    table_schemas = {
+        td.table_id: StreamSchema(
+            list(td.fields), shared_strings=shared_strings
+        )
+        for td in parsed.table_defs
+    }
 
     if not parsed.queries:
         raise SiddhiQLError("execution plan contains no queries")
@@ -115,6 +155,8 @@ def compile_plan(
     input_ids: List[str] = []
     for q in parsed.queries:
         for sid in q.input_stream_ids():
+            if sid in table_schemas:
+                continue  # table join side, not a stream input
             if sid not in all_schemas:
                 raise SiddhiQLError(
                     f"input stream {sid!r} is not defined or registered"
@@ -142,7 +184,9 @@ def compile_plan(
         if qname in used_names:
             raise SiddhiQLError(f"duplicate query name {qname!r}")
         used_names.add(qname)
-        art = _compile_query(q, qname, all_schemas, stream_codes, extensions)
+        art = _compile_query(
+            q, qname, all_schemas, stream_codes, extensions, table_schemas
+        )
         encoded.extend(getattr(art, "encoded_columns", ()))
         artifacts.append(art)
 
@@ -158,6 +202,7 @@ def compile_plan(
         schemas=all_schemas,
         partitions=partitions,
         source_ast=parsed,
+        table_schemas=table_schemas,
     )
 
 
@@ -167,9 +212,38 @@ def _compile_query(
     schemas: Dict[str, StreamSchema],
     stream_codes: Dict[str, int],
     extensions: ExtensionRegistry,
+    table_schemas: Optional[Dict[str, StreamSchema]] = None,
 ):
+    table_schemas = table_schemas or {}
+    if q.output_stream in table_schemas or q.output_action in (
+        "update", "delete",
+    ):
+        from .table import compile_table_write
+
+        if q.output_stream not in table_schemas:
+            raise SiddhiQLError(
+                f"{q.output_action} target {q.output_stream!r} is not a "
+                "defined table"
+            )
+        return compile_table_write(
+            q, name, schemas, table_schemas, stream_codes, extensions
+        )
     inp = q.input
+    if isinstance(inp, ast.JoinInput) and (
+        inp.left.stream_id in table_schemas
+        or inp.right.stream_id in table_schemas
+    ):
+        from .table import compile_table_join
+
+        return compile_table_join(
+            q, name, schemas, table_schemas, stream_codes, extensions
+        )
     if isinstance(inp, ast.StreamInput):
+        if inp.stream_id in table_schemas:
+            raise SiddhiQLError(
+                f"cannot read table {inp.stream_id!r} as a stream; join a "
+                "stream against it instead"
+            )
         has_agg = any(
             ast.contains_aggregate(i.expr) for i in q.selector.items
         )

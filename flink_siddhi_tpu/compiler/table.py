@@ -1,0 +1,527 @@
+"""Event tables: bounded keyed stores shared across a plan's queries.
+
+Reference surface (SURVEY.md §2.10 — siddhi-core event tables): ``define
+table T (...)``, inserting stream output into a table, updating/deleting
+table rows with an ``on`` condition, and joining a stream against a table.
+siddhi-core keeps tables as JVM collections mutated per event; here a table
+is a fixed-capacity ring of column arrays living in the plan state, threaded
+through the query artifacts in definition order so later queries observe
+earlier queries' table writes (at micro-batch granularity — the device step
+applies each query to the whole batch, which is the documented coarsening of
+the reference's per-event sequencing).
+
+All mutations are branch-free scatters: inserts append at a rolling write
+pointer (overwriting oldest on overflow), update/delete build an (E, C)
+event×row match matrix from the compiled ``on`` condition and scatter
+last-writer-wins values / clear valid bits.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..query import ast
+from ..query.lexer import SiddhiQLError
+from ..schema.stream_schema import StreamSchema
+from ..schema.types import AttributeType
+from .expr import ColumnEnv, ExprResolver, ResolvedAttr, compile_expr
+from .output import OutputField, OutputSchema
+
+TABLE_CAPACITY = 1024  # rows per table (bounded-slot policy)
+
+
+def table_key(table_id: str, field: str) -> str:
+    return f"@tbl:{table_id}.{field}"
+
+
+def init_table_state(table_id: str, schema: StreamSchema) -> Dict:
+    st = {
+        "valid": jnp.zeros(TABLE_CAPACITY, bool),
+        "ptr": jnp.asarray(0, jnp.int32),
+    }
+    for fname, ftype in zip(schema.field_names, schema.field_types):
+        st[table_key(table_id, fname)] = jnp.zeros(
+            TABLE_CAPACITY, ftype.device_dtype
+        )
+    return st
+
+
+class _TableResolver:
+    """Resolves ``T.field`` to table column keys, everything else through the
+    base stream resolver. For update/delete ``on`` conditions, bare names
+    resolve to the query's select-output columns first (Siddhi compares table
+    attrs against output attrs)."""
+
+    def __init__(self, base, table_id: str, schema: StreamSchema,
+                 out_slots: Optional[Dict[str, AttributeType]] = None):
+        self._base = base
+        self._tid = table_id
+        self._schema = schema
+        self._out = out_slots or {}
+
+    def resolve(self, attr: ast.Attr) -> ResolvedAttr:
+        if attr.qualifier == self._tid:
+            if attr.name not in self._schema:
+                raise SiddhiQLError(
+                    f"table {self._tid!r} has no attribute {attr.name!r}"
+                )
+            atype = self._schema.field_type(attr.name)
+            table = self._schema.string_tables.get(attr.name)
+            return ResolvedAttr(table_key(self._tid, attr.name), atype, table)
+        if attr.qualifier is None and attr.index is None:
+            if attr.name in self._out:
+                return ResolvedAttr(
+                    f"@out:{attr.name}", self._out[attr.name], None
+                )
+        return self._base.resolve(attr)
+
+
+def _collect_bare_names(expr: ast.Expr, out: set) -> None:
+    if isinstance(expr, ast.Attr):
+        if expr.qualifier is None:
+            out.add(expr.name)
+    elif isinstance(expr, ast.Unary):
+        _collect_bare_names(expr.operand, out)
+    elif isinstance(expr, ast.Binary):
+        _collect_bare_names(expr.left, out)
+        _collect_bare_names(expr.right, out)
+    elif isinstance(expr, ast.Call):
+        for a in expr.args:
+            _collect_bare_names(a, out)
+
+
+def _stream_front(q, schemas, stream_codes, extensions):
+    """Shared select/filter front-end over the (single) input stream."""
+    inp = q.input
+    if not isinstance(inp, ast.StreamInput):
+        raise SiddhiQLError(
+            "table insert/update/delete queries take a single stream input"
+        )
+    if inp.windows:
+        raise SiddhiQLError("windows are not supported on table writes yet")
+    ref = inp.ref_name
+    scopes = {ref: (inp.stream_id, schemas[inp.stream_id])}
+    if ref != inp.stream_id:
+        scopes[inp.stream_id] = (inp.stream_id, schemas[inp.stream_id])
+    resolver = ExprResolver(scopes, default_scope=ref)
+    filter_fns = []
+    for f in inp.filters:
+        ce = compile_expr(f, resolver, extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError("stream filter must be boolean")
+        filter_fns.append(ce.fn)
+    items = q.selector.items
+    if q.selector.is_star:
+        schema = schemas[inp.stream_id]
+        items = tuple(
+            ast.SelectItem(ast.Attr(n), None) for n in schema.field_names
+        )
+    if q.selector.group_by or q.selector.having is not None or any(
+        ast.contains_aggregate(i.expr) for i in items
+    ):
+        raise SiddhiQLError(
+            "aggregations/group by are not supported in table writes"
+        )
+    proj = []
+    for item in items:
+        ce = compile_expr(item.expr, resolver, extensions)
+        proj.append((item.output_name(), ce))
+    return inp, resolver, filter_fns, proj
+
+
+def _masked(tape, stream_code, filter_fns, enabled, env):
+    mask = tape.valid & (tape.stream == stream_code)
+    for f in filter_fns:
+        mask = mask & f(env)
+    return mask & enabled
+
+
+@dataclass
+class TableInsertArtifact:
+    """``from S select ... insert into T`` — appends projected rows."""
+
+    name: str
+    output_schema: OutputSchema  # degenerate: no stream output
+    table_id: str
+    col_names: List[str]
+    stream_code: int
+    filter_fns: List[Callable]
+    proj_fns: List[Callable]
+    uses_tables: bool = True
+    output_mode: str = "buffered"
+
+    def init_state(self) -> Dict:
+        return {"enabled": jnp.asarray(True),
+                "overflow": jnp.asarray(0, jnp.int32)}
+
+    def step_tables(self, state, tables, tape):
+        env: ColumnEnv = dict(tape.cols)
+        mask = _masked(
+            tape, self.stream_code, self.filter_fns, state["enabled"], env
+        )
+        E = tape.capacity
+        tbl = dict(tables[self.table_id])
+        C = tbl["valid"].shape[0]
+        rank = jnp.cumsum(mask) - 1
+        M = mask.sum()
+        # if one batch inserts more than C rows, only the newest C land
+        # (ring semantics); clamping also keeps scatter indices unique,
+        # since XLA scatter order for duplicates is unspecified
+        keep = mask & (rank >= M - C)
+        pos = jnp.where(keep, (tbl["ptr"] + rank) % C, C)  # C -> dropped
+        for cname, p in zip(self.col_names, self.proj_fns):
+            key = table_key(self.table_id, cname)
+            vals = jnp.broadcast_to(jnp.asarray(p(env)), (E,))
+            tbl[key] = tbl[key].at[pos].set(
+                vals.astype(tbl[key].dtype), mode="drop"
+            )
+        tbl["valid"] = tbl["valid"].at[pos].set(True, mode="drop")
+        tbl["ptr"] = (tbl["ptr"] + M) % C
+        new_state = dict(state)
+        new_state["overflow"] = state["overflow"] + jnp.maximum(M - C, 0)
+        state = new_state
+        new_tables = dict(tables)
+        new_tables[self.table_id] = tbl
+        empty = (
+            jnp.asarray(0, jnp.int32),
+            jnp.zeros(1, jnp.int32),
+            tuple(jnp.zeros(1, f.atype.device_dtype)
+                  for f in self.output_schema.fields),
+        )
+        return state, new_tables, empty
+
+
+@dataclass
+class TableMutateArtifact:
+    """``update T on <cond>`` / ``delete T on <cond>``: (E, C) match matrix,
+    last matching event wins for updates."""
+
+    name: str
+    output_schema: OutputSchema
+    table_id: str
+    action: str  # 'update' | 'delete'
+    col_names: List[str]  # update targets (match table fields by name)
+    stream_code: int
+    filter_fns: List[Callable]
+    proj_fns: List[Callable]
+    on_fn: Callable
+    uses_tables: bool = True
+    output_mode: str = "buffered"
+
+    def init_state(self) -> Dict:
+        return {"enabled": jnp.asarray(True)}
+
+    def step_tables(self, state, tables, tape):
+        env: ColumnEnv = dict(tape.cols)
+        mask = _masked(
+            tape, self.stream_code, self.filter_fns, state["enabled"], env
+        )
+        E = tape.capacity
+        tbl = dict(tables[self.table_id])
+        C = tbl["valid"].shape[0]
+
+        pair_env: ColumnEnv = {}
+        out_vals = {}
+        for cname, p in zip(self.col_names, self.proj_fns):
+            v = jnp.broadcast_to(jnp.asarray(p(env)), (E,))
+            out_vals[cname] = v
+            pair_env[f"@out:{cname}"] = v[:, None]
+        for k, v in env.items():
+            pair_env[k] = v[:, None]
+        for k, v in tbl.items():
+            if k.startswith("@tbl:"):
+                pair_env[k] = v[None, :]
+        match = (
+            mask[:, None] & tbl["valid"][None, :] & self.on_fn(pair_env)
+        )  # (E, C)
+
+        if self.action == "delete":
+            tbl["valid"] = tbl["valid"] & ~match.any(axis=0)
+        else:
+            hit = match.any(axis=0)
+            # last matching event per row wins
+            last_i = (E - 1) - jnp.argmax(match[::-1, :], axis=0)
+            for cname in self.col_names:
+                key = table_key(self.table_id, cname)
+                if key in tbl:
+                    vals = out_vals[cname][last_i]
+                    tbl[key] = jnp.where(
+                        hit, vals.astype(tbl[key].dtype), tbl[key]
+                    )
+        new_tables = dict(tables)
+        new_tables[self.table_id] = tbl
+        empty = (
+            jnp.asarray(0, jnp.int32),
+            jnp.zeros(1, jnp.int32),
+            tuple(jnp.zeros(1, f.atype.device_dtype)
+                  for f in self.output_schema.fields),
+        )
+        return state, new_tables, empty
+
+
+@dataclass
+class TableJoinArtifact:
+    """``from S join T on <cond> select ... insert into Out``: stream
+    events × current table rows."""
+
+    name: str
+    output_schema: OutputSchema
+    table_id: str
+    stream_code: int
+    filter_fns: List[Callable]
+    on_fn: Optional[Callable]
+    proj_fns: List[Callable]
+    outer: bool  # left outer (stream side preserved)
+    table_col_keys: List[str]
+    uses_tables: bool = True
+    output_mode: str = "buffered"
+
+    def init_state(self) -> Dict:
+        return {"enabled": jnp.asarray(True),
+                "overflow": jnp.asarray(0, jnp.int32)}
+
+    def step_tables(self, state, tables, tape):
+        env: ColumnEnv = dict(tape.cols)
+        mask = _masked(
+            tape, self.stream_code, self.filter_fns, state["enabled"], env
+        )
+        E = tape.capacity
+        tbl = tables[self.table_id]
+        C = tbl["valid"].shape[0]
+
+        pair_env: ColumnEnv = {k: v[:, None] for k, v in env.items()}
+        for k in self.table_col_keys:
+            pair_env[k] = tbl[k][None, :]
+        member = mask[:, None] & tbl["valid"][None, :]
+        if self.on_fn is not None:
+            member = member & self.on_fn(pair_env)
+
+        flags = member.reshape(-1)
+        ts_mat = jnp.broadcast_to(tape.ts[:, None], (E, C)).reshape(-1)
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(pair_env)), (E, C)).reshape(-1)
+            for p in self.proj_fns
+        )
+        seg_flags, seg_ts, seg_cols = [flags], [ts_mat], [cols]
+        if self.outer:
+            unmatched = mask & ~member.any(axis=1)
+            null_env: ColumnEnv = dict(env)
+            for k in self.table_col_keys:
+                null_env[k] = jnp.zeros(1, tbl[k].dtype)
+            ncols = tuple(
+                jnp.broadcast_to(jnp.asarray(p(null_env)), (E,))
+                for p in self.proj_fns
+            )
+            seg_flags.append(unmatched)
+            seg_ts.append(tape.ts)
+            seg_cols.append(ncols)
+
+        all_flags = jnp.concatenate(seg_flags)
+        all_ts = jnp.concatenate(seg_ts)
+        all_cols = tuple(
+            jnp.concatenate([sc[i] for sc in seg_cols])
+            for i in range(len(self.proj_fns))
+        )
+        cap = 4 * E
+        order = jnp.argsort(jnp.logical_not(all_flags))[:cap]
+        n = all_flags.sum().astype(jnp.int32)
+        new_state = dict(state)
+        new_state["overflow"] = state["overflow"] + jnp.maximum(n - cap, 0)
+        out = (
+            jnp.minimum(n, cap),
+            all_ts[order],
+            tuple(c[order] for c in all_cols),
+        )
+        return new_state, tables, out
+
+
+# --------------------------------------------------------------------------
+# compile entry points (called from plan.py)
+# --------------------------------------------------------------------------
+
+def compile_table_write(
+    q: ast.Query,
+    name: str,
+    schemas: Dict[str, StreamSchema],
+    table_schemas: Dict[str, StreamSchema],
+    stream_codes: Dict[str, int],
+    extensions,
+):
+    tid = q.output_stream
+    tschema = table_schemas[tid]
+    inp, resolver, filter_fns, proj = _stream_front(
+        q, schemas, stream_codes, extensions
+    )
+    sc = stream_codes[inp.stream_id]
+    empty_schema = OutputSchema(f"@void:{name}", ())
+
+    if q.output_action == "insert":
+        for cname, ce in proj:
+            if cname not in tschema:
+                raise SiddhiQLError(
+                    f"table {tid!r} has no column {cname!r}"
+                )
+        return TableInsertArtifact(
+            name=name,
+            output_schema=empty_schema,
+            table_id=tid,
+            col_names=[c for c, _ in proj],
+            stream_code=sc,
+            filter_fns=filter_fns,
+            proj_fns=[ce.fn for _, ce in proj],
+        )
+
+    if q.on_condition is None:
+        raise SiddhiQLError(
+            f"{q.output_action} {tid} requires an 'on' condition"
+        )
+    # every select output must either write a table column or feed the on
+    # condition — anything else is almost certainly a typo (the insert path
+    # validates strictly, so keep the paths symmetric)
+    on_names = set()
+    _collect_bare_names(q.on_condition, on_names)
+    for cname, _ in proj:
+        if cname not in tschema and cname not in on_names:
+            raise SiddhiQLError(
+                f"table {tid!r} has no column {cname!r} and the "
+                f"{q.output_action} 'on' condition does not reference it"
+            )
+    out_slots = {c: ce.atype for c, ce in proj}
+    tres = _TableResolver(resolver, tid, tschema, out_slots)
+    on_ce = compile_expr(q.on_condition, tres, extensions)
+    if on_ce.atype != AttributeType.BOOL:
+        raise SiddhiQLError("'on' condition must be boolean")
+    return TableMutateArtifact(
+        name=name,
+        output_schema=empty_schema,
+        table_id=tid,
+        action=q.output_action,
+        col_names=[c for c, _ in proj],
+        stream_code=sc,
+        filter_fns=filter_fns,
+        proj_fns=[ce.fn for _, ce in proj],
+        on_fn=on_ce.fn,
+    )
+
+
+def compile_table_join(
+    q: ast.Query,
+    name: str,
+    schemas: Dict[str, StreamSchema],
+    table_schemas: Dict[str, StreamSchema],
+    stream_codes: Dict[str, int],
+    extensions,
+):
+    inp = q.input
+    assert isinstance(inp, ast.JoinInput)
+    if inp.left.stream_id in table_schemas:
+        tside, sside = inp.left, inp.right
+        stream_outer = inp.join_type == "right outer join"
+        table_outer = inp.join_type in (
+            "left outer join", "full outer join",
+        )
+    else:
+        tside, sside = inp.right, inp.left
+        stream_outer = inp.join_type == "left outer join"
+        table_outer = inp.join_type in (
+            "right outer join", "full outer join",
+        )
+    if table_outer:
+        raise SiddhiQLError(
+            "outer join preserving the table side is not supported "
+            "(tables have no arrival events to emit unmatched rows on)"
+        )
+    if sside.stream_id in table_schemas:
+        raise SiddhiQLError("table-table joins are not supported")
+    if tside.windows:
+        raise SiddhiQLError("windows are not valid on a table join side")
+    tid = tside.stream_id
+    tschema = table_schemas[tid]
+
+    ref = sside.ref_name
+    scopes = {ref: (sside.stream_id, schemas[sside.stream_id])}
+    if ref != sside.stream_id:
+        scopes[sside.stream_id] = (
+            sside.stream_id, schemas[sside.stream_id],
+        )
+    base = ExprResolver(scopes, default_scope=ref)
+
+    class _JoinResolver:
+        """T.field / alias.field -> table cols; rest -> stream."""
+
+        def resolve(self, attr: ast.Attr) -> ResolvedAttr:
+            if attr.qualifier in (tid, tside.ref_name):
+                if attr.name not in tschema:
+                    raise SiddhiQLError(
+                        f"table {tid!r} has no attribute {attr.name!r}"
+                    )
+                return ResolvedAttr(
+                    table_key(tid, attr.name),
+                    tschema.field_type(attr.name),
+                    tschema.string_tables.get(attr.name),
+                )
+            try:
+                return base.resolve(attr)
+            except SiddhiQLError:
+                if attr.qualifier is None and attr.name in tschema:
+                    return ResolvedAttr(
+                        table_key(tid, attr.name),
+                        tschema.field_type(attr.name),
+                        tschema.string_tables.get(attr.name),
+                    )
+                raise
+
+    resolver = _JoinResolver()
+    filter_fns = []
+    for f in sside.filters:
+        ce = compile_expr(f, base, extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError("stream filter must be boolean")
+        filter_fns.append(ce.fn)
+
+    on_fn = None
+    if inp.on is not None:
+        ce = compile_expr(inp.on, resolver, extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError("join 'on' condition must be boolean")
+        on_fn = ce.fn
+
+    items = q.selector.items
+    if q.selector.is_star:
+        items = tuple(
+            ast.SelectItem(
+                ast.Attr(f, qualifier=sside.ref_name), f"{sside.ref_name}_{f}"
+            )
+            for f in schemas[sside.stream_id].field_names
+        ) + tuple(
+            ast.SelectItem(
+                ast.Attr(f, qualifier=tside.ref_name), f"{tside.ref_name}_{f}"
+            )
+            for f in tschema.field_names
+        )
+    proj_fns, out_fields = [], []
+    for item in items:
+        if ast.contains_aggregate(item.expr):
+            raise SiddhiQLError(
+                "aggregations over table joins are not supported yet"
+            )
+        ce = compile_expr(item.expr, resolver, extensions)
+        proj_fns.append(ce.fn)
+        out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
+
+    return TableJoinArtifact(
+        name=name,
+        output_schema=OutputSchema(q.output_stream, tuple(out_fields)),
+        table_id=tid,
+        stream_code=stream_codes[sside.stream_id],
+        filter_fns=filter_fns,
+        on_fn=on_fn,
+        proj_fns=proj_fns,
+        outer=stream_outer,
+        table_col_keys=[
+            table_key(tid, f) for f in tschema.field_names
+        ],
+    )

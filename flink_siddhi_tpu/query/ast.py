@@ -214,6 +214,8 @@ class Query:
     output_stream: str
     output_action: str = "insert"  # insert | update | delete (tables)
     name: Optional[str] = None  # @info(name='...')
+    # update/delete row-match condition: ``update T on T.x == x``
+    on_condition: Optional[Expr] = None
 
     def input_stream_ids(self) -> Tuple[str, ...]:
         inp = self.input

@@ -154,8 +154,8 @@ def _parse_query(ts: TokenStream, name: Optional[str] = None) -> ast.Query:
     ts.expect_keyword("from")
     input_clause = _parse_input(ts)
     selector = _parse_selector(ts)
-    action, out = _parse_output(ts)
-    return ast.Query(input_clause, selector, out, action, name)
+    action, out, on = _parse_output(ts)
+    return ast.Query(input_clause, selector, out, action, name, on)
 
 
 # --------------------------------------------------------------------------
@@ -371,7 +371,10 @@ def _parse_output(ts: TokenStream) -> Tuple[str, str]:
         ts.error(f"expected 'insert into', found {ts.current.text!r}")
         raise AssertionError  # unreachable
     target = ts.expect_id().text
-    return action, target
+    on = None
+    if action in ("update", "delete") and ts.accept_keyword("on"):
+        on = _parse_expr(ts)
+    return action, target, on
 
 
 # --------------------------------------------------------------------------
@@ -455,6 +458,9 @@ def _parse_time_duration(ts: TokenStream) -> int:
         total += int(value * _TIME_UNITS_MS[unit])
         seen = True
     if not seen:
+        # bare integer = milliseconds (Siddhi accepts plain ms constants)
+        if ts.current.kind == "INT":
+            return int(ts.advance().text.rstrip("lL"))
         ts.error("expected a time duration (e.g. '5 sec')")
     return total
 

@@ -1,0 +1,128 @@
+"""Event tables end-to-end: define table, insert, stream-table join,
+update/delete with on-conditions (siddhi-core event-table surface,
+SURVEY.md §2.10)."""
+
+import dataclasses
+
+import pytest
+
+from flink_siddhi_tpu import CEPEnvironment, SiddhiCEP
+
+
+@dataclasses.dataclass
+class Event:
+    id: int
+    kind: int
+    price: float
+    timestamp: int
+
+
+FIELDS = ["id", "kind", "price", "timestamp"]
+
+
+def run(events, cql, out="out", batch_size=4096):
+    env = CEPEnvironment(batch_size=batch_size)
+    return (
+        SiddhiCEP.define("S", events, FIELDS, env=env)
+        .cql(cql)
+        .returns(out)
+    )
+
+
+def test_insert_then_join():
+    # kind==0 events populate the table; kind==1 events look up by id
+    events = [
+        Event(1, 0, 10.0, 1000),
+        Event(2, 0, 20.0, 2000),
+        Event(1, 1, 0.0, 3000),
+        Event(2, 1, 0.0, 4000),
+        Event(3, 1, 0.0, 5000),  # no table row -> no output
+    ]
+    out = run(
+        events,
+        "define table T (tid int, tprice double);"
+        "from S[kind == 0] select id as tid, price as tprice insert into T;"
+        "from S[kind == 1] join T on S.id == T.tid "
+        "select S.id, T.tprice insert into out",
+    )
+    assert sorted(out) == [(1, 10.0), (2, 20.0)]
+
+
+def test_join_sees_same_batch_inserts():
+    # batch-granular sequencing: inserts from query 1 are visible to the
+    # join in the same micro-batch
+    events = [Event(5, 0, 55.0, 1000), Event(5, 1, 0.0, 2000)]
+    out = run(
+        events,
+        "define table T (tid int, tprice double);"
+        "from S[kind == 0] select id as tid, price as tprice insert into T;"
+        "from S[kind == 1] join T on S.id == T.tid "
+        "select T.tprice insert into out",
+    )
+    assert out == [(55.0,)]
+
+
+def test_update_on_condition():
+    events = [
+        Event(1, 0, 10.0, 1000),  # insert id=1 price=10
+        Event(1, 2, 99.0, 2000),  # update id=1 -> price=99
+        Event(1, 1, 0.0, 3000),  # lookup
+    ]
+    out = run(
+        events,
+        "define table T (tid int, tprice double);"
+        "from S[kind == 0] select id as tid, price as tprice insert into T;"
+        "from S[kind == 2] select id as tid, price as tprice "
+        "update T on T.tid == tid;"
+        "from S[kind == 1] join T on S.id == T.tid "
+        "select T.tprice insert into out",
+        batch_size=1,
+    )
+    assert out == [(99.0,)]
+
+
+def test_delete_on_condition():
+    events = [
+        Event(1, 0, 10.0, 1000),
+        Event(2, 0, 20.0, 2000),
+        Event(1, 3, 0.0, 3000),  # delete id=1
+        Event(1, 1, 0.0, 4000),  # lookup id=1 -> gone
+        Event(2, 1, 0.0, 5000),  # lookup id=2 -> present
+    ]
+    out = run(
+        events,
+        "define table T (tid int, tprice double);"
+        "from S[kind == 0] select id as tid, price as tprice insert into T;"
+        "from S[kind == 3] select id as tid delete T on T.tid == tid;"
+        "from S[kind == 1] join T on S.id == T.tid "
+        "select S.id, T.tprice insert into out",
+        batch_size=1,
+    )
+    assert out == [(2, 20.0)]
+
+
+def test_left_outer_table_join():
+    events = [
+        Event(1, 0, 10.0, 1000),
+        Event(1, 1, 0.0, 2000),
+        Event(9, 1, 0.0, 3000),  # no row -> zero-filled table side
+    ]
+    out = run(
+        events,
+        "define table T (tid int, tprice double);"
+        "from S[kind == 0] select id as tid, price as tprice insert into T;"
+        "from S[kind == 1] left outer join T on S.id == T.tid "
+        "select S.id, T.tprice insert into out",
+    )
+    assert sorted(out) == [(1, 10.0), (9, 0.0)]
+
+
+def test_select_from_table_rejected():
+    from flink_siddhi_tpu.query.lexer import SiddhiQLError
+
+    with pytest.raises(SiddhiQLError):
+        run(
+            [Event(1, 0, 1.0, 1000)],
+            "define table T (tid int);"
+            "from T select tid insert into out",
+        )
