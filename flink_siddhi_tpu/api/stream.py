@@ -34,8 +34,21 @@ class _StreamBase:
         self.env = env
         self.stream_ids = list(stream_ids)
 
-    def cql(self, plan_text: str, plan_id: str = "plan") -> "ExecutionStream":
-        return ExecutionStream(self.env, self.stream_ids, plan_text, plan_id)
+    def cql(self, plan_or_control, plan_id: str = "plan"):
+        """Static path: ``cql("from ... insert into ...")`` binds one plan
+        (ExecutableStream.cql(String), SiddhiStream.java:116-119).
+
+        Dynamic path: ``cql(control_events)`` — a list of (ts, ControlEvent)
+        pairs / ControlEvents, or a ControlListSource — starts with zero
+        plans and manages them at runtime (cql(DataStream<ControlEvent>),
+        SiddhiStream.java:126-140)."""
+        if isinstance(plan_or_control, str):
+            return ExecutionStream(
+                self.env, self.stream_ids, plan_or_control, plan_id
+            )
+        return DynamicExecutionStream(
+            self.env, self.stream_ids, plan_or_control
+        )
 
 
 class SingleStream(_StreamBase):
@@ -145,3 +158,59 @@ class ExecutionStream:
         raise KeyError(
             f"plan has no query inserting into {output_stream!r}"
         )
+
+
+class DynamicExecutionStream(ExecutionStream):
+    """Control-plane-managed execution: plans are added/updated/removed/
+    paused/resumed by control events instead of a static CQL string."""
+
+    def __init__(self, env, stream_ids, control):
+        from ..runtime.sources import ControlListSource
+
+        self.env = env
+        self.stream_ids = list(stream_ids)
+        self.plan_text = None
+        self.plan = None
+        if not isinstance(control, ControlListSource) and not hasattr(
+            control, "poll"
+        ):
+            control = ControlListSource(control)
+        self._control = control
+        self._job: Optional[Job] = None
+
+    def _compile(self, cql: str, plan_id: str) -> CompiledPlan:
+        return compile_plan(
+            cql,
+            {
+                sid: self.env.get_schema(sid)
+                for sid in self.stream_ids
+            },
+            extensions=self.env.extensions,
+            plan_id=plan_id,
+        )
+
+    @property
+    def job(self) -> Job:
+        if self._job is None:
+            self._job = Job(
+                [],
+                [self.env.sources[sid] for sid in self.stream_ids],
+                batch_size=self.env.batch_size,
+                time_mode=self.env.time_mode,
+                control_sources=[self._control],
+                plan_compiler=self._compile,
+            )
+        return self._job
+
+    def _fields(self, output_stream: str) -> List[str]:
+        # output schemas only exist once control events installed plans
+        fields = self.job.output_fields.get(output_stream)
+        if fields is None:
+            for rt in self.job._plans.values():
+                for a in rt.plan.artifacts:
+                    if a.output_schema.stream_id == output_stream:
+                        return a.output_schema.field_names
+            raise KeyError(
+                f"no runtime query inserts into {output_stream!r}"
+            )
+        return fields

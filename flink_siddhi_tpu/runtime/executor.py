@@ -46,6 +46,8 @@ class Job:
         sources: Sequence[Source],
         batch_size: int = 4096,
         time_mode: str = "event",  # 'event' | 'processing'
+        control_sources: Sequence = (),
+        plan_compiler: Optional[Callable] = None,  # (cql, plan_id) -> plan
     ) -> None:
         if time_mode not in ("event", "processing"):
             raise ValueError(time_mode)
@@ -54,6 +56,11 @@ class Job:
         self._sources = list(sources)
         self._source_wm: List[int] = [-(2**62)] * len(self._sources)
         self._source_done: List[bool] = [False] * len(self._sources)
+        self._control = list(control_sources)
+        self._control_wm: List[int] = [-(2**62)] * len(self._control)
+        self._control_done: List[bool] = [False] * len(self._control)
+        self._control_pending: List[Tuple[int, object]] = []
+        self._plan_compiler = plan_compiler
         # reorder buffer: stream_id -> pending EventBatches (event time)
         self._pending: Dict[str, List[EventBatch]] = {}
         self._epoch_ms: Optional[int] = None
@@ -67,6 +74,9 @@ class Job:
         self.processed_events = 0  # observability (reference logs per runtime)
 
     # -- plan management (dynamic control plane hooks) ----------------------
+    # Parity: AbstractSiddhiOperator.onEventReceived (:399-467) — add/update/
+    # remove QueryRuntimeHandlers, enable/disable gating — applied here at
+    # micro-batch boundaries.
     def add_plan(self, plan: CompiledPlan) -> None:
         self._plans[plan.plan_id] = _PlanRuntime(
             plan=plan,
@@ -76,6 +86,41 @@ class Job:
 
     def remove_plan(self, plan_id: str) -> None:
         self._plans.pop(plan_id, None)
+
+    def set_plan_enabled(self, plan_id: str, enabled: bool) -> None:
+        rt = self._plans.get(plan_id)
+        if rt is not None:
+            rt.enabled = enabled
+
+    @property
+    def plan_ids(self) -> List[str]:
+        return list(self._plans)
+
+    def _apply_control(self, ev) -> None:
+        from ..control.events import (
+            MetadataControlEvent,
+            OperationControlEvent,
+        )
+
+        if isinstance(ev, MetadataControlEvent):
+            if (
+                ev.added_plans or ev.updated_plans
+            ) and self._plan_compiler is None:
+                raise RuntimeError(
+                    "control event adds a plan but the job has no plan "
+                    "compiler (create it through the dynamic cql() path)"
+                )
+            for plan_id, cql in ev.added_plans.items():
+                self.add_plan(self._plan_compiler(cql, plan_id))
+            for plan_id, cql in ev.updated_plans.items():
+                self.remove_plan(plan_id)
+                self.add_plan(self._plan_compiler(cql, plan_id))
+            for plan_id in ev.deleted_plan_ids:
+                self.remove_plan(plan_id)
+        elif isinstance(ev, OperationControlEvent):
+            self.set_plan_enabled(ev.plan_id, ev.action == "enable")
+        else:
+            raise TypeError(f"unknown control event {type(ev)!r}")
 
     def add_sink(self, output_stream: str, fn: Callable) -> None:
         self._sinks.setdefault(output_stream, []).append(fn)
@@ -103,13 +148,20 @@ class Job:
 
     @property
     def finished(self) -> bool:
-        return all(self._source_done) and not any(
-            batches for batches in self._pending.values()
+        return (
+            all(self._source_done)
+            and all(self._control_done)
+            and not any(batches for batches in self._pending.values())
+            and not self._control_pending
         )
 
     def run_cycle(self) -> int:
-        """Pull, reorder, step, decode. Returns events processed."""
+        """Pull, apply control, reorder, step, decode. Returns events
+        processed. Control events take effect at micro-batch boundaries
+        (the reference applies them per event; §3.4)."""
         self._pull_sources()
+        self._pull_control()
+        self._apply_ready_control()
         ready = self._release_ready()
         if not ready:
             return 0
@@ -118,8 +170,36 @@ class Job:
         if self._epoch_ms is None:
             self._epoch_ms = min(int(b.timestamps.min()) for b in ready)
         for rt in list(self._plans.values()):
-            self._step_plan(rt, ready)
+            if rt.enabled:
+                self._step_plan(rt, ready)
         return total
+
+    def _pull_control(self) -> None:
+        for i, src in enumerate(self._control):
+            if self._control_done[i]:
+                continue
+            events, wm, done = src.poll(self.batch_size)
+            self._control_pending.extend(events)
+            if wm is not None:
+                self._control_wm[i] = max(self._control_wm[i], wm)
+            if done:
+                self._control_done[i] = True
+                self._control_wm[i] = MAX_WM
+
+    def _apply_ready_control(self) -> None:
+        if not self._control_pending:
+            return
+        wm = self._watermark()
+        self._control_pending.sort(key=lambda p: p[0])
+        while self._control_pending and (
+            self.time_mode == "processing" or self._control_pending[0][0] <= wm
+        ):
+            _, ev = self._control_pending.pop(0)
+            self._apply_control(ev)
+
+    def _watermark(self) -> int:
+        wms = self._source_wm + self._control_wm
+        return min(wms) if wms else MAX_WM
 
     def _pull_sources(self) -> None:
         for i, src in enumerate(self._sources):
@@ -145,7 +225,7 @@ class Job:
             ]
             self._pending.clear()
             return ready
-        wm = min(self._source_wm) if self._source_wm else MAX_WM
+        wm = self._watermark()
         ready: List[EventBatch] = []
         for sid in list(self._pending):
             merged = EventBatch.concat(self._pending[sid]).sort_by_time()

@@ -109,6 +109,35 @@ class BatchSource(Source):
         return batch, wm, False
 
 
+class ControlListSource:
+    """Replays timestamped control events (the control-topic analog of the
+    reference's dynamic path, SiddhiStream.java:126-140: control events ride
+    a broadcast stream interleaved with data by event time).
+
+    ``events``: iterable of ``(timestamp_ms, ControlEvent)`` pairs, or bare
+    ControlEvents (timestamped by their ``created_ms``)."""
+
+    def __init__(self, events) -> None:
+        pairs = []
+        for e in events:
+            if isinstance(e, tuple):
+                pairs.append((int(e[0]), e[1]))
+            else:
+                pairs.append((int(e.created_ms), e))
+        self._events = sorted(pairs, key=lambda p: p[0])
+        self._pos = 0
+
+    def poll(self, max_events: int):
+        """Return (list[(ts, event)], watermark_ms, done)."""
+        if self._pos >= len(self._events):
+            return [], np.iinfo(np.int64).max, True
+        take = self._events[self._pos : self._pos + max_events]
+        self._pos += len(take)
+        done = self._pos >= len(self._events)
+        wm = np.iinfo(np.int64).max if done else take[-1][0]
+        return take, wm, done
+
+
 class CallbackSource(Source):
     """Push-style adapter: user code calls ``emit``; the executor drains."""
 

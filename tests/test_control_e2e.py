@@ -1,0 +1,137 @@
+"""Dynamic control plane end-to-end (SiddhiCEPITCase.java:466-533 analog:
+plans added/updated/removed/enabled/disabled at runtime via control events
+interleaved with data by event time)."""
+
+import dataclasses
+
+from flink_siddhi_tpu import (
+    CEPEnvironment,
+    MetadataControlEvent,
+    OperationControlEvent,
+    SiddhiCEP,
+)
+
+
+@dataclasses.dataclass
+class Event:
+    id: int
+    price: float
+    timestamp: int
+
+
+FIELDS = ["id", "price", "timestamp"]
+
+
+def make_events(n, start_ts=1000):
+    return [Event(i % 4, float(i), start_ts + 1000 * i) for i in range(n)]
+
+
+def dyn(events, control, batch_size=4096):
+    env = CEPEnvironment(batch_size=batch_size)
+    return SiddhiCEP.define("S", events, FIELDS, env=env).cql(control)
+
+
+def test_add_plan_mid_stream():
+    # plan installed at ts 5500: only events with ts > 5500 are processed
+    events = make_events(10)  # ts 1000..10000
+    ev = MetadataControlEvent.builder()
+    ev.add_execution_plan("from S select id, price insert into out")
+    es = dyn(events, [(5500, ev.build())], batch_size=1)
+    out = es.returns("out")
+    assert out == [(e.id, e.price) for e in events if e.timestamp > 5500]
+
+
+def test_multiple_plans_fan_out():
+    # two plans over the same stream: every event fans out to both
+    events = make_events(8)
+    b = MetadataControlEvent.builder()
+    b.add_execution_plan("from S[id == 1] select id insert into ones")
+    b.add_execution_plan("from S[id == 2] select id insert into twos")
+    es = dyn(events, [(0, b.build())])
+    job = es.execute()
+    assert len(job.results("ones")) == 2
+    assert len(job.results("twos")) == 2
+
+
+def test_disable_enable_query():
+    events = make_events(10)  # ts 1000..10000
+    b = MetadataControlEvent.builder()
+    pid = b.add_execution_plan("from S select id insert into out")
+    control = [
+        (0, b.build()),
+        (4500, OperationControlEvent.disable_query(pid)),
+        (7500, OperationControlEvent.enable_query(pid)),
+    ]
+    es = dyn(events, control, batch_size=1)
+    out = es.returns("out")
+    # events in (4500, 7500] are dropped while the plan is paused
+    expected = [
+        (e.id,)
+        for e in events
+        if e.timestamp <= 4500 or e.timestamp > 7500
+    ]
+    assert out == expected
+
+
+def test_remove_plan():
+    events = make_events(10)
+    b = MetadataControlEvent.builder()
+    pid = b.add_execution_plan("from S select id insert into out")
+    drop = MetadataControlEvent.builder()
+    drop.remove_execution_plan(pid)
+    es = dyn(events, [(0, b.build()), (5500, drop.build())], batch_size=1)
+    out = es.returns("out")
+    assert out == [(e.id,) for e in events if e.timestamp <= 5500]
+
+
+def test_update_plan():
+    events = make_events(10)
+    b = MetadataControlEvent.builder()
+    pid = b.add_execution_plan("from S[id == 1] select id insert into out")
+    upd = (
+        MetadataControlEvent.builder()
+        .update_execution_plan(
+            pid, "from S[id == 2] select id insert into out"
+        )
+        .build()
+    )
+    es = dyn(events, [(0, b.build()), (5500, upd)], batch_size=1)
+    out = es.returns("out")
+    expected = [
+        (e.id,)
+        for e in events
+        if (e.timestamp <= 5500 and e.id == 1)
+        or (e.timestamp > 5500 and e.id == 2)
+    ]
+    assert out == expected
+
+
+def test_dynamic_pattern_plan():
+    # the ITCase dynamic test installs pattern queries at runtime
+    events = [Event(2, 1.0, 1000), Event(3, 2.0, 2000), Event(2, 3.0, 3000),
+              Event(3, 4.0, 4000)]
+    b = MetadataControlEvent.builder()
+    b.add_execution_plan(
+        "from every s1 = S[id == 2] -> s2 = S[id == 3] "
+        "select s1.price as p1, s2.price as p2 insert into outputStream"
+    )
+    es = dyn(events, [(0, b.build())])
+    out = es.return_as_map("outputStream")
+    assert out == [{"p1": 1.0, "p2": 2.0}, {"p1": 3.0, "p2": 4.0}]
+
+
+def test_control_json_round_trip():
+    from flink_siddhi_tpu.control.events import (
+        control_event_from_json,
+        control_event_to_json,
+    )
+
+    b = MetadataControlEvent.builder()
+    pid = b.add_execution_plan("from S select id insert into out")
+    ev = b.build()
+    ev2 = control_event_from_json(control_event_to_json(ev))
+    assert ev2.added_plans == {pid: "from S select id insert into out"}
+
+    op = OperationControlEvent.disable_query("abc")
+    op2 = control_event_from_json(control_event_to_json(op))
+    assert (op2.action, op2.plan_id) == ("disable", "abc")
