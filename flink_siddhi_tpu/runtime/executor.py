@@ -286,6 +286,26 @@ class Job:
                 for sink in self._sinks.get(sid, ()):
                     sink(abs_ts, row)
 
+    # -- checkpoint / restore (exceeds the reference: restore of engine
+    # state was an abandoned TODO there, AbstractSiddhiOperator.java:341) --
+    def snapshot(self) -> Dict:
+        from .checkpoint import snapshot_job
+
+        return snapshot_job(self)
+
+    def save_checkpoint(self, path: str) -> None:
+        from .checkpoint import save
+
+        save(self, path)
+
+    def restore(self, snapshot_or_path) -> None:
+        from .checkpoint import load, restore_job
+
+        if isinstance(snapshot_or_path, str):
+            load(self, snapshot_or_path)
+        else:
+            restore_job(self, snapshot_or_path)
+
     # -- results -------------------------------------------------------------
     def results(self, output_stream: str) -> List[Tuple]:
         return [row for _, row in self.collected.get(output_stream, [])]

@@ -81,6 +81,13 @@ class ListSource(Source):
         wm = np.iinfo(np.int64).max if done else max(self._ts[lo:hi])
         return batch, wm, done
 
+    # -- checkpoint support -------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"pos": self._pos}
+
+    def load_state_dict(self, d: dict) -> None:
+        self._pos = int(d["pos"])
+
 
 class BatchSource(Source):
     """Wraps an iterator of prebuilt EventBatches (the native-ingest path and

@@ -63,6 +63,13 @@ class StringTable:
     @classmethod
     def from_state_dict(cls, state: dict) -> "StringTable":
         t = cls()
-        for v in state["values"]:
-            t.intern(v)
+        t.load_state_dict(state)
         return t
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore in place (the shared dictionary object is referenced by
+        every schema of an environment, so identity must be preserved)."""
+        self._codes.clear()
+        self._values.clear()
+        for v in state["values"]:
+            self.intern(v)
