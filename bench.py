@@ -1,0 +1,169 @@
+"""Headline benchmark: 3-step pattern throughput (BASELINE.json north star).
+
+Replays N synthetic events through the compiled
+``every s1 -> s2 -> s3 within 5 sec`` pattern plan (the query the driver's
+north star names) and reports steady-state events/sec, excluding warmup
+(jit compile) cycles.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+``vs_baseline``: the reference publishes no numbers (BASELINE.md — repo has
+no benchmarks). The denominator is a pinned 500_000 events/sec estimate of
+the in-JVM Siddhi runtime on a single-core 3-step pattern (siddhi-core's
+published simple-filter throughput is low-millions/sec; multi-step pattern
+state machines run well under that). North star: vs_baseline >= 20.
+
+Env knobs: BENCH_EVENTS (default 10_000_000), BENCH_BATCH (default 131072),
+BENCH_CONFIG (headline | filter | pattern2 | window_groupby | multiquery64).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+BASELINE_EVENTS_PER_SEC = 500_000.0
+
+
+def make_batches(n_events, batch, schema, stream_id, n_ids=50, step_ms=1):
+    """Prebuilt columnar EventBatches — zero per-record Python work."""
+    from flink_siddhi_tpu.schema.batch import EventBatch
+
+    rng = np.random.default_rng(7)
+    out = []
+    ts0 = 1_000
+    name_code = schema.string_tables["name"].intern("test_event")
+    for start in range(0, n_events, batch):
+        m = min(batch, n_events - start)
+        ids = rng.integers(0, n_ids, size=m).astype(np.int32)
+        cols = {
+            "id": ids,
+            "name": np.full(m, name_code, dtype=np.int32),
+            "price": rng.random(m, dtype=np.float64) * 100.0,
+            "timestamp": (
+                ts0 + step_ms * (start + np.arange(m, dtype=np.int64))
+            ),
+        }
+        ts = cols["timestamp"]
+        out.append(EventBatch(stream_id, schema, cols, ts))
+    return out
+
+
+def build_job(config, n_events, batch):
+    from flink_siddhi_tpu import CEPEnvironment
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    env = CEPEnvironment(batch_size=batch, time_mode="processing")
+    schema = StreamSchema(
+        [
+            ("id", AttributeType.INT),
+            ("name", AttributeType.STRING),
+            ("price", AttributeType.DOUBLE),
+            ("timestamp", AttributeType.LONG),
+        ],
+        shared_strings=env.shared_strings,
+    )
+
+    if config == "headline":
+        cql = (
+            "from every s1 = inputStream[id == 1] -> "
+            "s2 = inputStream[id == 2] -> s3 = inputStream[id == 3] "
+            "within 5 sec "
+            "select s1.timestamp as t1, s3.timestamp as t3, "
+            "s3.price as price insert into matches"
+        )
+    elif config == "filter":
+        cql = (
+            "from inputStream[id == 2] select id, name, price "
+            "insert into matches"
+        )
+    elif config == "pattern2":
+        cql = (
+            "from every s1 = inputStream[id == 1] -> "
+            "s2 = inputStream[id == 2] "
+            "select s1.timestamp as t1, s2.timestamp as t2 "
+            "insert into matches"
+        )
+    elif config == "window_groupby":
+        cql = (
+            "from inputStream#window.length(1000) "
+            "select id, sum(price) as total, count() as cnt "
+            "group by id insert into matches"
+        )
+    elif config == "multiquery64":
+        parts = []
+        for q in range(64):
+            a, b = q % 50, (q * 7 + 1) % 50
+            parts.append(
+                f"from every s1 = inputStream[id == {a}] -> "
+                f"s2 = inputStream[id == {b}] "
+                f"select s1.timestamp as t1, s2.timestamp as t2 "
+                f"insert into m{q}"
+            )
+        cql = "; ".join(parts)
+    else:
+        raise SystemExit(f"unknown BENCH_CONFIG {config!r}")
+
+    n_ids = 1000 if config == "window_groupby" else 50
+    batches = make_batches(n_events, batch, schema, "inputStream", n_ids)
+    src = BatchSource("inputStream", schema, iter(batches))
+    plan = compile_plan(cql, {"inputStream": schema}, plan_id="bench")
+    return Job(
+        [plan], [src], batch_size=batch, time_mode="processing"
+    )
+
+
+def main():
+    config = os.environ.get("BENCH_CONFIG", "headline")
+    n_events = int(os.environ.get("BENCH_EVENTS", 10_000_000))
+    batch = int(os.environ.get("BENCH_BATCH", 131_072))
+    warmup_cycles = 3
+
+    job = build_job(config, n_events, batch)
+    cycles = 0
+    t0 = time.perf_counter()
+    counted_at = 0
+    while not job.finished:
+        job.run_cycle()
+        cycles += 1
+        if cycles == warmup_cycles:
+            t0 = time.perf_counter()
+            counted_at = job.processed_events
+    import jax
+
+    jax.block_until_ready(
+        [rt.states for rt in job._plans.values()]
+    )
+    elapsed = time.perf_counter() - t0
+    measured = job.processed_events - counted_at
+    if measured <= 0:  # tiny runs: count everything
+        measured = job.processed_events
+        elapsed = time.perf_counter() - t0
+    ev_per_sec = measured / max(elapsed, 1e-9)
+    print(
+        json.dumps(
+            {
+                "metric": f"events/sec ({config}, {n_events} events)",
+                "value": round(ev_per_sec, 1),
+                "unit": "events/sec",
+                "vs_baseline": round(
+                    ev_per_sec / BASELINE_EVENTS_PER_SEC, 3
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Sharded execution: one shard_map-ed device step over a mesh of shards.
+
+The multi-device analog of the reference's N parallel operator subtasks, each
+hosting a full copy of every execution plan (AbstractSiddhiOperator.java:
+301-313): plan state is stacked along a leading ``shards`` axis and laid out
+with a ``NamedSharding`` so each device owns its shard; the jitted step is a
+``jax.shard_map`` that advances every shard's plan in ONE SPMD program. Events
+reach shards through the host Router (key-hash / round-robin / broadcast —
+the DynamicPartitioner contract) as per-shard tapes stacked to a common
+bucketed capacity.
+
+On a real TPU slice the ``shards`` axis rides ICI; in tests it is an 8-device
+virtual CPU mesh (the MiniCluster analog, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..compiler.plan import CompiledPlan
+from ..runtime.executor import Job, _PlanRuntime
+from ..runtime.tape import build_tape, bucket_size
+from ..schema.batch import EventBatch
+from .mesh import SHARD_AXIS, make_cep_mesh
+from .router import Router
+
+
+def _tree_stack(trees: Sequence):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _tree_index(tree, i: int):
+    """Index the leading (shard) axis of a host tree."""
+    return jax.tree.map(lambda x: np.asarray(x)[i], tree)
+
+
+def _shapes(tree) -> List[Tuple]:
+    return [np.shape(leaf) for leaf in jax.tree.leaves(tree)]
+
+
+def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
+    """jit(shard_map(plan.step)) over the ``shards`` mesh axis.
+
+    Inside the shard body every leaf carries a leading local shard dim of 1,
+    stripped before the single-shard step and restored after, so the
+    single-device compile path and the sharded path share all kernels.
+    """
+
+    def local(states, tape):
+        states = jax.tree.map(lambda x: x[0], states)
+        tape = jax.tree.map(lambda x: x[0], tape)
+        new_states, outputs = plan.step(states, tape)
+        expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+        return expand(new_states), expand(outputs)
+
+    smapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(smapped)
+
+
+class ShardedJob(Job):
+    """A Job whose plans run sharded over a device mesh.
+
+    Semantics parity with reference parallelism (SURVEY.md §2.7): group-by
+    streams are key-partitioned so every group's state lives on exactly one
+    shard (exact results); shuffle streams are round-robined so stateful
+    cross-event queries (patterns without keys) match within a shard, exactly
+    as the reference's random channel selection does for partitionKey −1.
+    """
+
+    def __init__(
+        self,
+        plans: Sequence[CompiledPlan],
+        sources,
+        mesh=None,
+        n_shards: Optional[int] = None,
+        **kwargs,
+    ) -> None:
+        self.mesh = mesh if mesh is not None else make_cep_mesh(n_shards)
+        self.n_shards = self.mesh.devices.size
+        self._routers: Dict[str, Router] = {}
+        self._state_sharding = NamedSharding(self.mesh, P(SHARD_AXIS))
+        super().__init__(plans, sources, **kwargs)
+
+    # -- plan management -----------------------------------------------------
+    def add_plan(self, plan: CompiledPlan) -> None:
+        stacked = _tree_stack([plan.init_state()] * self.n_shards)
+        stacked = jax.device_put(stacked, self._state_sharding)
+        self._plans[plan.plan_id] = _PlanRuntime(
+            plan=plan,
+            states=stacked,
+            jitted=make_sharded_step(plan, self.mesh),
+        )
+        self._routers[plan.plan_id] = Router(self.n_shards, plan.partitions)
+
+    def remove_plan(self, plan_id: str) -> None:
+        super().remove_plan(plan_id)
+        self._routers.pop(plan_id, None)
+
+    # -- sharded hot path ----------------------------------------------------
+    def _grow_stacked(self, plan: CompiledPlan, stacked):
+        """Group tables grow when host interning discovers new keys; growth
+        is detected abstractly (shape metadata only — no device transfer in
+        the common case) and, when needed, applied per shard and restacked."""
+        probe = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x)[1:], x.dtype), stacked
+        )
+        grown = jax.eval_shape(plan.grow_state, probe)
+        if _shapes(grown) == _shapes(probe):
+            return stacked
+        host = jax.device_get(stacked)
+        shards = [
+            plan.grow_state(_tree_index(host, s))
+            for s in range(self.n_shards)
+        ]
+        return jax.device_put(_tree_stack(shards), self._state_sharding)
+
+    def _step_plan(self, rt: _PlanRuntime, ready: List[EventBatch]) -> None:
+        plan = rt.plan
+        involved = [
+            b for b in ready if b.stream_id in plan.spec.stream_codes
+        ]
+        if not involved:
+            return
+        shards = self._routers[plan.plan_id].route_all(involved)
+        cap = bucket_size(
+            max(sum(len(b) for b in sh) for sh in shards) or 1
+        )
+        tapes = [
+            build_tape(plan.spec, sh, self._epoch_ms, cap)[0]
+            for sh in shards
+        ]
+        stacked_tape = _tree_stack(
+            [jax.tree.map(jnp.asarray, t) for t in tapes]
+        )
+        rt.states = self._grow_stacked(plan, rt.states)
+        rt.states, outputs = rt.jitted(rt.states, stacked_tape)
+        outputs = jax.device_get(outputs)
+        for s in range(self.n_shards):
+            self._decode_outputs(plan, _tree_index(outputs, s))
+
+    def flush(self) -> None:
+        for rt in self._plans.values():
+            host = jax.device_get(rt.states)
+            new_shards = []
+            for s in range(self.n_shards):
+                st, outputs = rt.plan.flush(_tree_index(host, s))
+                new_shards.append(st)
+                if outputs:
+                    self._decode_outputs(rt.plan, outputs, only=set(outputs))
+            rt.states = jax.device_put(
+                _tree_stack(new_shards), self._state_sharding
+            )
+
+    # -- results: merge shard-interleaved output back to time order ---------
+    def results_with_ts(self, output_stream: str):
+        rows = list(self.collected.get(output_stream, []))
+        rows.sort(key=lambda p: p[0])
+        return rows
+
+    def results(self, output_stream: str):
+        return [row for _, row in self.results_with_ts(output_stream)]

@@ -38,23 +38,27 @@ def _to_numpy(tree):
     return jax.tree_util.tree_map(np.asarray, tree)
 
 
+def _first_string_table(job):
+    """The environment's shared string dictionary: every schema built through
+    CEPEnvironment references one StringTable object, so the first one found
+    is THE dictionary (api/cep.py shared_strings)."""
+    for rt in job._plans.values():
+        for sch in rt.plan.schemas.values():
+            for t in sch.string_tables.values():
+                return t
+    return None
+
+
 def snapshot_job(job) -> Dict[str, Any]:
     """Capture everything needed to resume ``job`` on a fresh process."""
     plans = {}
-    shared_strings_state = None
+    strings = _first_string_table(job)
     for plan_id, rt in job._plans.items():
         plan = rt.plan
         encoders = {
             enc.out_key: enc.encoder.state_dict()
             for enc in plan.spec.encoded
         }
-        if shared_strings_state is None:
-            for sch in plan.schemas.values():
-                for t in sch.string_tables.values():
-                    shared_strings_state = t.state_dict()
-                    break
-                if shared_strings_state is not None:
-                    break
         plans[plan_id] = {
             "states": _to_numpy(rt.states),
             "enabled": rt.enabled,
@@ -76,16 +80,20 @@ def snapshot_job(job) -> Dict[str, Any]:
         sd = getattr(src, "state_dict", None)
         if sd is not None:
             sources[i] = sd()
+    routers = {
+        pid: r.state_dict() for pid, r in getattr(job, "_routers", {}).items()
+    }
     return {
         "version": FORMAT_VERSION,
         "epoch_ms": job._epoch_ms,
         "processed_events": job.processed_events,
         "time_mode": job.time_mode,
         "plans": plans,
-        "strings": shared_strings_state,
+        "strings": strings.state_dict() if strings is not None else None,
         "pending": pending,
         "control_pending": list(job._control_pending),
         "sources": sources,
+        "routers": routers,
     }
 
 
@@ -95,25 +103,29 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
     then device state replaces the initialized pytrees."""
     if snap.get("version") != FORMAT_VERSION:
         raise ValueError(f"unsupported checkpoint version {snap.get('version')}")
+    if snap["time_mode"] != job.time_mode:
+        raise ValueError(
+            f"checkpoint was taken in {snap['time_mode']!r} time mode but "
+            f"the job runs in {job.time_mode!r}; the reorder buffer "
+            "semantics differ — rebuild the job with the original mode"
+        )
     job._epoch_ms = snap["epoch_ms"]
     job.processed_events = snap["processed_events"]
 
     # 1. shared string dictionary (identity-preserving, every schema of the
     # environment references the same object)
-    if snap["strings"] is not None:
-        restored = False
-        for rt in job._plans.values():
-            for sch in rt.plan.schemas.values():
-                for t in sch.string_tables.values():
-                    t.load_state_dict(snap["strings"])
-                    restored = True
-                    break
-                if restored:
-                    break
-            if restored:
-                break
+    strings = _first_string_table(job)
+    if snap["strings"] is not None and strings is not None:
+        strings.load_state_dict(snap["strings"])
 
-    # 2. per-plan encoders + device states
+    # 2. per-plan encoders + device states (both directions must match:
+    # a plan in only one of {snapshot, job} means the CQL changed)
+    job_only = set(job._plans) - set(snap["plans"])
+    if job_only:
+        raise ValueError(
+            f"the job has plans {sorted(job_only)} that the checkpoint "
+            "does not; rebuild the job with the same plans before restoring"
+        )
     for plan_id, prec in snap["plans"].items():
         rt = job._plans.get(plan_id)
         if rt is None:
@@ -122,15 +134,30 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
                 "rebuild the job with the same plans before restoring"
             )
         for enc in rt.plan.spec.encoded:
-            if enc.out_key in prec["encoders"]:
-                enc.encoder.load_state_dict(prec["encoders"][enc.out_key])
-        ref = rt.states
+            if enc.out_key not in prec["encoders"]:
+                raise ValueError(
+                    f"checkpoint for plan {plan_id!r} has no encoder state "
+                    f"for group key {enc.out_key!r}; was the group-by "
+                    "clause changed?"
+                )
+            enc.encoder.load_state_dict(prec["encoders"][enc.out_key])
+        # grow the reference to the restored encoders' bucketed sizes, then
+        # require exact shape/dtype agreement (catches window-size / capacity
+        # changes while allowing legitimately grown group tables)
+        if hasattr(job, "_grow_stacked"):
+            ref = job._grow_stacked(rt.plan, rt.states)
+        else:
+            ref = rt.plan.grow_state(rt.states)
         restored_states = prec["states"]
         _check_compatible(ref, restored_states, plan_id)
-        rt.states = jax.tree_util.tree_map(
-            lambda x: x, restored_states
-        )
+        rt.states = restored_states
         rt.enabled = prec["enabled"]
+
+    # 2b. sharded-job routers (round-robin cursors)
+    for pid, rstate in snap.get("routers", {}).items():
+        router = getattr(job, "_routers", {}).get(pid)
+        if router is not None:
+            router.load_state_dict(rstate)
 
     # 3. reorder buffer + control queue
     job._pending = {}
@@ -158,22 +185,34 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
 
 
 def _check_compatible(ref, restored, plan_id: str) -> None:
-    ref_paths = {
-        jax.tree_util.keystr(p)
-        for p, _ in jax.tree_util.tree_flatten_with_path(ref)[0]
+    ref_leaves = {
+        jax.tree_util.keystr(p): v
+        for p, v in jax.tree_util.tree_flatten_with_path(ref)[0]
     }
-    got_paths = {
-        jax.tree_util.keystr(p)
-        for p, _ in jax.tree_util.tree_flatten_with_path(restored)[0]
+    got_leaves = {
+        jax.tree_util.keystr(p): v
+        for p, v in jax.tree_util.tree_flatten_with_path(restored)[0]
     }
-    if ref_paths != got_paths:
-        missing = ref_paths - got_paths
-        extra = got_paths - ref_paths
+    if set(ref_leaves) != set(got_leaves):
+        missing = set(ref_leaves) - set(got_leaves)
+        extra = set(got_leaves) - set(ref_leaves)
         raise ValueError(
             f"checkpoint state for plan {plan_id!r} does not match the "
             f"running plan (missing {sorted(missing)[:3]}, "
             f"unexpected {sorted(extra)[:3]}); was the CQL changed?"
         )
+    for path, rv in ref_leaves.items():
+        gv = got_leaves[path]
+        if np.shape(rv) != np.shape(gv) or np.asarray(
+            rv
+        ).dtype != np.asarray(gv).dtype:
+            raise ValueError(
+                f"checkpoint state for plan {plan_id!r} leaf {path} has "
+                f"shape/dtype {np.shape(gv)}/{np.asarray(gv).dtype} but the "
+                f"running plan expects {np.shape(rv)}/"
+                f"{np.asarray(rv).dtype}; was the CQL (window sizes, "
+                "capacities) changed?"
+            )
 
 
 def save(job, path: str) -> None:

@@ -299,10 +299,12 @@ class Job:
         save(self, path)
 
     def restore(self, snapshot_or_path) -> None:
+        import os
+
         from .checkpoint import load, restore_job
 
-        if isinstance(snapshot_or_path, str):
-            load(self, snapshot_or_path)
+        if isinstance(snapshot_or_path, (str, os.PathLike)):
+            load(self, os.fspath(snapshot_or_path))
         else:
             restore_job(self, snapshot_or_path)
 

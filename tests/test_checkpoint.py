@@ -124,3 +124,84 @@ def test_restore_rejects_changed_plan():
     )
     with pytest.raises(ValueError):
         es2.job.restore(snap)
+
+
+def test_restore_rejects_changed_window_size():
+    # same pytree structure, different ring capacity -> must be rejected
+    # (shape validation, not just key paths)
+    events = make_events(12)
+    env1 = CEPEnvironment(batch_size=5)
+    job1 = (
+        SiddhiCEP.define("S", events, FIELDS, env=env1)
+        .cql("from S#window.length(5) select sum(price) as t insert into out")
+        .execute()
+    )
+    snap = job1.snapshot()
+
+    env2 = CEPEnvironment(batch_size=5)
+    es2 = SiddhiCEP.define("S", events, FIELDS, env=env2).cql(
+        "from S#window.length(9) select sum(price) as t insert into out"
+    )
+    with pytest.raises(ValueError, match="shape|dtype|CQL"):
+        es2.job.restore(snap)
+
+
+def test_restore_rejects_time_mode_mismatch():
+    events = make_events(12)
+    env1 = CEPEnvironment(batch_size=5)
+    cql = "from S select id, price insert into out"
+    job1 = SiddhiCEP.define("S", events, FIELDS, env=env1).cql(cql).execute()
+    snap = job1.snapshot()
+
+    env2 = CEPEnvironment(batch_size=5, time_mode="processing")
+    es2 = SiddhiCEP.define("S", events, FIELDS, env=env2).cql(cql)
+    with pytest.raises(ValueError, match="time mode"):
+        es2.job.restore(snap)
+
+
+def test_restore_accepts_pathlike(tmp_path):
+    events = make_events(12)
+    cql = "from S#window.length(5) select sum(price) as t insert into out"
+    env1 = CEPEnvironment(batch_size=5)
+    job1 = SiddhiCEP.define("S", events, FIELDS, env=env1).cql(cql).execute()
+    path = tmp_path / "ckpt.bin"  # pathlib.Path, not str
+    job1.save_checkpoint(str(path))
+
+    env2 = CEPEnvironment(batch_size=5)
+    es2 = SiddhiCEP.define("S", events, FIELDS, env=env2).cql(cql)
+    es2.job.restore(path)
+
+
+def test_sharded_job_checkpoint_roundtrip():
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.parallel import ShardedJob, make_cep_mesh
+
+    events = make_events(40)
+    cql = (
+        "from S select id, sum(price) as total, count() as c "
+        "group by id insert into out"
+    )
+
+    def build(evs):
+        env = CEPEnvironment(batch_size=8)
+        env.register_stream("S", evs, FIELDS)
+        plan = compile_plan(
+            cql, {"S": env.schemas["S"]}, extensions=env.extensions
+        )
+        return ShardedJob(
+            [plan], [env.sources["S"]], mesh=make_cep_mesh(8), batch_size=8
+        )
+
+    full = build(events)
+    full.run()
+
+    j1 = build(events[:20])
+    j1.run()
+    snap = j1.snapshot()
+    j2 = build(events)
+    j2.restore(snap)
+    # skip the consumed prefix (source position was restored)
+    j2.run()
+    assert sorted(j1.results_with_ts("out") + j2.results_with_ts("out")) == sorted(
+        full.results_with_ts("out")
+    )
