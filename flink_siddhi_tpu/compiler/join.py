@@ -67,6 +67,10 @@ class JoinArtifact:
     proj_fns: List[Callable]
     output_mode: str = "buffered"
 
+    def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
+        """Widest per-cycle emission block (drain-cadence contract)."""
+        return JOIN_OUT_FACTOR * tape_capacity
+
     def init_state(self) -> Dict:
         st = {"enabled": jnp.asarray(True),
               "overflow": jnp.asarray(0, jnp.int32)}

@@ -297,6 +297,10 @@ class ChainPatternArtifact:
     output_mode: str = "buffered"
     pool: int = DEFAULT_PARTIAL_POOL
 
+    def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
+        """Widest per-cycle emission block (drain-cadence contract)."""
+        return tape_capacity + self.pool
+
     def init_state(self) -> Dict:
         P = self.pool
         K = self.spec.n_elements
@@ -410,45 +414,67 @@ class ChainPatternArtifact:
         else:
             new_done = state["done"]
 
-        # emit matches sorted by completion time
+        # emit matches: O(V) cumsum-scatter compaction into the first
+        # n_matches rows (a full argsort of V keys is the single most
+        # expensive op on TPU here — sort networks are n log^2 n; the final
+        # by-timestamp ordering is done on host over the n decoded rows)
         n_matches = complete.sum().astype(jnp.int32)
-        emit_key = jnp.where(complete, v_emit_ts, _BIG)
-        order = jnp.argsort(emit_key, stable=True)
+        emit_pos = jnp.cumsum(complete.astype(jnp.int32)) - 1
+        emit_dest = jnp.where(complete, emit_pos, V)  # V -> dropped
         emit_env = _emit_env(
             spec,
             {
-                (elem, col, which): caps[(elem, col)][order]
+                (elem, col, which): caps[(elem, col)]
                 for elem, col, which in spec.captures
             },
         )
         out_cols = tuple(
-            jnp.broadcast_to(jnp.asarray(p(emit_env)), (V,))
+            jnp.zeros(V, dtype=jnp.result_type(jnp.asarray(p(emit_env))))
+            .at[emit_dest]
+            .set(jnp.broadcast_to(jnp.asarray(p(emit_env)), (V,)),
+                 mode="drop")
             for p in spec.proj_fns
         )
-        out_ts = v_emit_ts[order]
+        out_ts = (
+            jnp.zeros(V, dtype=jnp.int32)
+            .at[emit_dest]
+            .set(v_emit_ts, mode="drop")
+        )
 
-        # survivors -> new pool (oldest starts first; overflow dropped)
+        # survivors -> new pool, same cumsum-scatter compaction. The v
+        # ordering (carried pool first, then fresh starts in tape order) is
+        # already oldest-start-first for time-ordered batches, so on
+        # overflow the newest partials are the ones dropped.
         survive = v_active & (v_step < K)
         if spec.within is not None:
             batch_max = jnp.max(jnp.where(tape.valid, tape.ts, -_BIG))
             survive = survive & (
                 (batch_max - v_start) <= jnp.int32(spec.within)
             )
-        pool_key = jnp.where(survive, v_start, _BIG)
-        pool_order = jnp.argsort(pool_key, stable=True)[:P]
-        kept = survive[pool_order]
+        keep_pos = jnp.cumsum(survive.astype(jnp.int32)) - 1
+        pool_dest = jnp.where(survive & (keep_pos < P), keep_pos, P)
         n_survive = survive.sum().astype(jnp.int32)
+
+        def compact(vals, fill, dtype):
+            return (
+                jnp.full((P,), fill, dtype=dtype)
+                .at[pool_dest]
+                .set(vals, mode="drop")
+            )
+
         new_state = {
             "enabled": state["enabled"],
-            "active": kept,
-            "step": v_step[pool_order],
-            "start": v_start[pool_order],
+            "active": compact(survive, False, bool),
+            "step": compact(v_step, 1, jnp.int32),
+            "start": compact(v_start, 0, jnp.int32),
             "done": new_done,
             "overflow": state["overflow"]
             + jnp.maximum(n_survive - P, 0).astype(jnp.int32),
         }
         for pair in pairs:
-            new_state[_skey("cap", *pair)] = caps[pair][pool_order]
+            new_state[_skey("cap", *pair)] = compact(
+                caps[pair], 0, spec.cap_dtype[pair]
+            )
         return new_state, (n_matches, out_ts, out_cols)
 
 
@@ -466,6 +492,10 @@ class SlotNFAArtifact:
     output_schema: OutputSchema
     output_mode: str = "buffered"
     slots: int = DEFAULT_SLOTS
+
+    def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
+        """Widest per-cycle emission block (drain-cadence contract)."""
+        return tape_capacity + self.slots
 
     def __post_init__(self):
         spec = self.spec

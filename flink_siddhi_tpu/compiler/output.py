@@ -41,6 +41,22 @@ class OutputField:
             return float(v)
         return v
 
+    def decode_column(self, arr: np.ndarray) -> List[Any]:
+        """Whole-column decode: one host array -> python values.
+
+        ``ndarray.tolist()`` yields native python scalars in C; only the
+        dictionary lookup for encoded strings stays a per-value loop.
+        """
+        if self.table is not None:
+            return [self.table.value(v) for v in arr.tolist()]
+        if self.atype == AttributeType.BOOL:
+            return arr.astype(bool).tolist()
+        if self.atype in (AttributeType.INT, AttributeType.LONG):
+            return arr.astype(np.int64).tolist()
+        if self.atype in (AttributeType.FLOAT, AttributeType.DOUBLE):
+            return arr.astype(np.float64).tolist()
+        return arr.tolist()
+
 
 @dataclass
 class OutputSchema:
@@ -54,24 +70,38 @@ class OutputSchema:
     def decode_aligned(
         self, mask: np.ndarray, ts: np.ndarray, cols: Sequence[np.ndarray]
     ) -> List[Tuple[int, Tuple[Any, ...]]]:
-        """(ts_ms, row) per emitted position, in tape order."""
+        """(ts_ms, row) per emitted position, in tape order.
+
+        One device->host transfer per column (the naive per-row
+        ``np.asarray(c)[i]`` costs a full dispatch round-trip per value —
+        ~65us each through a tunneled accelerator, catastrophic for the
+        match-heavy benchmarks).
+        """
         idx = np.nonzero(np.asarray(mask))[0]
-        out = []
-        for i in idx:
-            row = tuple(
-                f.decode(np.asarray(c)[i]) for f, c in zip(self.fields, cols)
-            )
-            out.append((int(np.asarray(ts)[i]), row))
-        return out
+        if idx.size == 0:
+            return []
+        ts_list = np.asarray(ts)[idx].astype(np.int64).tolist()
+        col_lists = [
+            f.decode_column(np.asarray(c)[idx])
+            for f, c in zip(self.fields, cols)
+        ]
+        rows = zip(*col_lists) if col_lists else ((),) * idx.size
+        return list(zip(ts_list, map(tuple, rows)))
 
     def decode_buffered(
         self, count: int, ts: np.ndarray, cols: Sequence[np.ndarray]
     ) -> List[Tuple[int, Tuple[Any, ...]]]:
         n = int(count)
-        out = []
-        for i in range(n):
-            row = tuple(
-                f.decode(np.asarray(c)[i]) for f, c in zip(self.fields, cols)
-            )
-            out.append((int(np.asarray(ts)[i]), row))
-        return out
+        if n == 0:
+            return []
+        ts_arr = np.asarray(ts)[:n]
+        # buffers are compacted on device in slot order, not time order;
+        # restore by-timestamp emission order here (n is small)
+        order = np.argsort(ts_arr, kind="stable")
+        ts_list = ts_arr[order].astype(np.int64).tolist()
+        col_lists = [
+            f.decode_column(np.asarray(c)[:n][order])
+            for f, c in zip(self.fields, cols)
+        ]
+        rows = zip(*col_lists) if col_lists else ((),) * n
+        return list(zip(ts_list, map(tuple, rows)))

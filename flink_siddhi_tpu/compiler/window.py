@@ -586,6 +586,11 @@ class BatchWindowArtifact:
     having_fn: Optional[Callable]
     output_mode: str = "buffered"
 
+    def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
+        """Widest per-cycle emission block: every window-grid cell can
+        flush (drain-cadence contract)."""
+        return self._grid_shape(tape_capacity) * self._G(state)
+
     def _G(self, state) -> int:
         return state["cnt"].shape[0]
 

@@ -40,7 +40,11 @@ def hash_columns(cols: Sequence[np.ndarray], n: int) -> np.ndarray:
     with np.errstate(over="ignore"):
         for c in cols:
             if c.dtype.kind == "f":
-                v = np.ascontiguousarray(c, dtype=np.float64).view(np.uint64)
+                # normalize -0.0 -> +0.0: group interning uses value
+                # equality (0.0 == -0.0), so both must land on one shard
+                cf = np.ascontiguousarray(c, dtype=np.float64)
+                cf = cf + 0.0
+                v = cf.view(np.uint64)
             elif c.dtype.kind == "b":
                 v = c.astype(np.uint64)
             else:

@@ -152,6 +152,9 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
         _check_compatible(ref, restored_states, plan_id)
         rt.states = restored_states
         rt.enabled = prec["enabled"]
+        # output accumulators are drained pre-snapshot, never checkpointed
+        if getattr(rt, "acc", None) is not None:
+            rt.acc = rt.jitted_init_acc()
 
     # 2b. sharded-job routers (round-robin cursors)
     for pid, rstate in snap.get("routers", {}).items():
@@ -201,16 +204,19 @@ def _check_compatible(ref, restored, plan_id: str) -> None:
             f"running plan (missing {sorted(missing)[:3]}, "
             f"unexpected {sorted(extra)[:3]}); was the CQL changed?"
         )
+    def _dtype(v):
+        # device arrays expose .dtype without a device->host transfer;
+        # np.asarray here would download every state leaf just to compare
+        return getattr(v, "dtype", None) or np.asarray(v).dtype
+
     for path, rv in ref_leaves.items():
         gv = got_leaves[path]
-        if np.shape(rv) != np.shape(gv) or np.asarray(
-            rv
-        ).dtype != np.asarray(gv).dtype:
+        if np.shape(rv) != np.shape(gv) or _dtype(rv) != _dtype(gv):
             raise ValueError(
                 f"checkpoint state for plan {plan_id!r} leaf {path} has "
-                f"shape/dtype {np.shape(gv)}/{np.asarray(gv).dtype} but the "
+                f"shape/dtype {np.shape(gv)}/{_dtype(gv)} but the "
                 f"running plan expects {np.shape(rv)}/"
-                f"{np.asarray(rv).dtype}; was the CQL (window sizes, "
+                f"{_dtype(rv)}; was the CQL (window sizes, "
                 "capacities) changed?"
             )
 

@@ -15,6 +15,7 @@ AbstractSiddhiOperator.java:274-278,209-247) re-shaped for an accelerator:
 from __future__ import annotations
 
 import heapq
+import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -27,13 +28,17 @@ from .sources import Source
 from .tape import Tape, bucket_size, build_tape
 
 MAX_WM = np.iinfo(np.int64).max
+_LOG = logging.getLogger(__name__)
 
 
 @dataclass
 class _PlanRuntime:
     plan: CompiledPlan
     states: Dict
-    jitted: Callable
+    jitted: Callable  # plan.step (kept for direct/step callers)
+    jitted_acc: Callable = None  # plan.step_acc — the hot loop entry
+    jitted_init_acc: Callable = None  # cached: zeroing program compiles once
+    acc: Dict = None  # device-side output accumulator (None: fetch-per-cycle)
     enabled: bool = True
 
 
@@ -72,20 +77,37 @@ class Job:
         self.output_fields: Dict[str, List[str]] = {}
         self._sinks: Dict[str, List[Callable]] = {}
         self.processed_events = 0  # observability (reference logs per runtime)
+        # drain the device accumulators at least every N cycles so a
+        # long-running job can't overflow them (2 fetches per plan per drain)
+        self.drain_every_cycles = 256
+        self._cycles_since_drain = 0
+        # per-plan capacity-check cadence (recomputed as plans come and go)
+        self._drain_hints: Dict[str, int] = {}
 
     # -- plan management (dynamic control plane hooks) ----------------------
     # Parity: AbstractSiddhiOperator.onEventReceived (:399-467) — add/update/
     # remove QueryRuntimeHandlers, enable/disable gating — applied here at
     # micro-batch boundaries.
     def add_plan(self, plan: CompiledPlan) -> None:
+        init_acc = jax.jit(plan.init_acc)
         self._plans[plan.plan_id] = _PlanRuntime(
             plan=plan,
             states=plan.init_state(),
             jitted=jax.jit(plan.step),
+            # donate states + accumulator: XLA updates the (potentially
+            # 100s-of-MB) output buffer in place instead of copying it
+            # every micro-batch
+            jitted_acc=jax.jit(plan.step_acc, donate_argnums=(0, 1)),
+            jitted_init_acc=init_acc,
+            acc=init_acc(),
         )
 
     def remove_plan(self, plan_id: str) -> None:
+        rt = self._plans.get(plan_id)
+        if rt is not None:
+            self._drain_plan(rt)  # don't lose already-produced matches
         self._plans.pop(plan_id, None)
+        self._drain_hints.pop(plan_id, None)
 
     def set_plan_enabled(self, plan_id: str, enabled: bool) -> None:
         rt = self._plans.get(plan_id)
@@ -137,14 +159,65 @@ class Job:
             self.flush()
 
     def flush(self) -> None:
-        """End-of-stream: fire final timer-driven emissions (timeBatch
-        windows carry their last incomplete window out)."""
+        """End-of-stream: drain accumulated matches, then fire final
+        timer-driven emissions (timeBatch windows carry their last
+        incomplete window out)."""
         for rt in self._plans.values():
+            self._drain_plan(rt)
             rt.states, outputs = rt.plan.flush(rt.states)
             if outputs:
                 self._decode_outputs(
                     rt.plan, outputs, only=set(outputs)
                 )
+
+    def drain_outputs(self, min_fill: float = 0.0) -> None:
+        """Fetch and decode all on-device accumulated emissions (two
+        device->host round-trips per plan). With ``min_fill`` > 0 this is a
+        cheap capacity check: one meta fetch, and the (bigger) data fetch +
+        decode only happens for plans past that fill fraction."""
+        for rt in self._plans.values():
+            self._drain_plan(rt, min_fill)
+
+    def _drain_plan(self, rt: _PlanRuntime, min_fill: float = 0.0) -> None:
+        if rt.acc is None or not rt.plan.artifacts:
+            return
+        meta = np.asarray(rt.acc["meta"])  # fetch 1 (also syncs the queue)
+        counts, overflow = meta[0], meta[1]
+        seen = getattr(rt, "_overflow_seen", None)
+        for ai, a in enumerate(rt.plan.artifacts):
+            already = 0 if seen is None else int(seen[ai])
+            if overflow[ai] > already:  # log new drops once, not per check
+                _LOG.warning(
+                    "%s: %d emissions dropped (accumulator full; raise "
+                    "CompiledPlan.ACC_BUDGET_BYTES or drain more often)",
+                    a.name, int(overflow[ai]) - already,
+                )
+        rt._overflow_seen = overflow
+        max_n = int(counts.max()) if counts.size else 0
+        if max_n == 0:
+            return
+        if min_fill > 0 and max_n < min_fill * rt.plan.acc_capacity():
+            return  # capacity check only: plenty of headroom, keep batching
+        data = np.asarray(rt.acc["buf"][:, :max_n])  # fetch 2
+        rt.acc = rt.jitted_init_acc()
+        rt._overflow_seen = None  # counters reset with the accumulator
+        decoded = rt.plan.drain_decode(counts, data)
+        for a in rt.plan.artifacts:
+            self._emit_rows(a.output_schema, decoded.get(a.name) or [])
+
+    def _emit_rows(self, schema, rows) -> None:
+        """Shared append-to-collectors/sinks tail for all decode paths."""
+        if not rows:
+            return
+        sid = schema.stream_id
+        self.output_fields.setdefault(sid, schema.field_names)
+        bucket = self.collected.setdefault(sid, [])
+        epoch = self._epoch_ms or 0
+        for rel_ts, row in rows:
+            abs_ts = epoch + rel_ts
+            bucket.append((abs_ts, row))
+            for sink in self._sinks.get(sid, ()):
+                sink(abs_ts, row)
 
     @property
     def finished(self) -> bool:
@@ -172,6 +245,14 @@ class Job:
         for rt in list(self._plans.values()):
             if rt.enabled:
                 self._step_plan(rt, ready)
+        self._cycles_since_drain += 1
+        if self._cycles_since_drain >= min(
+            self.drain_every_cycles,
+            min(self._drain_hints.values(), default=self.drain_every_cycles),
+        ):
+            # meta-only check; full drain only past half capacity
+            self.drain_outputs(min_fill=0.5)
+            self._cycles_since_drain = 0
         return total
 
     def _pull_control(self) -> None:
@@ -252,8 +333,27 @@ class Job:
         # host interning may have discovered new group keys: re-bucket state
         # tables before the jit call (shape change -> one-off retrace)
         rt.states = plan.grow_state(rt.states)
-        rt.states, outputs = rt.jitted(rt.states, tape)
-        self._decode_outputs(plan, outputs)
+        # NO device->host fetch here: emissions append to the on-device
+        # accumulator and are drained in bulk (flush/results/periodic check)
+        rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, tape)
+        # capacity-check cadence: each artifact declares its widest
+        # per-cycle emission block (joins fan out, patterns carry pools,
+        # batch windows flush whole grids) and needs that much headroom to
+        # fit, so with checks every k cycles and a >=50%-full drain rule,
+        # no overflow requires cap/2 + (k+1)*block <= cap
+        block = max(
+            (
+                a.emit_block_width(tape.capacity, rt.states.get(a.name))
+                if hasattr(a, "emit_block_width")
+                else tape.capacity
+                for a in plan.artifacts
+            ),
+            default=tape.capacity,
+        )
+        cap_cycles = max(
+            1, plan.acc_capacity() // (2 * max(block, 1)) - 1
+        )
+        self._drain_hints[plan.plan_id] = cap_cycles
 
     def _decode_outputs(
         self, plan: CompiledPlan, outputs: Dict, only=None
@@ -276,26 +376,23 @@ class Job:
                 rows = schema.decode_buffered(
                     int(count), np.asarray(ts), cols
                 )
-            sid = schema.stream_id
-            self.output_fields.setdefault(sid, schema.field_names)
-            bucket = self.collected.setdefault(sid, [])
-            epoch = self._epoch_ms or 0
-            for rel_ts, row in rows:
-                abs_ts = epoch + rel_ts
-                bucket.append((abs_ts, row))
-                for sink in self._sinks.get(sid, ()):
-                    sink(abs_ts, row)
+            self._emit_rows(schema, rows)
 
     # -- checkpoint / restore (exceeds the reference: restore of engine
     # state was an abandoned TODO there, AbstractSiddhiOperator.java:341) --
     def snapshot(self) -> Dict:
         from .checkpoint import snapshot_job
 
+        # accumulated-but-undrained emissions are not part of the snapshot;
+        # surface them to collectors/sinks first so nothing is lost
+        self.drain_outputs()
         return snapshot_job(self)
 
     def save_checkpoint(self, path: str) -> None:
         from .checkpoint import save
 
+        # same contract as snapshot(): surface accumulated emissions first
+        self.drain_outputs()
         save(self, path)
 
     def restore(self, snapshot_or_path) -> None:
@@ -310,7 +407,9 @@ class Job:
 
     # -- results -------------------------------------------------------------
     def results(self, output_stream: str) -> List[Tuple]:
+        self.drain_outputs()
         return [row for _, row in self.collected.get(output_stream, [])]
 
     def results_with_ts(self, output_stream: str) -> List[Tuple[int, Tuple]]:
+        self.drain_outputs()
         return list(self.collected.get(output_stream, []))
