@@ -25,7 +25,7 @@ import numpy as np
 from ..compiler.plan import CompiledPlan
 from ..schema.batch import EventBatch
 from .sources import Source
-from .tape import Tape, bucket_size, build_tape
+from .tape import build_wire_tape
 
 MAX_WM = np.iinfo(np.int64).max
 _LOG = logging.getLogger(__name__)
@@ -39,6 +39,7 @@ class _PlanRuntime:
     jitted_acc: Callable = None  # plan.step_acc — the hot loop entry
     jitted_init_acc: Callable = None  # cached: zeroing program compiles once
     acc: Dict = None  # device-side output accumulator (None: fetch-per-cycle)
+    wire_kinds: Dict = None  # sticky per-column wire widths (build_wire_tape)
     enabled: bool = True
 
 
@@ -90,6 +91,10 @@ class Job:
     # micro-batch boundaries.
     def add_plan(self, plan: CompiledPlan) -> None:
         init_acc = jax.jit(plan.init_acc)
+
+        def step_wire(states, acc, wire):
+            return plan.step_acc(states, acc, wire.expand())
+
         self._plans[plan.plan_id] = _PlanRuntime(
             plan=plan,
             states=plan.init_state(),
@@ -97,9 +102,10 @@ class Job:
             # donate states + accumulator: XLA updates the (potentially
             # 100s-of-MB) output buffer in place instead of copying it
             # every micro-batch
-            jitted_acc=jax.jit(plan.step_acc, donate_argnums=(0, 1)),
+            jitted_acc=jax.jit(step_wire, donate_argnums=(0, 1)),
             jitted_init_acc=init_acc,
             acc=init_acc(),
+            wire_kinds={},
         )
 
     def remove_plan(self, plan_id: str) -> None:
@@ -329,7 +335,9 @@ class Job:
         ]
         if not involved:
             return
-        tape, _prov = build_tape(plan.spec, involved, self._epoch_ms)
+        tape, _prov = build_wire_tape(
+            plan.spec, involved, self._epoch_ms, rt.wire_kinds
+        )
         # host interning may have discovered new group keys: re-bucket state
         # tables before the jit call (shape change -> one-off retrace)
         rt.states = plan.grow_state(rt.states)

@@ -87,6 +87,213 @@ class Tape:
         return cls(ts, stream, valid, cols)
 
 
+# --------------------------------------------------------------------------
+# Wire tape: the narrow host->device format
+# --------------------------------------------------------------------------
+# A tunneled/remote accelerator moves host->device bytes at tens of MB/s, so
+# the upload is the throughput ceiling of the whole engine. The wire format
+# strips everything the device can reconstruct:
+#   * validity mask  -> one scalar (post-sort validity is always a prefix)
+#   * stream codes   -> omitted entirely for single-input plans
+#   * int columns    -> narrowest safe width (int8/int16/int32), sticky per
+#     column so a width upgrade retraces at most twice per column
+#   * a column whose values equal the event timestamp (a very common schema
+#     shape: an explicit `timestamp` attribute) -> "alias", 0 bytes
+# ``WireTape.expand()`` runs as the first (fused, free) ops of the jitted
+# step and rebuilds the full logical ``Tape``.
+
+_INT_KINDS = ("i8", "i16", "i32")
+_KIND_DTYPE = {
+    "i8": np.int8,
+    "i16": np.int16,
+    "i32": np.int32,
+    "f32": np.float32,
+    "b": np.bool_,
+}
+
+
+def _int_kind(lo: int, hi: int) -> str:
+    if -128 <= lo and hi <= 127:
+        return "i8"
+    if -32768 <= lo and hi <= 32767:
+        return "i16"
+    return "i32"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class WireTape:
+    """Narrow on-the-wire micro-batch; ``expand()`` under jit -> ``Tape``."""
+
+    ts: object  # int32[E], rebased, padding = last ts
+    n_valid: object  # int32[1]
+    stream: object  # int8[E] or None (single-stream plans)
+    cols: Dict[str, object]  # key -> narrow array (absent for aliases)
+    kinds: Tuple[Tuple[str, str], ...] = ()  # (key, kind), kind may be alias
+    stream_const: int = -1  # valid when stream is None
+    epoch_i32: int = 0  # int32-wrapped epoch for alias reconstruction
+
+    ts_kind: str = "i32"  # 'i32' absolute | 'd8'/'d16' deltas (+ base)
+    ts_base: object = None  # int32[1], first timestamp (delta kinds)
+
+    @property
+    def capacity(self) -> int:
+        return self.ts.shape[-1]
+
+    def tree_flatten(self):
+        keys = tuple(sorted(self.cols))
+        children = (self.ts, self.n_valid, self.stream, self.ts_base) + tuple(
+            self.cols[k] for k in keys
+        )
+        aux = (keys, self.kinds, self.stream_const, self.epoch_i32,
+               self.ts_kind)
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        keys, kinds, stream_const, epoch_i32, ts_kind = aux
+        ts, n_valid, stream, ts_base = children[:4]
+        cols = dict(zip(keys, children[4:]))
+        return cls(ts, n_valid, stream, cols, kinds, stream_const,
+                   epoch_i32, ts_kind, ts_base)
+
+    def expand(self) -> Tape:
+        import jax.numpy as jnp
+
+        cap = self.ts.shape[-1]
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        valid = iota < self.n_valid[0]
+        if self.ts_kind == "i32":
+            ts = self.ts
+        else:
+            # sorted timestamps travel as per-event deltas; the padding
+            # deltas are 0, which reproduces build_tape's "padding repeats
+            # the last timestamp"
+            ts = self.ts_base[0] + jnp.cumsum(
+                self.ts.astype(jnp.int32), dtype=jnp.int32
+            )
+        if self.stream is None:
+            stream = jnp.where(
+                valid, jnp.int32(self.stream_const), jnp.int32(-1)
+            )
+        else:
+            stream = self.stream.astype(jnp.int32)
+        cols = {}
+        for key, kind in self.kinds:
+            if kind == "alias_ts":
+                cols[key] = ts + jnp.int32(self.epoch_i32)
+            elif kind == "f32" or kind == "b":
+                cols[key] = self.cols[key]
+            else:
+                cols[key] = self.cols[key].astype(jnp.int32)
+        return Tape(ts, stream, valid, cols)
+
+
+def build_wire_tape(
+    spec: TapeSpec,
+    batches: Sequence[EventBatch],
+    epoch_ms: int,
+    sticky_kinds: Dict[str, str],
+    capacity: int | None = None,
+) -> Tuple[WireTape, np.ndarray]:
+    """build_tape + narrowing. ``sticky_kinds`` (mutated) remembers each
+    column's widest kind seen so widths only ever widen (bounded retraces).
+    """
+    tape, prov = build_tape(spec, batches, epoch_ms, capacity)
+    total = sum(len(b) for b in batches)
+    epoch_i32 = int(np.int64(epoch_ms) & 0xFFFFFFFF)
+    if epoch_i32 >= 1 << 31:
+        epoch_i32 -= 1 << 32
+
+    kinds: List[Tuple[str, str]] = []
+    cols: Dict[str, np.ndarray] = {}
+    with np.errstate(over="ignore"):
+        recon = None
+        for key in sorted(tape.cols):
+            col = tape.cols[key]
+            sticky = sticky_kinds.get(key)
+            if col.dtype == np.float32:
+                kind = "f32"
+            elif col.dtype == np.bool_:
+                kind = "b"
+            else:
+                # alias check first (0 wire bytes); sticky 'alias_ts' may
+                # degrade to a real int kind the first time it mismatches
+                kind = None
+                if sticky in (None, "alias_ts"):
+                    if recon is None:
+                        recon = tape.ts[:total] + np.int32(epoch_i32)
+                    if np.array_equal(col[:total], recon):
+                        kind = "alias_ts"
+                if kind is None:
+                    lo, hi = (
+                        (int(col[:total].min()), int(col[:total].max()))
+                        if total
+                        else (0, 0)
+                    )
+                    kind = _int_kind(lo, hi)
+                # widths only widen; alias degrades to measured width
+                if sticky is not None and sticky != kind:
+                    order = ("alias_ts",) + _INT_KINDS
+                    if kind in order and sticky in order:
+                        kind = order[max(order.index(kind),
+                                         order.index(sticky))]
+            sticky_kinds[key] = kind
+            kinds.append((key, kind))
+            if kind != "alias_ts":
+                cols[key] = (
+                    col
+                    if kind in ("f32", "b", "i32")
+                    else col.astype(_KIND_DTYPE[kind])
+                )
+
+    # timestamps: sorted, so deltas are small -> 1-2 wire bytes instead of 4
+    ts_kind = sticky_kinds.get("__ts__")
+    ts_arr = tape.ts
+    ts_base = None
+    if ts_kind != "i32" and total:
+        deltas = np.diff(tape.ts.astype(np.int64), prepend=tape.ts[0])
+        dmax = int(deltas.max()) if len(deltas) else 0
+        dmin = int(deltas.min()) if len(deltas) else 0
+        want = "d8" if 0 <= dmin and dmax <= 127 else (
+            "d16" if 0 <= dmin and dmax <= 32767 else "i32"
+        )
+        order = ("d8", "d16", "i32")
+        if ts_kind in order and want in order:
+            want = order[max(order.index(want), order.index(ts_kind))]
+        ts_kind = want
+        if ts_kind != "i32":
+            ts_base = np.asarray([tape.ts[0]], dtype=np.int32)
+            ts_arr = deltas.astype(
+                np.int8 if ts_kind == "d8" else np.int16
+            )
+    else:
+        ts_kind = "i32"
+    sticky_kinds["__ts__"] = ts_kind
+
+    single = len(spec.stream_codes) == 1
+    stream_const = next(iter(spec.stream_codes.values())) if single else -1
+    narrow_stream_ok = max(spec.stream_codes.values(), default=0) <= 127
+    wire = WireTape(
+        ts=ts_arr,
+        n_valid=np.asarray([total], dtype=np.int32),
+        stream=(
+            None
+            if single
+            else tape.stream.astype(np.int8)
+            if narrow_stream_ok
+            else tape.stream
+        ),
+        cols=cols,
+        kinds=tuple(kinds),
+        stream_const=stream_const,
+        epoch_i32=epoch_i32,
+        ts_kind=ts_kind,
+        ts_base=ts_base,
+    )
+    return wire, prov
+
+
 def build_tape(
     spec: TapeSpec,
     batches: Sequence[EventBatch],
