@@ -1,0 +1,211 @@
+"""REST query-management service.
+
+The reference sketched this API and left every route unimplemented
+(CEPService.scala:43-95: ``/api/v1/queries`` CRUD, all bodies ``???``).
+This is the working version: a small stdlib HTTP server that translates
+REST calls into control-plane events (control/events.py) pushed onto a
+``ControlQueueSource`` that a running Job consumes at micro-batch
+boundaries — the same path a control stream takes (§3.4 of the
+reference: MetadataControlEvent / OperationControlEvent).
+
+Routes (JSON in/out):
+    GET    /api/v1/queries               -> {"queries": [plan ids]}
+    POST   /api/v1/queries   {"cql": s}  -> {"id": plan_id}
+    PUT    /api/v1/queries/<id> {"cql"}  -> {"id": id}
+    DELETE /api/v1/queries/<id>          -> {"id": id}
+    POST   /api/v1/queries/<id>/enable   -> {"id": id}
+    POST   /api/v1/queries/<id>/disable  -> {"id": id}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..control.events import (
+    MetadataControlEvent,
+    OperationControlEvent,
+)
+
+
+class ControlQueueSource:
+    """Push-style control source: the service enqueues events, the job's
+    executor drains them at micro-batch boundaries. Stays open until
+    ``close()`` (a pipeline with a live control service never finishes on
+    its own)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._pending: List[Tuple[int, object]] = []
+        self._clock_ms = 0
+        self._closed = False
+
+    def push(self, event, timestamp_ms: Optional[int] = None) -> None:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("control source closed")
+            ts = (
+                int(timestamp_ms)
+                if timestamp_ms is not None
+                else int(event.created_ms)
+            )
+            self._pending.append((ts, event))
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+
+    def poll(self, max_events: int):
+        with self._lock:
+            take = self._pending[:max_events]
+            self._pending = self._pending[max_events:]
+            done = self._closed and not self._pending
+            # a live (empty) control queue must not hold back the data
+            # watermark: control applies at the next batch boundary anyway
+            wm = np.iinfo(np.int64).max if (done or not self._pending) else (
+                take[-1][0] if take else None
+            )
+            return take, wm, done
+
+
+class QueryControlService:
+    """HTTP facade over a ControlQueueSource (optionally mirroring a live
+    Job for GET /queries)."""
+
+    def __init__(
+        self,
+        control: ControlQueueSource,
+        job=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        validate=None,  # callable(cql) raising on bad queries
+    ) -> None:
+        self.control = control
+        self.job = job
+        self.validate = validate
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                if not n:
+                    return {}
+                try:
+                    return json.loads(self.rfile.read(n))
+                except ValueError:
+                    return {}
+
+            def _route(self):
+                parts = [p for p in self.path.split("/") if p]
+                # expect ['api', 'v1', 'queries', <id>?, <action>?]
+                if parts[:3] != ["api", "v1", "queries"]:
+                    return None
+                return parts[3:]
+
+            def do_GET(self):
+                tail = self._route()
+                if tail is None or tail:
+                    return self._reply(404, {"error": "not found"})
+                ids = (
+                    service.job.plan_ids
+                    if service.job is not None
+                    else []
+                )
+                self._reply(200, {"queries": ids})
+
+            def do_POST(self):
+                tail = self._route()
+                if tail is None:
+                    return self._reply(404, {"error": "not found"})
+                if not tail:  # add query
+                    cql = self._body().get("cql")
+                    if not cql:
+                        return self._reply(400, {"error": "missing cql"})
+                    err = service._check(cql)
+                    if err:
+                        return self._reply(400, {"error": err})
+                    b = MetadataControlEvent.builder()
+                    plan_id = b.add_execution_plan(cql)
+                    service.control.push(b.build())
+                    return self._reply(201, {"id": plan_id})
+                if len(tail) == 2 and tail[1] in ("enable", "disable"):
+                    ev = (
+                        OperationControlEvent.enable_query(tail[0])
+                        if tail[1] == "enable"
+                        else OperationControlEvent.disable_query(tail[0])
+                    )
+                    service.control.push(ev)
+                    return self._reply(200, {"id": tail[0]})
+                self._reply(404, {"error": "not found"})
+
+            def do_PUT(self):
+                tail = self._route()
+                if tail is None or len(tail) != 1:
+                    return self._reply(404, {"error": "not found"})
+                cql = self._body().get("cql")
+                if not cql:
+                    return self._reply(400, {"error": "missing cql"})
+                err = service._check(cql)
+                if err:
+                    return self._reply(400, {"error": err})
+                b = MetadataControlEvent.builder()
+                b.update_execution_plan(tail[0], cql)
+                service.control.push(b.build())
+                self._reply(200, {"id": tail[0]})
+
+            def do_DELETE(self):
+                tail = self._route()
+                if tail is None or len(tail) != 1:
+                    return self._reply(404, {"error": "not found"})
+                b = MetadataControlEvent.builder()
+                b.remove_execution_plan(tail[0])
+                service.control.push(b.build())
+                self._reply(200, {"id": tail[0]})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    def _check(self, cql: str) -> Optional[str]:
+        """Fail-fast validation at the REST boundary (parity with the
+        reference's graph-build-time validateSiddhiApp,
+        AbstractSiddhiOperator.java:291-299). Returns an error string or
+        None."""
+        if self.validate is None:
+            return None
+        try:
+            self.validate(cql)
+            return None
+        except Exception as e:
+            return str(e)
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "QueryControlService":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -23,6 +23,7 @@ state shapes are validated against the running plans' initialized states.
 
 from __future__ import annotations
 
+import os
 import pickle
 from typing import Any, Dict
 
@@ -222,8 +223,14 @@ def _check_compatible(ref, restored, plan_id: str) -> None:
 
 
 def save(job, path: str) -> None:
-    with open(path, "wb") as f:
+    # atomic replace: a crash mid-write (the exact failure checkpoints
+    # exist to survive) must not destroy the previous good checkpoint
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
         pickle.dump(snapshot_job(job), f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def load(job, path: str) -> None:

@@ -282,7 +282,13 @@ class Job:
             self.time_mode == "processing" or self._control_pending[0][0] <= wm
         ):
             _, ev = self._control_pending.pop(0)
-            self._apply_control(ev)
+            try:
+                self._apply_control(ev)
+            except Exception:
+                # a bad dynamic query (e.g. unparsable CQL pushed through
+                # a control channel with no up-front validation) must not
+                # take down the running queries
+                _LOG.exception("control event rejected: %r", ev)
 
     def _watermark(self) -> int:
         wms = self._source_wm + self._control_wm
