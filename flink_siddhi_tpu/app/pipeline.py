@@ -129,8 +129,8 @@ class CEPPipeline:
                 else open(cfg.output_path, "a", encoding="utf-8")
             )
         out = self._out
-        for out_stream, artifacts in plan.output_streams().items():
-            names = artifacts[0].output_schema.field_names
+        for out_stream, schemas in plan.output_streams().items():
+            names = schemas[0].field_names
 
             def sink(ts, row, _names=names, _sid=out_stream):
                 out.write(

@@ -153,6 +153,10 @@ class _PatternSpec:
     proj_fns: List
     out_fields: Tuple[OutputField, ...]
     output_stream: str
+    # per projection: the (elem, col) capture pair when the projection is a
+    # plain capture reference, else None (lets the stacked engine emit
+    # straight from the stacked capture buffers with zero per-query ops)
+    proj_srcs: Tuple[Optional[Tuple[int, str]], ...] = ()
 
     @property
     def n_elements(self) -> int:
@@ -201,7 +205,7 @@ def _build_spec(
         raise SiddhiQLError(
             "select * is not valid for pattern queries; name the captures"
         )
-    proj_fns, out_fields = [], []
+    proj_fns, out_fields, proj_srcs = [], [], []
     for item in q.selector.items:
         if ast.contains_aggregate(item.expr):
             raise SiddhiQLError(
@@ -210,6 +214,22 @@ def _build_spec(
         ce = compile_expr(item.expr, cap_resolver, extensions)
         proj_fns.append(ce.fn)
         out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
+        src = None
+        if isinstance(item.expr, ast.Attr):
+            a = item.expr
+            if a.qualifier is not None:
+                info = cap_resolver._by_alias.get(a.qualifier)
+                if info is not None and a.name in info[2]:
+                    src = (info[0], a.name)
+            else:
+                hits = [
+                    info
+                    for info in cap_resolver._by_alias.values()
+                    if a.name in info[2]
+                ]
+                if len(hits) == 1:
+                    src = (hits[0][0], a.name)
+        proj_srcs.append(src)
     if q.selector.having is not None:
         raise SiddhiQLError("having is not valid on pattern queries")
 
@@ -234,6 +254,7 @@ def _build_spec(
         proj_fns=proj_fns,
         out_fields=tuple(out_fields),
         output_stream=q.output_stream,
+        proj_srcs=tuple(proj_srcs),
     )
 
 
@@ -276,6 +297,189 @@ def _emit_env(spec: _PatternSpec, cap_arrays: Dict) -> ColumnEnv:
 # Engine 1: vectorized chain matcher (all-(1,1) `->` patterns)
 # --------------------------------------------------------------------------
 
+def _as_i32(arr):
+    if arr.dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(arr, jnp.int32)
+    return arr.astype(jnp.int32)
+
+
+def _from_i32(row, dtype):
+    if dtype == jnp.float32:
+        return jax.lax.bitcast_convert_type(row, jnp.float32)
+    return row.astype(dtype)
+
+
+@dataclass(frozen=True)
+class _ChainCfg:
+    """Static (hashable) chain-matcher configuration — everything the
+    vmappable core needs besides data. Two queries with equal cfg can run
+    stacked on a query axis (StackedChainArtifact)."""
+
+    K: int
+    every: bool
+    has_within: bool
+    pairs: Tuple[Tuple[int, str], ...]
+    cap_dtypes: Tuple[str, ...]  # numpy dtype names, per pair
+
+    @staticmethod
+    def of(spec: "_PatternSpec") -> "_ChainCfg":
+        pairs = tuple(_cap_pairs(spec))
+        return _ChainCfg(
+            K=spec.n_elements,
+            every=spec.every,
+            has_within=spec.within is not None,
+            pairs=pairs,
+            cap_dtypes=tuple(
+                np.dtype(spec.cap_dtype[p]).name for p in pairs
+            ),
+        )
+
+
+def _chain_core(
+    cfg: _ChainCfg,
+    P: int,
+    state: Dict,
+    preds,  # bool[K, E]
+    cap_srcs: Dict,  # pair -> value[E]
+    within_val,  # int32 scalar (ignored unless cfg.has_within)
+    ts,  # int32[E]
+    valid,  # bool[E]
+):
+    """One micro-batch of the chain matcher for ONE query: advance carried
+    partials + fresh starts through all elements, find completions, and
+    compact survivors back into the pool. Pure function of arrays + static
+    cfg, so a stacked group of structurally-identical queries runs it
+    under jax.vmap over the leading query axis.
+
+    Returns (new_state, complete[V], emit_ts[V], caps{pair: [V]}).
+    """
+    K = cfg.K
+    E = ts.shape[0]
+    V = P + E
+    pairs = list(cfg.pairs)
+    cap_dtypes = {
+        p: np.dtype(n) for p, n in zip(cfg.pairs, cfg.cap_dtypes)
+    }
+    arange = jnp.arange(E, dtype=jnp.int32)
+
+    # next_idx[k][p] = min q >= p with preds[k][q], else E; padded so a
+    # gather at position E (or beyond-batch) safely reads "no match".
+    nxt = []
+    for k in range(1, K):
+        idx = jnp.where(preds[k], arange, E)
+        scanned = jax.lax.associative_scan(jnp.minimum, idx, reverse=True)
+        nxt.append(
+            jnp.concatenate([scanned, jnp.asarray([E], dtype=jnp.int32)])
+        )
+    ts_pad = jnp.concatenate([ts, jnp.asarray([0], dtype=jnp.int32)])
+    env_pad = {
+        pair: jnp.concatenate(
+            [cap_srcs[pair], jnp.zeros(1, dtype=cap_srcs[pair].dtype)]
+        )
+        for pair in pairs
+    }
+
+    # fresh starts: one candidate per tape position matching element 0
+    starts = preds[0]
+    if not cfg.every:
+        starts = starts & ~state["done"]
+    v_active = jnp.concatenate([state["active"], starts])
+    v_step = jnp.concatenate([state["step"], jnp.ones(E, dtype=jnp.int32)])
+    # search position: carried partials resume at batch start
+    v_pos = jnp.concatenate([jnp.zeros(P, dtype=jnp.int32), arange + 1])
+    v_start = jnp.concatenate([state["start"], ts])
+    # fresh starts already completed element 0 at their own position, so a
+    # single-element pattern (K == 1) emits at the start event's ts; K > 1
+    # overwrites this on the final advance
+    v_emit_ts = jnp.concatenate([jnp.zeros(P, dtype=jnp.int32), ts])
+    caps = {}
+    for pair in pairs:
+        elem, _col = pair
+        src = env_pad[pair][:E]
+        fresh = (
+            src if elem == 0 else jnp.zeros(E, dtype=cap_dtypes[pair])
+        )
+        caps[pair] = jnp.concatenate([state[_skey("cap", *pair)], fresh])
+
+    # advance every partial through all remaining elements (K-1 gathers)
+    for k in range(1, K):
+        at_k = v_active & (v_step == k)
+        j = nxt[k - 1][jnp.clip(v_pos, 0, E)]
+        found = at_k & (j < E)
+        ts_j = ts_pad[j]
+        if cfg.has_within:
+            ok = (ts_j - v_start) <= within_val
+            dead = found & ~ok
+            found = found & ok
+            v_active = v_active & ~dead
+        for pair in pairs:
+            if pair[0] == k:
+                v = env_pad[pair][j]
+                caps[pair] = jnp.where(found, v, caps[pair])
+        v_step = jnp.where(found, k + 1, v_step)
+        v_pos = jnp.where(found, j + 1, v_pos)
+        if k == K - 1:
+            v_emit_ts = jnp.where(found, ts_j, v_emit_ts)
+
+    complete = v_active & (v_step == K)
+    if not cfg.every:
+        # exactly one match: earliest start, then earliest completion
+        # (two-stage int32 argmin; device has no int64)
+        start_key = jnp.where(complete, v_start, _BIG)
+        min_start = jnp.min(start_key)
+        emit_key = jnp.where(
+            complete & (v_start == min_start), v_emit_ts, _BIG
+        )
+        winner = jnp.argmin(emit_key)
+        one = jnp.zeros(V, dtype=bool).at[winner].set(True)
+        complete = complete & one & ~state["done"]
+        new_done = state["done"] | complete.any()
+    else:
+        new_done = state["done"]
+
+    # survivors -> new pool: one-scatter compaction over a stacked
+    # (state-row, V) matrix. The v ordering (carried pool first, then
+    # fresh starts in tape order) is already oldest-start-first for
+    # time-ordered batches, so on overflow the newest partials drop.
+    survive = v_active & (v_step < K)
+    if cfg.has_within:
+        batch_max = jnp.max(jnp.where(valid, ts, -_BIG))
+        survive = survive & ((batch_max - v_start) <= within_val)
+    keep_pos = jnp.cumsum(survive.astype(jnp.int32)) - 1
+    pool_dest = jnp.where(survive & (keep_pos < P), keep_pos, P)
+    n_survive = survive.sum().astype(jnp.int32)
+
+    pool_rows = jnp.stack(
+        [_as_i32(survive), v_step, v_start]
+        + [_as_i32(caps[pair]) for pair in pairs]
+    )
+    pool_fill = jnp.concatenate(
+        [
+            jnp.asarray([0, 1, 0], dtype=jnp.int32),
+            jnp.zeros(len(pairs), dtype=jnp.int32),
+        ]
+    )
+    pool_packed = (
+        jnp.broadcast_to(pool_fill[:, None], (pool_rows.shape[0], P))
+        .at[:, pool_dest]
+        .set(pool_rows, mode="drop")
+    )
+    new_state = {
+        "enabled": state["enabled"],
+        "active": pool_packed[0].astype(bool),
+        "step": pool_packed[1],
+        "start": pool_packed[2],
+        "done": new_done,
+        "overflow": state["overflow"]
+        + jnp.maximum(n_survive - P, 0).astype(jnp.int32),
+    }
+    for j, pair in enumerate(pairs):
+        new_state[_skey("cap", *pair)] = _from_i32(
+            pool_packed[3 + j], cap_dtypes[pair]
+        )
+    return new_state, complete, v_emit_ts, caps
+
+
 def _is_chain(spec: _PatternSpec) -> bool:
     return spec.kind == "pattern" and all(
         el.min_count == 1 and el.max_count == 1 for el in spec.elements
@@ -294,7 +498,9 @@ class ChainPatternArtifact:
     name: str
     spec: _PatternSpec
     output_schema: OutputSchema
-    output_mode: str = "buffered"
+    # 'packed': step returns (n, (1+C, V) int32 block) — ts row 0, one
+    # bitcast row per projection — the accumulator append layout
+    output_mode: str = "packed"
     pool: int = DEFAULT_PARTIAL_POOL
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
@@ -320,104 +526,26 @@ class ChainPatternArtifact:
 
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         spec = self.spec
-        K = spec.n_elements
         E = tape.capacity
         P = self.pool
         V = P + E  # virtual partial set: carried pool ++ fresh starts
         pairs = _cap_pairs(spec)
 
-        preds = _element_preds(spec, tape, state["enabled"])
-        arange = jnp.arange(E, dtype=jnp.int32)
-
-        # next_idx[k][p] = min q >= p with preds[k][q], else E; padded so a
-        # gather at position E (or beyond-batch) safely reads "no match".
-        nxt = []
-        for k in range(1, K):
-            idx = jnp.where(preds[k], arange, E)
-            scanned = jax.lax.associative_scan(
-                jnp.minimum, idx, reverse=True
-            )
-            nxt.append(jnp.concatenate(
-                [scanned, jnp.asarray([E], dtype=jnp.int32)]
-            ))
-        ts_pad = jnp.concatenate(
-            [tape.ts, jnp.asarray([0], dtype=jnp.int32)]
-        )
-        env_pad = {
-            key: jnp.concatenate(
-                [tape.cols[key], jnp.zeros(1, dtype=tape.cols[key].dtype)]
-            )
-            for key in {spec.cap_src_key[p] for p in pairs}
+        preds = jnp.stack(_element_preds(spec, tape, state["enabled"]))
+        cap_srcs = {
+            pair: tape.cols[spec.cap_src_key[pair]] for pair in pairs
         }
-
-        # fresh starts: one candidate per tape position matching element 0
-        starts = preds[0] & ~(jnp.asarray(not spec.every) & state["done"])
-        v_active = jnp.concatenate([state["active"], starts])
-        v_step = jnp.concatenate(
-            [state["step"], jnp.ones(E, dtype=jnp.int32)]
+        within_val = jnp.int32(
+            spec.within if spec.within is not None else 0
         )
-        # search position: carried partials resume at batch start
-        v_pos = jnp.concatenate(
-            [jnp.zeros(P, dtype=jnp.int32), arange + 1]
+        state, complete, v_emit_ts, caps = _chain_core(
+            _ChainCfg.of(spec), P, state, preds, cap_srcs, within_val,
+            tape.ts, tape.valid,
         )
-        v_start = jnp.concatenate([state["start"], tape.ts])
-        # fresh starts already completed element 0 at their own position, so
-        # a single-element pattern (K == 1) emits at the start event's ts;
-        # K > 1 overwrites this on the final advance
-        v_emit_ts = jnp.concatenate(
-            [jnp.zeros(P, dtype=jnp.int32), tape.ts]
-        )
-        caps = {}
-        for pair in pairs:
-            elem, col = pair
-            src = env_pad[spec.cap_src_key[pair]][:E]
-            fresh = (
-                src
-                if elem == 0
-                else jnp.zeros(E, dtype=spec.cap_dtype[pair])
-            )
-            caps[pair] = jnp.concatenate([state[_skey("cap", *pair)], fresh])
-
-        # advance every partial through all remaining elements (K-1 gathers)
-        for k in range(1, K):
-            at_k = v_active & (v_step == k)
-            j = nxt[k - 1][jnp.clip(v_pos, 0, E)]
-            found = at_k & (j < E)
-            ts_j = ts_pad[j]
-            if spec.within is not None:
-                ok = (ts_j - v_start) <= jnp.int32(spec.within)
-                dead = found & ~ok
-                found = found & ok
-                v_active = v_active & ~dead
-            for pair in pairs:
-                if pair[0] == k:
-                    v = env_pad[spec.cap_src_key[pair]][j]
-                    caps[pair] = jnp.where(found, v, caps[pair])
-            v_step = jnp.where(found, k + 1, v_step)
-            v_pos = jnp.where(found, j + 1, v_pos)
-            if k == K - 1:
-                v_emit_ts = jnp.where(found, ts_j, v_emit_ts)
-
-        complete = v_active & (v_step == K)
-        if not spec.every:
-            # exactly one match: earliest start, then earliest completion
-            # (two-stage int32 argmin; device has no int64)
-            start_key = jnp.where(complete, v_start, _BIG)
-            min_start = jnp.min(start_key)
-            emit_key = jnp.where(
-                complete & (v_start == min_start), v_emit_ts, _BIG
-            )
-            winner = jnp.argmin(emit_key)
-            one = jnp.zeros(V, dtype=bool).at[winner].set(True)
-            complete = complete & one & ~state["done"]
-            new_done = state["done"] | complete.any()
-        else:
-            new_done = state["done"]
-
         # emit matches: O(V) cumsum-scatter compaction into the first
-        # n_matches rows (a full argsort of V keys is the single most
-        # expensive op on TPU here — sort networks are n log^2 n; the final
-        # by-timestamp ordering is done on host over the n decoded rows)
+        # n_matches rows; all output rows (ts + projections) compact
+        # through ONE scatter. The packed (1+C, V) int32 block is exactly
+        # the accumulator's append layout (plan.step_acc).
         n_matches = complete.sum().astype(jnp.int32)
         emit_pos = jnp.cumsum(complete.astype(jnp.int32)) - 1
         emit_dest = jnp.where(complete, emit_pos, V)  # V -> dropped
@@ -428,54 +556,247 @@ class ChainPatternArtifact:
                 for elem, col, which in spec.captures
             },
         )
-        out_cols = tuple(
-            jnp.zeros(V, dtype=jnp.result_type(jnp.asarray(p(emit_env))))
-            .at[emit_dest]
-            .set(jnp.broadcast_to(jnp.asarray(p(emit_env)), (V,)),
-                 mode="drop")
-            for p in spec.proj_fns
+        emit_rows = jnp.stack(
+            [_as_i32(v_emit_ts)]
+            + [
+                _as_i32(jnp.broadcast_to(jnp.asarray(p(emit_env)), (V,)))
+                for p in spec.proj_fns
+            ]
         )
-        out_ts = (
-            jnp.zeros(V, dtype=jnp.int32)
-            .at[emit_dest]
-            .set(v_emit_ts, mode="drop")
+        packed = (
+            jnp.zeros_like(emit_rows)
+            .at[:, emit_dest]
+            .set(emit_rows, mode="drop")
         )
+        return state, (n_matches, packed)
 
-        # survivors -> new pool, same cumsum-scatter compaction. The v
-        # ordering (carried pool first, then fresh starts in tape order) is
-        # already oldest-start-first for time-ordered batches, so on
-        # overflow the newest partials are the ones dropped.
-        survive = v_active & (v_step < K)
-        if spec.within is not None:
-            batch_max = jnp.max(jnp.where(tape.valid, tape.ts, -_BIG))
-            survive = survive & (
-                (batch_max - v_start) <= jnp.int32(spec.within)
-            )
-        keep_pos = jnp.cumsum(survive.astype(jnp.int32)) - 1
-        pool_dest = jnp.where(survive & (keep_pos < P), keep_pos, P)
-        n_survive = survive.sum().astype(jnp.int32)
 
-        def compact(vals, fill, dtype):
-            return (
-                jnp.full((P,), fill, dtype=dtype)
-                .at[pool_dest]
-                .set(vals, mode="drop")
-            )
+# --------------------------------------------------------------------------
+# Engine 1b: stacked chain matcher — N structurally-identical chain queries
+# advanced by ONE vmapped program (multi-query parallelism, the reference's
+# one-runtime-per-plan fan-out re-expressed as a device query axis;
+# SURVEY.md §2.7-(5), AbstractSiddhiOperator.java:112,301-313)
+# --------------------------------------------------------------------------
 
-        new_state = {
-            "enabled": state["enabled"],
-            "active": compact(survive, False, bool),
-            "step": compact(v_step, 1, jnp.int32),
-            "start": compact(v_start, 0, jnp.int32),
-            "done": new_done,
-            "overflow": state["overflow"]
-            + jnp.maximum(n_survive - P, 0).astype(jnp.int32),
+@dataclass
+class StackedChainArtifact:
+    """A group of chain patterns sharing one ``_ChainCfg``: their per-query
+    predicates/captures/projections are stacked as data and the chain
+    advance runs once under ``jax.vmap`` over the query axis — per-step
+    device op count is O(1) in the number of queries, not O(Q).
+
+    Emissions from all member queries compact through one scatter into a
+    single packed block with a query-id row; the host splits rows back to
+    each member's output stream at decode time."""
+
+    name: str
+    members: List[ChainPatternArtifact]
+    output_mode: str = "packed"
+    out_cap_factor: int = 2  # emission buffer width = factor*E + pool
+
+    def __post_init__(self):
+        self.pool = self.members[0].pool
+        self._cfg = _ChainCfg.of(self.members[0].spec)
+        assert all(
+            _ChainCfg.of(m.spec) == self._cfg for m in self.members
+        ), "stacked members must share a chain signature"
+
+    @property
+    def output_schema(self) -> OutputSchema:
+        # representative — members share field structure; decode routes
+        # rows to each member's own stream via the qid row
+        return self.members[0].output_schema
+
+    @property
+    def acc_rows(self) -> int:
+        return 2 + len(self.output_schema.fields)  # ts + qid + columns
+
+    def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
+        return self.out_cap_factor * tape_capacity + self.pool
+
+    def init_state(self) -> Dict:
+        Q = len(self.members)
+        P = self.pool
+        state = {
+            "enabled": jnp.ones(Q, dtype=bool),
+            "active": jnp.zeros((Q, P), dtype=bool),
+            "step": jnp.ones((Q, P), dtype=jnp.int32),
+            "start": jnp.zeros((Q, P), dtype=jnp.int32),
+            "done": jnp.zeros(Q, dtype=bool),
+            "overflow": jnp.zeros(Q, dtype=jnp.int32),
         }
-        for pair in pairs:
-            new_state[_skey("cap", *pair)] = compact(
-                caps[pair], 0, spec.cap_dtype[pair]
+        spec0 = self.members[0].spec
+        for pair in _cap_pairs(spec0):
+            state[_skey("cap", *pair)] = jnp.zeros(
+                (Q, P), dtype=spec0.cap_dtype[pair]
             )
-        return new_state, (n_matches, out_ts, out_cols)
+        return state
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        cfg = self._cfg
+        E = tape.capacity
+        P = self.pool
+        V = P + E
+        Q = len(self.members)
+
+        preds = jnp.stack(
+            [
+                jnp.stack(
+                    _element_preds(m.spec, tape, state["enabled"][qi])
+                )
+                for qi, m in enumerate(self.members)
+            ]
+        )  # (Q, K, E)
+        cap_srcs = {
+            pair: jnp.stack(
+                [
+                    tape.cols[m.spec.cap_src_key[pair]]
+                    for m in self.members
+                ]
+            )
+            for pair in cfg.pairs
+        }
+        within_vec = jnp.asarray(
+            [m.spec.within or 0 for m in self.members], dtype=jnp.int32
+        )
+
+        new_state, complete, emit_ts, caps = jax.vmap(
+            lambda st, pr, cs, wv: _chain_core(
+                cfg, P, st, pr, cs, wv, tape.ts, tape.valid
+            )
+        )(state, preds, cap_srcs, within_vec)
+
+        # projections: when every member's column c is the same plain
+        # capture reference (the overwhelmingly common select shape), the
+        # stacked output rows ARE the stacked capture buffers — zero
+        # per-query ops. Otherwise fall back to a per-member eval loop.
+        qid_row = jnp.broadcast_to(
+            jnp.arange(Q, dtype=jnp.int32)[:, None], (Q, V)
+        )
+        n_cols = len(self.members[0].spec.proj_fns)
+        col_srcs = []
+        uniform = True
+        for c in range(n_cols):
+            srcs = {m.spec.proj_srcs[c] for m in self.members}
+            if len(srcs) == 1 and None not in srcs:
+                col_srcs.append(next(iter(srcs)))
+            else:
+                uniform = False
+                break
+        if uniform:
+            stacked_rows = [_as_i32(emit_ts), qid_row] + [
+                _as_i32(caps[pair]) for pair in col_srcs
+            ]
+            flat_rows = jnp.stack(
+                [r.reshape(Q * V) for r in stacked_rows]
+            )
+            R = len(stacked_rows)
+        else:
+            rows_per_q = []
+            for qi, m in enumerate(self.members):
+                env = _emit_env(
+                    m.spec,
+                    {
+                        (e, c, w): caps[(e, c)][qi]
+                        for e, c, w in m.spec.captures
+                    },
+                )
+                rows_per_q.append(
+                    jnp.stack(
+                        [
+                            _as_i32(emit_ts[qi]),
+                            jnp.full(V, qi, dtype=jnp.int32),
+                        ]
+                        + [
+                            _as_i32(
+                                jnp.broadcast_to(
+                                    jnp.asarray(p(env)), (V,)
+                                )
+                            )
+                            for p in m.spec.proj_fns
+                        ]
+                    )
+                )
+            R = rows_per_q[0].shape[0]
+            flat_rows = (
+                jnp.stack(rows_per_q)
+                .transpose(1, 0, 2)
+                .reshape(R, Q * V)
+            )
+        cflat = complete.reshape(Q * V)
+        n_total = cflat.sum().astype(jnp.int32)
+        out_w = min(Q * V, self.out_cap_factor * E + P)
+        pos = jnp.cumsum(cflat.astype(jnp.int32)) - 1
+        dest = jnp.where(cflat & (pos < out_w), pos, out_w)
+        packed = (
+            jnp.zeros((R, out_w), dtype=jnp.int32)
+            .at[:, dest]
+            .set(flat_rows, mode="drop")
+        )
+        n_emitted = jnp.minimum(n_total, jnp.int32(out_w))
+        # matches beyond the emission buffer are genuinely dropped; the
+        # third element feeds the accumulator's drained overflow counter
+        return new_state, (n_emitted, packed, n_total - n_emitted)
+
+    def decode_packed(self, n: int, block: np.ndarray):
+        """Split a fetched packed block into per-member (schema, rows)."""
+        out = []
+        qid = block[1, :n]
+        for qi, m in enumerate(self.members):
+            sel = np.nonzero(qid == qi)[0]
+            if sel.size == 0:
+                continue
+            schema = m.output_schema
+            cols = []
+            for j, f in enumerate(schema.fields):
+                raw = block[2 + j, :n][sel]
+                if np.dtype(f.atype.device_dtype) == np.dtype(
+                    np.float32
+                ):
+                    raw = raw.view(np.float32)
+                cols.append(raw)
+            rows = schema.decode_buffered(
+                int(sel.size), block[0, :n][sel], cols
+            )
+            out.append((schema, rows))
+        return out
+
+
+def group_chain_artifacts(artifacts: List) -> List:
+    """Replace runs of structurally-identical ChainPatternArtifacts with
+    one StackedChainArtifact (multi-query parallelism)."""
+    groups: Dict = {}
+    for a in artifacts:
+        if isinstance(a, ChainPatternArtifact):
+            key = (
+                _ChainCfg.of(a.spec),
+                a.pool,
+                tuple(
+                    np.dtype(f.atype.device_dtype).name
+                    for f in a.output_schema.fields
+                ),
+            )
+            groups.setdefault(key, []).append(a)
+    stacked_of = {}
+    for key, members in groups.items():
+        if len(members) >= 2:
+            stacked = StackedChainArtifact(
+                name="@stack:" + members[0].name,
+                members=members,
+            )
+            for m in members:
+                stacked_of[m.name] = stacked
+    if not stacked_of:
+        return artifacts
+    out, added = [], set()
+    for a in artifacts:
+        s = stacked_of.get(getattr(a, "name", None))
+        if s is None:
+            out.append(a)
+        elif s.name not in added:
+            out.append(s)
+            added.add(s.name)
+    return out
 
 
 # --------------------------------------------------------------------------

@@ -104,7 +104,10 @@ class CompiledPlan:
         out = []
         row = 0
         for a in self.artifacts:
-            n_rows = 1 + len(a.output_schema.fields)  # ts + columns
+            # default: ts + columns; stacked artifacts add a query-id row
+            n_rows = getattr(
+                a, "acc_rows", 1 + len(a.output_schema.fields)
+            )
             out.append((row, n_rows))
             row += n_rows
         return out
@@ -146,26 +149,45 @@ class CompiledPlan:
             zip(self.artifacts, self.acc_layout())
         ):
             out = outputs[a.name]
-            if a.output_mode == "aligned":
+            if a.output_mode == "packed":
+                # artifact already emits the accumulator block layout;
+                # an optional third element counts matches it had to drop
+                # before packing (stacked emission buffer overflow)
+                n, block = out[0], out[1]
+                pre_dropped = (
+                    out[2].astype(jnp.int32)
+                    if len(out) > 2
+                    else jnp.int32(0)
+                )
+                over = over.at[ai].add(pre_dropped)
+                n = n.astype(jnp.int32)
+            elif a.output_mode == "aligned":
                 mask, ts, cols = out
                 n = mask.sum().astype(jnp.int32)
-                # O(V) front-compaction, tape order kept (no sort)
+                # O(V) front-compaction, tape order kept (no sort); all
+                # rows compact through ONE scatter (per-fusion launch
+                # overhead dominates at micro-batch sizes)
                 vlen = int(mask.shape[0])
                 pos = jnp.cumsum(mask.astype(jnp.int32)) - 1
                 dest = jnp.where(mask, pos, vlen)
-                rows = [
-                    jnp.zeros(vlen, dtype=r.dtype)
-                    .at[dest]
-                    .set(r, mode="drop")
-                    for r in [ts] + [jnp.asarray(c) for c in cols]
-                ]
+                src = jnp.stack(
+                    [self._to_i32_row(r)
+                     for r in [ts] + [jnp.asarray(c) for c in cols]]
+                )
+                block = (
+                    jnp.zeros_like(src)
+                    .at[:, dest]
+                    .set(src, mode="drop")
+                )
             else:
                 n, ts, cols = out
                 n = n.astype(jnp.int32)
-                rows = [ts] + [jnp.asarray(c) for c in cols]
-            v = int(rows[0].shape[0])
+                block = jnp.stack(
+                    [self._to_i32_row(r)
+                     for r in [ts] + [jnp.asarray(c) for c in cols]]
+                )
+            v = int(block.shape[1])
             n_true = n
-            block = jnp.stack([self._to_i32_row(r) for r in rows])
             if v > cap:
                 # block wider than the whole accumulator (huge batch or
                 # tiny budget): degrade to drain-every-batch granularity;
@@ -193,11 +215,12 @@ class CompiledPlan:
         }
 
     def drain_decode(self, counts: np.ndarray, data: np.ndarray
-                     ) -> Dict[str, List[Tuple[int, Tuple]]]:
+                     ) -> Dict[str, List]:
         """Host side of a drain: unpack the fetched buffer slice into
-        decoded (ts, row) lists per artifact name. ``data`` is
-        ``buf[:, :max(counts)]`` already on host."""
-        out: Dict[str, List[Tuple[int, Tuple]]] = {}
+        per-artifact lists of (output_schema, decoded rows). ``data`` is
+        ``buf[:, :max(counts)]`` already on host. Stacked multi-query
+        artifacts route their rows to each member's own stream."""
+        out: Dict[str, List] = {}
         for ai, (a, (row0, n_rows)) in enumerate(
             zip(self.artifacts, self.acc_layout())
         ):
@@ -206,15 +229,19 @@ class CompiledPlan:
                 out[a.name] = []
                 continue
             block = data[row0:row0 + n_rows, :n]
+            if hasattr(a, "decode_packed"):
+                out[a.name] = a.decode_packed(n, block)
+                continue
             cols = []
             for j, f in enumerate(a.output_schema.fields):
                 raw = block[1 + j]
                 if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
                     raw = raw.view(np.float32)
                 cols.append(raw)
-            out[a.name] = a.output_schema.decode_buffered(
-                n, block[0], cols
-            )
+            out[a.name] = [(
+                a.output_schema,
+                a.output_schema.decode_buffered(n, block[0], cols),
+            )]
         return out
 
     @property
@@ -228,9 +255,17 @@ class CompiledPlan:
         raise KeyError(name)
 
     def output_streams(self) -> Dict[str, List]:
+        """stream_id -> [OutputSchema] writing to it (a stacked group
+        contributes every member's schema)."""
         by_stream: Dict[str, List] = {}
         for a in self.artifacts:
-            by_stream.setdefault(a.output_schema.stream_id, []).append(a)
+            schemas = (
+                [m.output_schema for m in a.members]
+                if hasattr(a, "members")
+                else [a.output_schema]
+            )
+            for sch in schemas:
+                by_stream.setdefault(sch.stream_id, []).append(sch)
         return by_stream
 
 
@@ -319,6 +354,13 @@ def compile_plan(
         )
         encoded.extend(getattr(art, "encoded_columns", ()))
         artifacts.append(art)
+
+    # multi-query parallelism: structurally-identical chain patterns are
+    # stacked onto a device query axis and advanced by one vmapped program
+    # (SURVEY.md §2.7-(5))
+    from .nfa import group_chain_artifacts
+
+    artifacts = group_chain_artifacts(artifacts)
 
     spec = TapeSpec(
         stream_codes, tuple(columns), column_types, tuple(encoded)

@@ -275,7 +275,172 @@ class SlidingWindowArtifact:
             ring[f"g{j}"] = jnp.zeros(C, dt)
         return {"enabled": jnp.asarray(True), "ring": ring}
 
+    def _prefixable(self) -> bool:
+        """Length windows whose aggregates distribute over +/- can use the
+        O((E+C) log) arrival/expiry formulation instead of the O(E*C)
+        window matrix (catastrophic for large windows: a length(1000)
+        window over a 131k batch materializes 131M-element gathers)."""
+        return self.window_mode == "length" and all(
+            a.kind in ("count", "sum", "avg", "stddev") for a in self.aggs
+        )
+
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        if self._prefixable():
+            return self._step_prefix(state, tape)
+        return self._step_matrix(state, tape)
+
+    def _step_prefix(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        """Sliding length-window aggregation as a difference of per-group
+        running sums over a merged arrival/expiry event sequence.
+
+        Window semantics (identical to the matrix path / Siddhi): the
+        window at event k holds the last C *matching* events up to and
+        including k; group-by aggregates over the window members of k's
+        group. Each arrival at compacted position p contributes +v, and
+        expires (-v) at position p+C; the per-group running sum of the
+        merged sequence, sampled at k's arrival, is exactly the windowed
+        aggregate. One stable sort groups the sequence; segmented scans
+        do the rest.
+        """
+        env: ColumnEnv = dict(tape.cols)
+        mask = tape.valid & (tape.stream == self.stream_code)
+        for f in self.filter_fns:
+            mask = mask & f(env)
+        mask = mask & state["enabled"]
+        E = tape.capacity
+        C = self.capacity
+        ring = state["ring"]
+
+        order = jnp.argsort(jnp.logical_not(mask))  # matching first, stable
+        M = mask.sum()
+        rank = jnp.cumsum(mask) - 1
+
+        def cat(ring_col, col):
+            col = jnp.broadcast_to(jnp.asarray(col), (E,))
+            return jnp.concatenate(
+                [ring_col, col[order].astype(ring_col.dtype)]
+            )
+
+        c_cols: Dict[str, jnp.ndarray] = {}
+        for j, fn in enumerate(self.arg_fns):
+            c_cols[f"a{j}"] = cat(ring[f"a{j}"], fn(env))
+        for j, fn in enumerate(self.group_fns):
+            c_cols[f"g{j}"] = cat(ring[f"g{j}"], fn(env))
+        ts_col = env[self.ts_key] if self.ts_key else tape.ts
+        c_cols["ts"] = cat(ring["ts"], ts_col)
+        cval = jnp.concatenate(
+            [ring["valid"], jnp.arange(E) < M]
+        )
+        N = C + E
+
+        # merged sequence: N arrivals (+) then N expiries (-), expiry of
+        # position p lands at p+C and is ordered BEFORE an arrival at the
+        # same position (window is (k-C, k])
+        pos = jnp.arange(N, dtype=jnp.int32)
+        key2 = jnp.concatenate([pos * 2 + 1, (pos + C) * 2])
+        sign2 = jnp.concatenate(
+            [jnp.ones(N, jnp.int32), jnp.full(N, -1, jnp.int32)]
+        )
+        live2 = jnp.concatenate([cval, cval])
+
+        # stable group ordering: sort by position key, then stably by each
+        # group column (reversed), so entries of one group stay merged in
+        # position order
+        o = jnp.argsort(key2, stable=True)
+        for j in reversed(range(len(self.group_fns))):
+            g2 = jnp.concatenate([c_cols[f"g{j}"]] * 2)
+            o = o[jnp.argsort(g2[o], stable=True)]
+        seg_start = jnp.zeros(2 * N, dtype=bool).at[0].set(True)
+        for j in range(len(self.group_fns)):
+            g2 = jnp.concatenate([c_cols[f"g{j}"]] * 2)
+            go = g2[o]
+            seg_start = seg_start | jnp.concatenate(
+                [jnp.ones(1, bool), go[1:] != go[:-1]]
+            )
+        live_o = live2[o]
+
+        inv = jnp.zeros(2 * N, jnp.int32).at[o].set(
+            jnp.arange(2 * N, dtype=jnp.int32)
+        )
+        arrival_idx = inv[:N]  # where each arrival sits in sorted order
+
+        def windowed(vals):
+            # exact integer window sums stay integer; floats run in f32
+            sgn = sign2.astype(vals.dtype)
+            v2 = jnp.concatenate([vals] * 2)[o]
+            v2 = jnp.where(live_o, v2 * sgn[o], jnp.zeros((), vals.dtype))
+            cums = _seg_scan(seg_start, v2, lambda a, b: a + b)
+            return cums[arrival_idx]  # per concat-arrival window sum
+
+        stats: Dict[str, jnp.ndarray] = {}
+        need_count = any(
+            a.kind in ("count", "avg", "stddev") for a in self.aggs
+        )
+        if need_count:
+            stats["cnt"] = windowed(jnp.ones(N, jnp.int32))
+        for j in range(len(self.arg_fns)):
+            kinds = {
+                a.kind for a in self.aggs if a.arg_idx == j
+            }
+            if kinds & {"sum", "avg", "stddev"}:
+                a_col = c_cols[f"a{j}"]
+                if jnp.issubdtype(a_col.dtype, jnp.floating):
+                    a_col = a_col.astype(jnp.float32)
+                stats[f"s{j}"] = windowed(a_col)
+            if "stddev" in kinds:
+                v = c_cols[f"a{j}"].astype(jnp.float32)
+                stats[f"q{j}"] = windowed(v * v)
+
+        def unsort(concat_vals, dtype):
+            # concat arrival i corresponds to compacted batch index i-C;
+            # map back to tape order through rank
+            batch_vals = concat_vals[C + jnp.clip(rank, 0)]
+            return jnp.where(mask, batch_vals, 0).astype(dtype)
+
+        slot_types: Dict[str, AttributeType] = {}
+        for agg in self.aggs:
+            if agg.kind == "count":
+                rows = stats["cnt"]
+            elif agg.kind == "sum":
+                rows = stats[f"s{agg.arg_idx}"]
+                if not jnp.issubdtype(
+                    agg.out_type.device_dtype, jnp.floating
+                ):
+                    rows = jnp.round(rows)
+            elif agg.kind == "avg":
+                rows = stats[f"s{agg.arg_idx}"] / jnp.maximum(
+                    stats["cnt"], 1.0
+                )
+            else:  # stddev
+                c = jnp.maximum(stats["cnt"], 1.0)
+                mean = stats[f"s{agg.arg_idx}"] / c
+                rows = jnp.sqrt(
+                    jnp.maximum(
+                        stats[f"q{agg.arg_idx}"] / c - mean * mean, 0.0
+                    )
+                )
+            env[agg.slot] = unsort(rows, agg.out_type.device_dtype)
+            slot_types[agg.slot] = agg.out_type
+
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(env)), (E,))
+            for p in self.proj_fns
+        )
+        out_mask = mask
+        if self.having_fn is not None:
+            henv = dict(env)
+            for f, c in zip(self.output_schema.fields, cols):
+                henv[f"@out:{f.name}"] = c
+            out_mask = out_mask & self.having_fn(henv)
+
+        new_ring = {
+            k: lax.dynamic_slice(v, (M,), (C,)) for k, v in c_cols.items()
+        }
+        new_ring["valid"] = lax.dynamic_slice(cval, (M,), (C,))
+        new_state = {"enabled": state["enabled"], "ring": new_ring}
+        return new_state, (out_mask, tape.ts, cols)
+
+    def _step_matrix(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)
         for f in self.filter_fns:

@@ -273,7 +273,13 @@ class ColumnDecoder:
                 ok = False
             for i, (name, kind, table) in enumerate(self.fields):
                 v = rec.get(name) if ok else None
-                outs[i][row] = self._coerce(v, kind, table)
+                try:
+                    outs[i][row] = self._coerce(v, kind, table)
+                except (TypeError, ValueError):
+                    # type-mismatched value: row invalid, like the native
+                    # decoder's failed parse
+                    outs[i][row] = self._coerce(None, kind, table)
+                    ok = False
             valid[row] = 1 if ok else 0
             row += 1
         return [o[:row] for o in outs], valid[:row], row
@@ -337,6 +343,9 @@ class ColumnDecoder:
             return table.intern("" if v is None else str(v))
         if v is None:
             return 0
+        if isinstance(v, str):
+            # native decoder rejects quoted values for numeric fields
+            raise ValueError(f"numeric field got string {v!r}")
         if kind == KIND_DOUBLE:
             return float(v)
         return int(v)

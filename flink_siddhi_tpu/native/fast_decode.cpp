@@ -388,6 +388,9 @@ long long fd_decode_csv(const char* buf, long long buflen, const int* kinds,
             if (f.kind == 2) {
                 static_cast<int64_t*>(f.out)[row] =
                     f.interner->intern(cell, len);
+            } else if (cell == cell_end) {
+                ok = false;  // empty numeric cell: invalid row
+                break;
             } else if (f.kind == 1) {
                 char* ep = nullptr;
                 double v = std::strtod(cell, &ep);

@@ -209,7 +209,8 @@ class Job:
         rt._overflow_seen = None  # counters reset with the accumulator
         decoded = rt.plan.drain_decode(counts, data)
         for a in rt.plan.artifacts:
-            self._emit_rows(a.output_schema, decoded.get(a.name) or [])
+            for schema, rows in decoded.get(a.name) or []:
+                self._emit_rows(schema, rows)
 
     def _emit_rows(self, schema, rows) -> None:
         """Shared append-to-collectors/sinks tail for all decode paths."""
@@ -383,6 +384,26 @@ class Job:
                 if not mask.any():
                     continue
                 rows = schema.decode_aligned(mask, np.asarray(ts), cols)
+            elif a.output_mode == "packed":
+                count, block = out
+                if int(count) == 0:
+                    continue
+                block = np.asarray(block)
+                if hasattr(a, "decode_packed"):
+                    for sch, rows in a.decode_packed(int(count), block):
+                        self._emit_rows(sch, rows)
+                    continue
+                cols = []
+                for j, f in enumerate(schema.fields):
+                    raw = block[1 + j]
+                    if np.dtype(f.atype.device_dtype) == np.dtype(
+                        np.float32
+                    ):
+                        raw = raw.view(np.float32)
+                    cols.append(raw)
+                rows = schema.decode_buffered(
+                    int(count), block[0], cols
+                )
             else:  # buffered
                 count, ts, cols = out
                 if int(count) == 0:

@@ -261,11 +261,23 @@ class _DecodedLinesSource(Source):
             self._carry, data = data[cut + 1:], data[: cut + 1]
         else:
             self._carry = b""
-            self._done = True
         if not data.strip():
+            self._done = eof
             wm = np.iinfo(np.int64).max if self._done else None
             return None, wm, self._done
         n_lines = data.count(b"\n") + (0 if data.endswith(b"\n") else 1)
+        if n_lines > max_events:
+            # honor the executor's batch size: decode only max_events
+            # lines now, push the rest back in front of the carry
+            nl = np.nonzero(
+                np.frombuffer(data, dtype=np.uint8) == 0x0A
+            )[0]
+            cut = int(nl[max_events - 1]) + 1
+            self._carry = data[cut:] + self._carry
+            data = data[:cut]
+            n_lines = max_events
+            eof = False  # more data pending regardless of file state
+        self._done = eof
         cols, valid, n = self._decode(data, n_lines)
         columns: Dict[str, np.ndarray] = {}
         for (name, kind, table), arr in zip(self._fields, cols):
