@@ -118,6 +118,11 @@ class QueryControlService:
                 return parts[3:]
 
             def do_GET(self):
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["api", "v1", "metrics"]:
+                    if service.job is None:
+                        return self._reply(200, {})
+                    return self._reply(200, service.job.metrics())
                 tail = self._route()
                 if tail is None or tail:
                     return self._reply(404, {"error": "not found"})

@@ -592,7 +592,10 @@ class StackedChainArtifact:
     name: str
     members: List[ChainPatternArtifact]
     output_mode: str = "packed"
-    out_cap_factor: int = 2  # emission buffer width = factor*E + pool
+    # emission buffer width = min(Q, out_cap_factor)*E + Q*pool: lossless
+    # for stacks up to out_cap_factor queries, bounded (with a drained
+    # overflow counter) beyond that
+    out_cap_factor: int = 8
 
     def __post_init__(self):
         self.pool = self.members[0].pool
@@ -612,7 +615,10 @@ class StackedChainArtifact:
         return 2 + len(self.output_schema.fields)  # ts + qid + columns
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
-        return self.out_cap_factor * tape_capacity + self.pool
+        q = len(self.members)
+        return (
+            min(q, self.out_cap_factor) * tape_capacity + q * self.pool
+        )
 
     def init_state(self) -> Dict:
         Q = len(self.members)
@@ -673,7 +679,7 @@ class StackedChainArtifact:
         qid_row = jnp.broadcast_to(
             jnp.arange(Q, dtype=jnp.int32)[:, None], (Q, V)
         )
-        n_cols = len(self.members[0].spec.proj_fns)
+        n_cols = len(self.members[0].spec.proj_fns)  # noqa: F841  (doc)
         col_srcs = []
         uniform = True
         for c in range(n_cols):
@@ -725,7 +731,7 @@ class StackedChainArtifact:
             )
         cflat = complete.reshape(Q * V)
         n_total = cflat.sum().astype(jnp.int32)
-        out_w = min(Q * V, self.out_cap_factor * E + P)
+        out_w = min(Q * V, min(Q, self.out_cap_factor) * E + Q * P)
         pos = jnp.cumsum(cflat.astype(jnp.int32)) - 1
         dest = jnp.where(cflat & (pos < out_w), pos, out_w)
         packed = (
@@ -747,16 +753,9 @@ class StackedChainArtifact:
             if sel.size == 0:
                 continue
             schema = m.output_schema
-            cols = []
-            for j, f in enumerate(schema.fields):
-                raw = block[2 + j, :n][sel]
-                if np.dtype(f.atype.device_dtype) == np.dtype(
-                    np.float32
-                ):
-                    raw = raw.view(np.float32)
-                cols.append(raw)
-            rows = schema.decode_buffered(
-                int(sel.size), block[0, :n][sel], cols
+            sub = block[:, :n][:, sel]
+            rows = schema.decode_packed_block(
+                int(sel.size), sub, data_row=2
             )
             out.append((schema, rows))
         return out
@@ -1041,7 +1040,45 @@ class SlotNFAArtifact:
             pred_mat,
             {_skey("src", *pair): cap_srcs[pair] for pair in pairs},
         )
-        (new_state, buf), _ = jax.lax.scan(body, (state, buf_init), xs)
+        # Relevance compaction (pattern kind only): '->' ignores events
+        # matching no element, so the sequential scan — the expensive part,
+        # ~E dependent steps — only needs the events whose predicate row is
+        # non-empty. They compact into an E//8 buffer; a lax.cond falls
+        # back to the full scan in the (rare) batch where more than E//8
+        # events are relevant. Sequences must see every event (strict
+        # continuity: an irrelevant event kills partials), so they keep
+        # the full scan.
+        if spec.kind == "pattern" and E >= 4096:
+            R = max(2048, E // 8)
+            rel = pred_mat.any(axis=1) & tape.valid
+            cnt = rel.sum().astype(jnp.int32)
+            cpos = jnp.cumsum(rel.astype(jnp.int32)) - 1
+            dest = jnp.where(rel & (cpos < R), cpos, R)
+            idx = (
+                jnp.zeros(R, dtype=jnp.int32)
+                .at[dest]
+                .set(jnp.arange(E, dtype=jnp.int32), mode="drop")
+            )
+            cvalid = jnp.arange(R) < jnp.minimum(cnt, R)
+            xs_c = (
+                tape.ts[idx],
+                cvalid,
+                pred_mat[idx] & cvalid[:, None],
+                {
+                    _skey("src", *pair): cap_srcs[pair][idx]
+                    for pair in pairs
+                },
+            )
+            (new_state, buf), _ = jax.lax.cond(
+                cnt <= R,
+                lambda carry: jax.lax.scan(body, carry, xs_c),
+                lambda carry: jax.lax.scan(body, carry, xs),
+                (state, buf_init),
+            )
+        else:
+            (new_state, buf), _ = jax.lax.scan(
+                body, (state, buf_init), xs
+            )
 
         emit_env = _emit_env(
             spec,

@@ -88,6 +88,19 @@ class OutputSchema:
         rows = zip(*col_lists) if col_lists else ((),) * idx.size
         return list(zip(ts_list, map(tuple, rows)))
 
+    def decode_packed_block(
+        self, n: int, block: np.ndarray, data_row: int = 1
+    ) -> List[Tuple[int, Tuple[Any, ...]]]:
+        """Decode the accumulator's packed int32 layout: row 0 is the
+        timestamp, rows ``data_row..`` are one bitcast row per field."""
+        cols = []
+        for j, f in enumerate(self.fields):
+            raw = block[data_row + j, :n]
+            if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
+                raw = raw.view(np.float32)
+            cols.append(raw)
+        return self.decode_buffered(n, block[0, :n], cols)
+
     def decode_buffered(
         self, count: int, ts: np.ndarray, cols: Sequence[np.ndarray]
     ) -> List[Tuple[int, Tuple[Any, ...]]]:

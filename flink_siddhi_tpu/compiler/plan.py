@@ -232,15 +232,9 @@ class CompiledPlan:
             if hasattr(a, "decode_packed"):
                 out[a.name] = a.decode_packed(n, block)
                 continue
-            cols = []
-            for j, f in enumerate(a.output_schema.fields):
-                raw = block[1 + j]
-                if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
-                    raw = raw.view(np.float32)
-                cols.append(raw)
             out[a.name] = [(
                 a.output_schema,
-                a.output_schema.decode_buffered(n, block[0], cols),
+                a.output_schema.decode_packed_block(n, block),
             )]
         return out
 

@@ -385,7 +385,7 @@ class Job:
                     continue
                 rows = schema.decode_aligned(mask, np.asarray(ts), cols)
             elif a.output_mode == "packed":
-                count, block = out
+                count, block = out[0], out[1]  # 3rd elem: drop counter
                 if int(count) == 0:
                     continue
                 block = np.asarray(block)
@@ -393,17 +393,7 @@ class Job:
                     for sch, rows in a.decode_packed(int(count), block):
                         self._emit_rows(sch, rows)
                     continue
-                cols = []
-                for j, f in enumerate(schema.fields):
-                    raw = block[1 + j]
-                    if np.dtype(f.atype.device_dtype) == np.dtype(
-                        np.float32
-                    ):
-                        raw = raw.view(np.float32)
-                    cols.append(raw)
-                rows = schema.decode_buffered(
-                    int(count), block[0], cols
-                )
+                rows = schema.decode_packed_block(int(count), block)
             else:  # buffered
                 count, ts, cols = out
                 if int(count) == 0:
@@ -439,6 +429,35 @@ class Job:
             load(self, os.fspath(snapshot_or_path))
         else:
             restore_job(self, snapshot_or_path)
+
+    # -- observability ------------------------------------------------------
+    # The reference only counts processed events per runtime, logged at
+    # shutdown (AbstractSiddhiOperator.java:117,147); this is queryable.
+    def metrics(self, drain: bool = False) -> Dict[str, object]:
+        """Snapshot of counters. ``drain=False`` (default) reads only
+        host-side state — safe to call from another thread (e.g. the REST
+        service) while the run loop owns the device; emitted counts are
+        then as-of the last drain. ``drain=True`` flushes the device
+        accumulators first and must be called from the run-loop thread."""
+        if drain:
+            self.drain_outputs()
+        return {
+            "processed_events": self.processed_events,
+            "plans": {
+                pid: {"enabled": rt.enabled}
+                for pid, rt in self._plans.items()
+            },
+            "emitted": {
+                sid: len(rows) for sid, rows in self.collected.items()
+            },
+            "pending_batches": sum(
+                len(b) for b in self._pending.values()
+            ),
+            "watermark": (
+                None if self._watermark() in (MAX_WM, -(2 ** 62))
+                else self._watermark()
+            ),
+        }
 
     # -- results -------------------------------------------------------------
     def results(self, output_stream: str) -> List[Tuple]:

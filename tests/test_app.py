@@ -253,6 +253,12 @@ def test_control_service_rest_roundtrip(tmp_path):
         job.run_cycle()
         assert qid not in job.plan_ids
 
+        # metrics endpoint
+        status, m = call("GET", "/api/v1/metrics")
+        assert status == 200
+        assert m["processed_events"] > 0
+        assert "ones" in m["emitted"]
+
         # 404 + 400 paths
         status, _ = call("GET", "/api/v1/nope")
         assert status == 404

@@ -199,3 +199,40 @@ def test_router_shuffle_balance_and_broadcast_pin():
     pieces = rb.route(batch)
     assert len(pieces[0]) == len(events)
     assert all(p is None for p in pieces[1:])
+
+
+def test_sharded_stacked_chain_group():
+    """A plan whose chain queries auto-stack must run under ShardedJob
+    (regression: the stacked packed output is a 3-tuple)."""
+    import numpy as np
+
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.parallel import ShardedJob, make_cep_mesh
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.batch import EventBatch
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+    )
+    n = 256
+    ids = (np.arange(n) % 6).astype(np.int32)
+    ts = 1000 + np.arange(n, dtype=np.int64)
+    batch = EventBatch("S", schema, {"id": ids, "timestamp": ts}, ts)
+    cql = (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] "
+        "select s1.timestamp as t1, s2.timestamp as t2 insert into o1; "
+        "from every s1 = S[id == 3] -> s2 = S[id == 4] "
+        "select s1.timestamp as a, s2.timestamp as b insert into o2"
+    )
+    plan = compile_plan(cql, {"S": schema}, plan_id="p")
+    assert len(plan.artifacts) == 1  # stacked
+    mesh = make_cep_mesh(4)
+    job = ShardedJob(
+        [plan], [BatchSource("S", schema, iter([batch]))],
+        mesh=mesh, batch_size=128,
+    )
+    job.run()
+    assert len(job.results("o1")) > 0
+    assert len(job.results("o2")) > 0

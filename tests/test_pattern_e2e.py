@@ -259,3 +259,51 @@ def test_single_element_every_pattern_timestamps():
     )
     rows = es.execute().results_with_ts("outputStream")
     assert rows == [(5000, (2,)), (9000, (2,))]
+
+
+def test_quantified_pattern_compaction_equivalence():
+    """Large-batch slot-NFA runs the relevance-compacted scan; its matches
+    must equal the uncompacted small-batch run over the same events."""
+    import numpy as np
+
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.batch import EventBatch
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+         ("timestamp", AttributeType.LONG)]
+    )
+    rng = np.random.default_rng(3)
+    n = 8192
+    ids = rng.integers(0, 40, n).astype(np.int32)
+    ts = 1000 + np.arange(n, dtype=np.int64)
+    prices = np.round(rng.random(n) * 100, 3)
+
+    def make_job(batch):
+        batches = []
+        for s in range(0, n, batch):
+            e = min(s + batch, n)
+            batches.append(EventBatch(
+                "S", schema,
+                {"id": ids[s:e], "price": prices[s:e],
+                 "timestamp": ts[s:e]}, ts[s:e],
+            ))
+        cql = (
+            "from every s1 = S[id == 1]+ -> s2 = S[id == 2] "
+            "select s1[0].price as p0, s1[last].price as pl, "
+            "s2.price as p2 insert into o"
+        )
+        plan = compile_plan(cql, {"S": schema})
+        job = Job([plan], [BatchSource("S", schema, iter(batches))],
+                  batch_size=batch, time_mode="processing")
+        job.run()
+        return job.results("o")
+
+    big = make_job(8192)   # compacted scan path (E >= 4096)
+    small = make_job(512)  # full scan path
+    assert len(big) > 0
+    assert big == small
