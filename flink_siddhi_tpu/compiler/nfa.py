@@ -344,6 +344,7 @@ def _chain_core(
     within_val,  # int32 scalar (ignored unless cfg.has_within)
     ts,  # int32[E]
     valid,  # bool[E]
+    use_pallas: bool = False,  # single-query callers only (not vmappable)
 ):
     """One micro-batch of the chain matcher for ONE query: advance carried
     partials + fresh starts through all elements, find completions, and
@@ -364,13 +365,23 @@ def _chain_core(
 
     # next_idx[k][p] = min q >= p with preds[k][q], else E; padded so a
     # gather at position E (or beyond-batch) safely reads "no match".
-    nxt = []
-    for k in range(1, K):
-        idx = jnp.where(preds[k], arange, E)
-        scanned = jax.lax.associative_scan(jnp.minimum, idx, reverse=True)
-        nxt.append(
-            jnp.concatenate([scanned, jnp.asarray([E], dtype=jnp.int32)])
-        )
+    # All K-1 reverse cummins fuse into one Pallas pass on TPU.
+    idxs = [
+        jnp.where(preds[k], arange, E) for k in range(1, K)
+    ]
+    if use_pallas and idxs:
+        from .pallas_ops import multi_reverse_cummin
+
+        scans = multi_reverse_cummin(idxs)
+    else:
+        scans = [
+            jax.lax.associative_scan(jnp.minimum, idx, reverse=True)
+            for idx in idxs
+        ]
+    nxt = [
+        jnp.concatenate([s, jnp.asarray([E], dtype=jnp.int32)])
+        for s in scans
+    ]
     ts_pad = jnp.concatenate([ts, jnp.asarray([0], dtype=jnp.int32)])
     env_pad = {
         pair: jnp.concatenate(
@@ -540,7 +551,7 @@ class ChainPatternArtifact:
         )
         state, complete, v_emit_ts, caps = _chain_core(
             _ChainCfg.of(spec), P, state, preds, cap_srcs, within_val,
-            tape.ts, tape.valid,
+            tape.ts, tape.valid, use_pallas=True,
         )
         # emit matches: O(V) cumsum-scatter compaction into the first
         # n_matches rows; all output rows (ts + projections) compact

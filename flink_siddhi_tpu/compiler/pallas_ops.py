@@ -1,0 +1,179 @@
+"""Pallas TPU kernels for the engine's hot scan primitives.
+
+The chain matcher's "next match at/after position p" indexes are reverse
+cumulative minimums over the event axis — one per pattern element
+(nfa.py:_chain_core). XLA compiles each as its own pass over HBM; at
+micro-batch sizes per-kernel launch overhead dominates, so up to 8
+channels are fused here into ONE blocked Pallas pass: the grid walks
+the event axis right-to-left, each step does a log-width shift-min
+sweep over its (8, 1024) tile in VMEM and threads the running minimum
+through a VMEM carry.
+
+Falls back transparently to ``jax.lax.cummin`` when Pallas is
+unavailable (non-TPU backend, odd shapes, vmapped/stacked callers) —
+set ``FST_NO_PALLAS=1`` to force the fallback.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LOG = logging.getLogger(__name__)
+
+_BLOCK = 1024  # lanes per grid step (bounded VMEM sweep)
+_SUB = 8  # sublane tile for int32
+_INF = 2 ** 30
+
+
+def _build():
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref, carry_ref):
+        # carry_ref: a (SUB, 128) output block revisited by every grid
+        # step (index_map pins it to (0, 0)) — the running minimum of all
+        # blocks to the right. Using a revisited output instead of VMEM
+        # scratch keeps the kernel importable without the TPU-specific
+        # pallas module (so it also runs under the interpreter on CPU).
+        blk = pl.program_id(0)
+
+        @pl.when(blk == 0)
+        def _init():  # rightmost block: nothing to the right yet
+            carry_ref[...] = jnp.full_like(carry_ref[...], _INF)
+
+        x = x_ref[...]  # (SUB, BLOCK) int32
+        # in-block suffix min via masked shift-mins: offsets B/2..1 cover
+        # every distance by binary decomposition
+        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        acc = x
+        step = _BLOCK // 2
+        while step >= 1:
+            shifted = jnp.roll(acc, -step, axis=1)
+            take = lane < (_BLOCK - step)
+            acc = jnp.where(take, jnp.minimum(acc, shifted), acc)
+            step //= 2
+        carry = carry_ref[..., :1]  # (SUB, 1): min of all blocks right
+        out = jnp.minimum(acc, carry)
+        o_ref[...] = out
+        carry_ref[..., :1] = out[..., :1]
+
+    interpret = bool(os.environ.get("FST_PALLAS_INTERPRET"))
+
+    def run(x2d):
+        n_blocks = x2d.shape[1] // _BLOCK
+        out, _carry = pl.pallas_call(
+            kernel,
+            grid=(n_blocks,),
+            in_specs=[
+                pl.BlockSpec(
+                    (_SUB, _BLOCK),
+                    # right-to-left: grid step i handles block n-1-i
+                    lambda i, n=n_blocks: (0, n - 1 - i),
+                )
+            ],
+            out_specs=[
+                pl.BlockSpec(
+                    (_SUB, _BLOCK), lambda i, n=n_blocks: (0, n - 1 - i)
+                ),
+                pl.BlockSpec((_SUB, 128), lambda i: (0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct(x2d.shape, jnp.int32),
+                jax.ShapeDtypeStruct((_SUB, 128), jnp.int32),
+            ],
+            interpret=interpret,
+        )(x2d)
+        return out
+
+    return run
+
+
+_RUN = None
+_FAILED = False
+_TLS = threading.local()  # per-thread force-fallback flag
+
+
+@contextlib.contextmanager
+def force_fallback():
+    """Disable the Pallas path while tracing runs inside this context
+    (e.g. under shard_map, a lowering configuration warmup() never
+    probed). Trace-time only: wrap the function BODY that builds the
+    jaxpr, not the jit call site."""
+    prev = getattr(_TLS, "disabled", False)
+    _TLS.disabled = True
+    try:
+        yield
+    finally:
+        _TLS.disabled = prev
+
+
+def warmup() -> bool:
+    """Build + probe the kernel eagerly. MUST be called from host code
+    (never inside a jit trace): lowering/Mosaic failures and numerical
+    mismatches surface here, so traced callers can rely on a kernel
+    that is known-good — or silently use the XLA fallback. Returns
+    whether the Pallas path is active."""
+    global _RUN, _FAILED
+    if _RUN is None and not _FAILED:
+        if not available():
+            _FAILED = True
+            return False
+        try:
+            run = _build()
+            # probe spans FOUR grid blocks with random data so both the
+            # in-block sweep and the cross-block carry are validated
+            rng = np.random.default_rng(0)
+            probe = rng.integers(
+                0, 2 ** 29, (_SUB, 4 * _BLOCK)
+            ).astype(np.int32)
+            out = np.asarray(jax.jit(run)(jnp.asarray(probe)))
+            ref = np.minimum.accumulate(
+                probe[:, ::-1], axis=1
+            )[:, ::-1]
+            if not np.array_equal(out, ref):
+                raise RuntimeError("probe mismatch")
+            _RUN = run
+        except Exception as e:  # pallas unavailable on this backend
+            _LOG.info("pallas reverse-cummin unavailable: %s", e)
+            _FAILED = True
+    return _RUN is not None
+
+
+def available() -> bool:
+    if os.environ.get("FST_NO_PALLAS"):
+        return False
+    if os.environ.get("FST_PALLAS_INTERPRET"):
+        return True  # interpreter mode: any backend (tests)
+    return jax.default_backend() == "tpu"
+
+
+def multi_reverse_cummin(rows):
+    """Reverse cummin along the last axis for up to 8 int32 channels of
+    equal length E (E a multiple of 1024), fused in one Pallas pass.
+    ``rows``: list of (E,) int32 arrays; returns the same. Falls back to
+    per-row ``lax.cummin`` whenever the kernel can't apply."""
+    E = rows[0].shape[0]
+    # only a warmup()-probed kernel is used: building/probing inside a
+    # jit trace is impossible (pallas has no op-by-op eval rule)
+    usable = (
+        _RUN is not None
+        and not getattr(_TLS, "disabled", False)
+        and available()
+        and 0 < len(rows) <= _SUB
+        and E % _BLOCK == 0
+    )
+    if usable:
+        pad = [jnp.full(E, _INF, jnp.int32)] * (_SUB - len(rows))
+        x = jnp.stack([r.astype(jnp.int32) for r in rows] + pad)
+        out = _RUN(x)  # ONE fused pass for all channels
+        return [out[i] for i in range(len(rows))]
+    return [
+        jax.lax.cummin(r.astype(jnp.int32), axis=0, reverse=True)
+        for r in rows
+    ]

@@ -52,9 +52,14 @@ def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
     """
 
     def local(states, tape):
+        from ..compiler import pallas_ops
+
         states = jax.tree.map(lambda x: x[0], states)
         tape = jax.tree.map(lambda x: x[0], tape)
-        new_states, outputs = plan.step(states, tape)
+        # custom kernels under shard_map are a lowering configuration the
+        # warmup probe never validated; use the XLA path here
+        with pallas_ops.force_fallback():
+            new_states, outputs = plan.step(states, tape)
         expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         return expand(new_states), expand(outputs)
 

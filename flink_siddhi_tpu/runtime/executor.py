@@ -28,6 +28,7 @@ from .sources import Source
 from .tape import build_wire_tape
 
 MAX_WM = np.iinfo(np.int64).max
+MIN_WM = -(2 ** 62)  # pre-first-event watermark sentinel
 _LOG = logging.getLogger(__name__)
 
 
@@ -60,10 +61,10 @@ class Job:
         self.batch_size = batch_size
         self.time_mode = time_mode
         self._sources = list(sources)
-        self._source_wm: List[int] = [-(2**62)] * len(self._sources)
+        self._source_wm: List[int] = [MIN_WM] * len(self._sources)
         self._source_done: List[bool] = [False] * len(self._sources)
         self._control = list(control_sources)
-        self._control_wm: List[int] = [-(2**62)] * len(self._control)
+        self._control_wm: List[int] = [MIN_WM] * len(self._control)
         self._control_done: List[bool] = [False] * len(self._control)
         self._control_pending: List[Tuple[int, object]] = []
         self._plan_compiler = plan_compiler
@@ -90,6 +91,9 @@ class Job:
     # remove QueryRuntimeHandlers, enable/disable gating — applied here at
     # micro-batch boundaries.
     def add_plan(self, plan: CompiledPlan) -> None:
+        from ..compiler import pallas_ops
+
+        pallas_ops.warmup()  # probe TPU kernels outside any trace
         init_acc = jax.jit(plan.init_acc)
 
         def step_wire(states, acc, wire):
@@ -385,7 +389,12 @@ class Job:
                     continue
                 rows = schema.decode_aligned(mask, np.asarray(ts), cols)
             elif a.output_mode == "packed":
-                count, block = out[0], out[1]  # 3rd elem: drop counter
+                count, block = out[0], out[1]
+                if len(out) > 2 and int(out[2]) > 0:
+                    _LOG.warning(
+                        "%s: %d emissions dropped (stacked emission "
+                        "buffer overflow)", a.name, int(out[2]),
+                    )
                 if int(count) == 0:
                     continue
                 block = np.asarray(block)
@@ -441,22 +450,23 @@ class Job:
         accumulators first and must be called from the run-loop thread."""
         if drain:
             self.drain_outputs()
+        wm = self._watermark()
         return {
             "processed_events": self.processed_events,
+            # list() snapshots below: the run-loop thread mutates these
+            # dicts concurrently with off-thread metrics readers
             "plans": {
                 pid: {"enabled": rt.enabled}
-                for pid, rt in self._plans.items()
+                for pid, rt in list(self._plans.items())
             },
             "emitted": {
-                sid: len(rows) for sid, rows in self.collected.items()
+                sid: len(rows)
+                for sid, rows in list(self.collected.items())
             },
             "pending_batches": sum(
-                len(b) for b in self._pending.values()
+                len(b) for b in list(self._pending.values())
             ),
-            "watermark": (
-                None if self._watermark() in (MAX_WM, -(2 ** 62))
-                else self._watermark()
-            ),
+            "watermark": None if wm in (MAX_WM, MIN_WM) else wm,
         }
 
     # -- results -------------------------------------------------------------

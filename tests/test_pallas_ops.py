@@ -1,0 +1,76 @@
+"""Pallas reverse-cummin kernel: equivalence with lax.cummin.
+
+The kernel logic (blocked right-to-left grid, in-block shift-min sweep,
+revisited-output carry) is exercised on CPU via the pallas interpreter.
+That import path registers TPU lowering rules, which conflicts with this
+suite's conftest (it deletes non-CPU backend factories to keep the
+remote-accelerator tunnel out of tests), so the interpreter run happens
+in a clean subprocess.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_INTERPRET_SNIPPET = """
+import numpy as np, jax.numpy as jnp
+from flink_siddhi_tpu.compiler import pallas_ops
+assert pallas_ops.available()
+assert pallas_ops.warmup(), "kernel failed to build/probe"
+E = 4096
+rng = np.random.default_rng(7)
+rows = [jnp.asarray(rng.integers(0, 2 ** 29, E).astype(np.int32))
+        for _ in range(3)]
+out = pallas_ops.multi_reverse_cummin(rows)
+assert not pallas_ops._FAILED, "kernel fell back in interpret mode"
+for o, r in zip(out, rows):
+    ref = np.minimum.accumulate(np.asarray(r)[::-1])[::-1]
+    assert np.array_equal(np.asarray(o), ref)
+print("OK")
+"""
+
+
+def test_multi_reverse_cummin_interpret():
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        FST_PALLAS_INTERPRET="1",
+        PYTHONPATH=_REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _INTERPRET_SNIPPET],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+    )
+    assert r.returncode == 0 and "OK" in r.stdout, (
+        r.stdout + "\n" + r.stderr
+    )
+
+
+def test_fallback_matches():
+    os.environ["FST_NO_PALLAS"] = "1"
+    try:
+        import importlib
+
+        from flink_siddhi_tpu.compiler import pallas_ops
+
+        importlib.reload(pallas_ops)
+        import jax.numpy as jnp
+
+        rows = [jnp.asarray(np.array([5, 3, 7, 1], np.int32))]
+        out = pallas_ops.multi_reverse_cummin(rows)
+        assert np.asarray(out[0]).tolist() == [1, 1, 1, 1]
+    finally:
+        os.environ.pop("FST_NO_PALLAS", None)
+        import importlib
+
+        from flink_siddhi_tpu.compiler import pallas_ops
+
+        importlib.reload(pallas_ops)
