@@ -156,8 +156,11 @@ def available() -> bool:
 def multi_reverse_cummin(rows):
     """Reverse cummin along the last axis for up to 8 int32 channels of
     equal length E (E a multiple of 1024), fused in one Pallas pass.
-    ``rows``: list of (E,) int32 arrays; returns the same. Falls back to
-    per-row ``lax.cummin`` whenever the kernel can't apply."""
+    ``rows``: list of (E,) int32 arrays with values < 2**30 (the kernel's
+    carry/padding sentinel — larger values would clamp to it; the chain
+    matcher's inputs are tape positions <= E, far below). Returns the
+    same. Falls back to per-row ``lax.cummin`` whenever the kernel can't
+    apply."""
     E = rows[0].shape[0]
     # only a warmup()-probed kernel is used: building/probing inside a
     # jit trace is impossible (pallas has no op-by-op eval rule)

@@ -72,6 +72,31 @@ def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
     return jax.jit(smapped)
 
 
+def make_sharded_step_acc(plan: CompiledPlan, mesh) -> callable:
+    """jit(shard_map(plan.step_acc)): each shard appends its emissions to
+    its own on-device accumulator — the hot loop never fetches (same
+    contract as the single-device executor)."""
+
+    def local(states, acc, tape):
+        from ..compiler import pallas_ops
+
+        states = jax.tree.map(lambda x: x[0], states)
+        acc = jax.tree.map(lambda x: x[0], acc)
+        tape = jax.tree.map(lambda x: x[0], tape)
+        with pallas_ops.force_fallback():
+            new_states, new_acc = plan.step_acc(states, acc, tape)
+        expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
+        return expand(new_states), expand(new_acc)
+
+    smapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+    )
+    return jax.jit(smapped, donate_argnums=(0, 1))
+
+
 class ShardedJob(Job):
     """A Job whose plans run sharded over a device mesh.
 
@@ -100,10 +125,19 @@ class ShardedJob(Job):
     def add_plan(self, plan: CompiledPlan) -> None:
         stacked = _tree_stack([plan.init_state()] * self.n_shards)
         stacked = jax.device_put(stacked, self._state_sharding)
+        init_acc = jax.jit(
+            lambda: _tree_stack(
+                [plan.init_acc()] * self.n_shards
+            ),
+            out_shardings=self._state_sharding,
+        )
         self._plans[plan.plan_id] = _PlanRuntime(
             plan=plan,
             states=stacked,
             jitted=make_sharded_step(plan, self.mesh),
+            jitted_acc=make_sharded_step_acc(plan, self.mesh),
+            jitted_init_acc=init_acc,
+            acc=init_acc(),
         )
         self._routers[plan.plan_id] = Router(self.n_shards, plan.partitions)
 
@@ -148,13 +182,68 @@ class ShardedJob(Job):
             [jax.tree.map(jnp.asarray, t) for t in tapes]
         )
         rt.states = self._grow_stacked(plan, rt.states)
-        rt.states, outputs = rt.jitted(rt.states, stacked_tape)
-        outputs = jax.device_get(outputs)
+        # per-shard on-device accumulation; no fetch in the hot loop
+        # (drained in bulk by _drain_plan, same as the single-device Job)
+        rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, stacked_tape)
+        # same no-overflow contract as Job._step_plan: account for each
+        # artifact's widest per-cycle emission block (shapes only — the
+        # leading shard axis is stripped via ShapeDtypeStructs)
+        E = stacked_tape.ts.shape[-1]
+        block = max(
+            (
+                a.emit_block_width(
+                    E,
+                    jax.tree.map(
+                        lambda x: jax.ShapeDtypeStruct(
+                            np.shape(x)[1:], x.dtype
+                        ),
+                        rt.states.get(a.name),
+                    ),
+                )
+                if hasattr(a, "emit_block_width")
+                else E
+                for a in plan.artifacts
+            ),
+            default=E,
+        )
+        cap_cycles = max(
+            1, plan.acc_capacity() // (2 * max(block, 1)) - 1
+        )
+        self._drain_hints[plan.plan_id] = cap_cycles
+
+    def _drain_plan(self, rt: _PlanRuntime, min_fill: float = 0.0) -> None:
+        if rt.acc is None or not rt.plan.artifacts:
+            return
+        meta = np.asarray(rt.acc["meta"])  # (shards, 2, A) — one fetch
+        counts, overflow = meta[:, 0], meta[:, 1]
+        seen = getattr(rt, "_overflow_seen", None)
+        already = 0 if seen is None else int(np.sum(seen))
+        total = int(overflow.sum())
+        if total > already:  # log new drops once, not per check
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%s: %d emissions dropped across shards (accumulator "
+                "full)", rt.plan.plan_id, total - already,
+            )
+        rt._overflow_seen = overflow
+        max_n = int(counts.max()) if counts.size else 0
+        if max_n == 0:
+            return
+        if min_fill > 0 and max_n < min_fill * rt.plan.acc_capacity():
+            return
+        data = np.asarray(rt.acc["buf"][:, :, :max_n])  # fetch two
+        rt.acc = rt.jitted_init_acc()
+        rt._overflow_seen = None  # counters reset with the accumulator
         for s in range(self.n_shards):
-            self._decode_outputs(plan, _tree_index(outputs, s))
+            decoded = rt.plan.drain_decode(counts[s], data[s])
+            for a in rt.plan.artifacts:
+                for schema, rows in decoded.get(a.name) or []:
+                    self._emit_rows(schema, rows)
 
     def flush(self) -> None:
         for rt in self._plans.values():
+            self._drain_plan(rt)
             host = jax.device_get(rt.states)
             new_shards = []
             for s in range(self.n_shards):
@@ -168,6 +257,7 @@ class ShardedJob(Job):
 
     # -- results: merge shard-interleaved output back to time order ---------
     def results_with_ts(self, output_stream: str):
+        self.drain_outputs()
         rows = list(self.collected.get(output_stream, []))
         rows.sort(key=lambda p: p[0])
         return rows
