@@ -22,12 +22,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+import heapq
+import logging
+
 from ..compiler.plan import CompiledPlan
 from ..runtime.executor import Job, _PlanRuntime
 from ..runtime.tape import build_tape, bucket_size
 from ..schema.batch import EventBatch
 from .mesh import SHARD_AXIS, make_cep_mesh
 from .router import Router
+
+_LOG = logging.getLogger(__name__)
 
 
 def _tree_stack(trees: Sequence):
@@ -185,31 +190,18 @@ class ShardedJob(Job):
         # per-shard on-device accumulation; no fetch in the hot loop
         # (drained in bulk by _drain_plan, same as the single-device Job)
         rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, stacked_tape)
-        # same no-overflow contract as Job._step_plan: account for each
-        # artifact's widest per-cycle emission block (shapes only — the
-        # leading shard axis is stripped via ShapeDtypeStructs)
-        E = stacked_tape.ts.shape[-1]
-        block = max(
-            (
-                a.emit_block_width(
-                    E,
-                    jax.tree.map(
-                        lambda x: jax.ShapeDtypeStruct(
-                            np.shape(x)[1:], x.dtype
-                        ),
-                        rt.states.get(a.name),
-                    ),
-                )
-                if hasattr(a, "emit_block_width")
-                else E
-                for a in plan.artifacts
+        # shared no-overflow contract (Job._update_drain_hint); strip the
+        # leading shard axis via shape metadata only
+        self._update_drain_hint(
+            plan,
+            stacked_tape.ts.shape[-1],
+            lambda name: jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    np.shape(x)[1:], x.dtype
+                ),
+                rt.states.get(name),
             ),
-            default=E,
         )
-        cap_cycles = max(
-            1, plan.acc_capacity() // (2 * max(block, 1)) - 1
-        )
-        self._drain_hints[plan.plan_id] = cap_cycles
 
     def _drain_plan(self, rt: _PlanRuntime, min_fill: float = 0.0) -> None:
         if rt.acc is None or not rt.plan.artifacts:
@@ -220,11 +212,10 @@ class ShardedJob(Job):
         already = 0 if seen is None else int(np.sum(seen))
         total = int(overflow.sum())
         if total > already:  # log new drops once, not per check
-            import logging
-
-            logging.getLogger(__name__).warning(
+            _LOG.warning(
                 "%s: %d emissions dropped across shards (accumulator "
-                "full)", rt.plan.plan_id, total - already,
+                "full; raise CompiledPlan.ACC_BUDGET_BYTES or drain "
+                "more often)", rt.plan.plan_id, total - already,
             )
         rt._overflow_seen = overflow
         max_n = int(counts.max()) if counts.size else 0
@@ -235,11 +226,20 @@ class ShardedJob(Job):
         data = np.asarray(rt.acc["buf"][:, :, :max_n])  # fetch two
         rt.acc = rt.jitted_init_acc()
         rt._overflow_seen = None  # counters reset with the accumulator
+        # merge each output's per-shard (already time-ordered) rows by
+        # timestamp so sinks observe near-monotonic time across shards
+        per_schema = {}
         for s in range(self.n_shards):
             decoded = rt.plan.drain_decode(counts[s], data[s])
             for a in rt.plan.artifacts:
                 for schema, rows in decoded.get(a.name) or []:
-                    self._emit_rows(schema, rows)
+                    per_schema.setdefault(
+                        schema.stream_id, (schema, [])
+                    )[1].append(rows)
+        for schema, shard_rows in per_schema.values():
+            self._emit_rows(
+                schema, list(heapq.merge(*shard_rows, key=lambda p: p[0]))
+            )
 
     def flush(self) -> None:
         for rt in self._plans.values():

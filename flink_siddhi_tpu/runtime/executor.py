@@ -224,10 +224,16 @@ class Job:
         self.output_fields.setdefault(sid, schema.field_names)
         bucket = self.collected.setdefault(sid, [])
         epoch = self._epoch_ms or 0
+        sinks = self._sinks.get(sid)
+        if not sinks:  # bulk path: drains can carry millions of rows
+            bucket.extend(
+                (epoch + rel_ts, row) for rel_ts, row in rows
+            )
+            return
         for rel_ts, row in rows:
             abs_ts = epoch + rel_ts
             bucket.append((abs_ts, row))
-            for sink in self._sinks.get(sid, ()):
+            for sink in sinks:
                 sink(abs_ts, row)
 
     @property
@@ -355,19 +361,24 @@ class Job:
         # NO device->host fetch here: emissions append to the on-device
         # accumulator and are drained in bulk (flush/results/periodic check)
         rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, tape)
-        # capacity-check cadence: each artifact declares its widest
-        # per-cycle emission block (joins fan out, patterns carry pools,
-        # batch windows flush whole grids) and needs that much headroom to
-        # fit, so with checks every k cycles and a >=50%-full drain rule,
-        # no overflow requires cap/2 + (k+1)*block <= cap
+        self._update_drain_hint(
+            plan, tape.capacity, lambda name: rt.states.get(name)
+        )
+
+    def _update_drain_hint(self, plan, tape_capacity, state_of) -> None:
+        """Capacity-check cadence: each artifact declares its widest
+        per-cycle emission block (joins fan out, patterns carry pools,
+        batch windows flush whole grids) and needs that much headroom to
+        fit, so with checks every k cycles and a >=50%-full drain rule,
+        no overflow requires cap/2 + (k+1)*block <= cap."""
         block = max(
             (
-                a.emit_block_width(tape.capacity, rt.states.get(a.name))
+                a.emit_block_width(tape_capacity, state_of(a.name))
                 if hasattr(a, "emit_block_width")
-                else tape.capacity
+                else tape_capacity
                 for a in plan.artifacts
             ),
-            default=tape.capacity,
+            default=tape_capacity,
         )
         cap_cycles = max(
             1, plan.acc_capacity() // (2 * max(block, 1)) - 1
