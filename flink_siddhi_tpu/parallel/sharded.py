@@ -237,9 +237,15 @@ class ShardedJob(Job):
                         schema.stream_id, (schema, [])
                     )[1].append(rows)
         for schema, shard_rows in per_schema.values():
-            self._emit_rows(
-                schema, list(heapq.merge(*shard_rows, key=lambda p: p[0]))
-            )
+            if self._sinks.get(schema.stream_id):
+                # sinks observe emission order: merge shards by timestamp
+                rows = list(
+                    heapq.merge(*shard_rows, key=lambda p: p[0])
+                )
+            else:
+                # collectors re-sort on read; skip the per-row merge
+                rows = [r for sh in shard_rows for r in sh]
+            self._emit_rows(schema, rows)
 
     def flush(self) -> None:
         for rt in self._plans.values():

@@ -1,0 +1,126 @@
+"""Randomized equivalence: device pattern engines vs a trivial Python
+NFA oracle.
+
+The role SiddhiSyntaxTest plays in the reference (pinning raw engine
+behavior, SiddhiCEPITCase.java:333-382 semantics) — here the oracle is
+an obviously-correct per-event interpreter for `every A -> B [-> C]
+[within t]` chains, and the engine must produce identical match sets
+for random streams regardless of micro-batch boundaries.
+"""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.sources import BatchSource
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+
+def oracle_chain(ids, ts, steps, within=None, every=True):
+    """Pure-python chain NFA: each event matching steps[0] opens a
+    partial; each partial advances through steps in order, taking the
+    FIRST later event that matches its next step; `within` bounds
+    last-first timestamps. Returns sorted match tuples of event ids'
+    timestamps."""
+    partials = []  # list of (start_idx, next_step, captured ts list)
+    matches = []
+    done = False
+    for i, (eid, t) in enumerate(zip(ids, ts)):
+        new_partials = []
+        for start, step, caps in partials:
+            if eid == steps[step]:
+                caps2 = caps + [t]
+                if within is not None and caps2[-1] - caps2[0] > within:
+                    continue  # expired
+                if step + 1 == len(steps):
+                    if every or not done:
+                        matches.append(tuple(caps2))
+                        done = True
+                else:
+                    new_partials.append((start, step + 1, caps2))
+            else:
+                new_partials.append((start, step, caps))
+        partials = new_partials
+        if eid == steps[0] and (every or not done):
+            if len(steps) == 1:
+                matches.append((t,))
+                done = True
+            else:
+                partials.append((i, 1, [t]))
+    return sorted(matches)
+
+
+def run_engine(ids, ts, steps, within, batch, every=True):
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+    )
+    n = len(ids)
+    batches = []
+    for s in range(0, n, batch):
+        e = min(s + batch, n)
+        batches.append(
+            EventBatch(
+                "S", schema,
+                {
+                    "id": np.asarray(ids[s:e], np.int32),
+                    "timestamp": np.asarray(ts[s:e], np.int64),
+                },
+                np.asarray(ts[s:e], np.int64),
+            )
+        )
+    pat = " -> ".join(
+        f"s{k} = S[id == {v}]" for k, v in enumerate(steps)
+    )
+    sel = ", ".join(
+        f"s{k}.timestamp as t{k}" for k in range(len(steps))
+    )
+    w = f" within {within // 1000} sec" if within is not None else ""
+    ev = "every " if every else ""
+    cql = f"from {ev}{pat}{w} select {sel} insert into o"
+    plan = compile_plan(cql, {"S": schema})
+    job = Job(
+        [plan], [BatchSource("S", schema, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return sorted(job.results("o"))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("batch", [7, 64, 997])
+def test_chain_vs_oracle_random(seed, batch):
+    rng = np.random.default_rng(seed)
+    n = 400
+    ids = rng.integers(0, 6, n).tolist()
+    ts = (1000 + np.cumsum(rng.integers(1, 50, n))).tolist()
+    steps = [1, 2, 3]
+    expected = oracle_chain(ids, ts, steps)
+    got = run_engine(ids, ts, steps, None, batch)
+    assert got == expected
+
+
+@pytest.mark.parametrize("within_s", [1, 5])
+def test_chain_within_vs_oracle(within_s):
+    rng = np.random.default_rng(42)
+    n = 500
+    ids = rng.integers(0, 5, n).tolist()
+    ts = (1000 + np.cumsum(rng.integers(1, 900, n))).tolist()
+    steps = [1, 2]
+    within = within_s * 1000
+    expected = oracle_chain(ids, ts, steps, within=within)
+    got = run_engine(ids, ts, steps, within, batch=61)
+    assert got == expected
+
+
+def test_non_every_vs_oracle():
+    rng = np.random.default_rng(9)
+    n = 300
+    ids = rng.integers(0, 4, n).tolist()
+    ts = (1000 + np.arange(n) * 10).tolist()
+    steps = [1, 2]
+    expected = oracle_chain(ids, ts, steps, every=False)
+    got = run_engine(ids, ts, steps, None, batch=37, every=False)
+    assert got == expected
