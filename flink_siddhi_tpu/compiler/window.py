@@ -276,11 +276,20 @@ class SlidingWindowArtifact:
         return {"enabled": jnp.asarray(True), "ring": ring}
 
     def _prefixable(self) -> bool:
-        """Length windows whose aggregates distribute over +/- can use the
+        """Windows whose aggregates distribute over +/- can use the
         O((E+C) log) arrival/expiry formulation instead of the O(E*C)
         window matrix (catastrophic for large windows: a length(1000)
-        window over a 131k batch materializes 131M-element gathers)."""
-        return self.window_mode == "length" and all(
+        window over a 131k batch materializes 131M-element gathers).
+        Length windows expire by position; tape-time windows by
+        searchsorted timestamp over the (sorted) tape order.
+        externalTime windows keep the matrix path: their user-supplied
+        timestamp column has no ordering guarantee, and expiry-by-search
+        over disordered times mis-evicts (an event could even expire
+        before its own arrival)."""
+        return (
+            self.window_mode == "length"
+            or (self.window_mode == "time" and self.ts_key is None)
+        ) and all(
             a.kind in ("count", "sum", "avg", "stddev") for a in self.aggs
         )
 
@@ -333,11 +342,33 @@ class SlidingWindowArtifact:
         )
         N = C + E
 
-        # merged sequence: N arrivals (+) then N expiries (-), expiry of
-        # position p lands at p+C and is ordered BEFORE an arrival at the
-        # same position (window is (k-C, k])
+        # merged sequence: N arrivals (+) then N expiries (-), each expiry
+        # ordered BEFORE any arrival at its position. Length windows expire
+        # C events later ((k-C, k]); time windows expire at the first
+        # position whose timestamp reaches ts + span (ts > ts_k - span
+        # membership, searched over running-max timestamps so disordered
+        # stragglers evict conservatively instead of corrupting the scan)
         pos = jnp.arange(N, dtype=jnp.int32)
-        key2 = jnp.concatenate([pos * 2 + 1, (pos + C) * 2])
+        if self.window_mode == "length":
+            exp_pos = pos + C
+        else:
+            # saturating add: ts + span can overflow int32 near the
+            # engine's relative-timestamp limit, which would wrap the
+            # expiry target negative and self-cancel the event
+            ts_c = c_cols["ts"].astype(jnp.int32)
+            mono = lax.cummax(ts_c)
+            tgt = ts_c + jnp.int32(self.time_ms)
+            tgt = jnp.where(
+                tgt < ts_c, jnp.int32(2 ** 31 - 1), tgt
+            )
+            exp_pos = jnp.searchsorted(
+                mono, tgt, side="left"
+            ).astype(jnp.int32)
+            # defense for cross-batch stragglers (processing-time inputs
+            # regressing between polls): an event is always inside its
+            # own window, so its expiry can never precede its arrival
+            exp_pos = jnp.maximum(exp_pos, pos + 1)
+        key2 = jnp.concatenate([pos * 2 + 1, exp_pos * 2])
         sign2 = jnp.concatenate(
             [jnp.ones(N, jnp.int32), jnp.full(N, -1, jnp.int32)]
         )

@@ -124,3 +124,63 @@ def test_non_every_vs_oracle():
     expected = oracle_chain(ids, ts, steps, every=False)
     got = run_engine(ids, ts, steps, None, batch=37, every=False)
     assert got == expected
+
+
+def test_time_window_groupby_vs_oracle():
+    """Sliding #window.time group-by sum/count (prefix/expiry path)
+    against a per-event python oracle, across batch splits."""
+    rng = np.random.default_rng(11)
+    n = 600
+    ids = rng.integers(0, 5, n).tolist()
+    ts = (1000 + np.cumsum(rng.integers(1, 400, n))).tolist()
+    vals = [float(v) for v in rng.integers(1, 100, n)]
+    span = 2000
+
+    def oracle():
+        out = []
+        hist = []  # (ts, id, val)
+        for t, g, v in zip(ts, ids, vals):
+            hist.append((t, g, v))
+            window = [h for h in hist if h[0] > t - span]
+            mine = [h for h in window if h[1] == g]
+            out.append((g, sum(h[2] for h in mine), len(mine)))
+        return out
+
+    schema = StreamSchema(
+        [
+            ("id", AttributeType.INT),
+            ("v", AttributeType.DOUBLE),
+            ("timestamp", AttributeType.LONG),
+        ]
+    )
+    for batch in (41, 512):
+        batches = []
+        for s in range(0, n, batch):
+            e = min(s + batch, n)
+            batches.append(
+                EventBatch(
+                    "S", schema,
+                    {
+                        "id": np.asarray(ids[s:e], np.int32),
+                        "v": np.asarray(vals[s:e], np.float64),
+                        "timestamp": np.asarray(ts[s:e], np.int64),
+                    },
+                    np.asarray(ts[s:e], np.int64),
+                )
+            )
+        plan = compile_plan(
+            "from S#window.time(2 sec) select id, sum(v) as t, "
+            "count() as c group by id insert into o",
+            {"S": schema},
+        )
+        job = Job(
+            [plan], [BatchSource("S", schema, iter(batches))],
+            batch_size=batch,
+        )
+        job.run()
+        got = job.results("o")
+        expected = oracle()
+        assert len(got) == len(expected)
+        for (gg, gt, gc), (eg, et, ec) in zip(got, expected):
+            assert gg == eg and gc == ec
+            assert abs(gt - et) < 1e-3 * max(1.0, abs(et))
