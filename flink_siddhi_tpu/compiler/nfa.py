@@ -81,8 +81,10 @@ class CaptureResolver:
     """
 
     def __init__(self, elements, schemas):
-        # alias -> (element index, stream_id, schema)
+        # alias -> (element index, stream_id, schema); absent ('not')
+        # elements never match an event, so they have nothing to select
         self._by_alias: Dict[str, Tuple[int, str, object]] = {}
+        self._negated = {el.alias for el in elements if el.negated}
         for i, el in enumerate(elements):
             self._by_alias[el.alias] = (i, el.stream_id, schemas[el.stream_id])
         self.referenced: List[Tuple[int, str, str]] = []  # (elem, col, which)
@@ -97,7 +99,7 @@ class CaptureResolver:
             hits = [
                 (alias, info)
                 for alias, info in self._by_alias.items()
-                if attr.name in info[2]
+                if attr.name in info[2] and alias not in self._negated
             ]
             if not hits:
                 raise SiddhiQLError(f"unknown attribute {attr.name!r}")
@@ -128,6 +130,10 @@ class CaptureResolver:
                 raise SiddhiQLError(
                     f"stream of alias {alias!r} has no attribute {attr.name!r}"
                 )
+        if alias in self._negated:
+            raise SiddhiQLError(
+                f"cannot select from absent ('not') element {alias!r}"
+            )
         atype = schema.field_type(attr.name)
         table = schema.string_tables.get(attr.name)
         self._note(idx, attr.name, which)
@@ -174,11 +180,29 @@ def _build_spec(
     aliases = [el.alias for el in inp.elements]
     if len(set(aliases)) != len(aliases):
         raise SiddhiQLError("pattern aliases must be unique")
-    for el in inp.elements:
+    for i, el in enumerate(inp.elements):
         if el.negated:
-            raise SiddhiQLError(
-                "absence ('not') pattern elements are not supported yet"
-            )
+            # mid-chain absence only: `A -> not B -> C` (C must arrive
+            # with no B in between). Timer-based terminal absence
+            # (`... -> not B for 5 sec`) is a later milestone.
+            if inp.kind == "sequence":
+                raise SiddhiQLError(
+                    "absence ('not') is not supported in sequences"
+                )
+            if i == 0:
+                raise SiddhiQLError(
+                    "a pattern cannot start with an absent ('not') element"
+                )
+            if i == len(inp.elements) - 1:
+                raise SiddhiQLError(
+                    "terminal absence ('-> not B') needs a duration and "
+                    "is not supported yet; only mid-chain absence "
+                    "('A -> not B -> C') is"
+                )
+            if (el.min_count, el.max_count) != (1, 1):
+                raise SiddhiQLError(
+                    "absent ('not') elements cannot be quantified"
+                )
         if el.stream_id not in stream_codes:
             raise SiddhiQLError(f"stream {el.stream_id!r} is not defined")
 
@@ -313,25 +337,47 @@ def _from_i32(row, dtype):
 class _ChainCfg:
     """Static (hashable) chain-matcher configuration — everything the
     vmappable core needs besides data. Two queries with equal cfg can run
-    stacked on a query axis (StackedChainArtifact)."""
+    stacked on a query axis (StackedChainArtifact).
 
-    K: int
+    ``positive`` are the original element indices the chain advances
+    through; ``guards[k]`` are the absent ('not') elements between
+    positive steps k-1 and k — a guard match before the step-k match
+    kills the partial (mid-chain absence, `A -> not B -> C`)."""
+
+    K: int  # number of POSITIVE elements
     every: bool
     has_within: bool
     pairs: Tuple[Tuple[int, str], ...]
     cap_dtypes: Tuple[str, ...]  # numpy dtype names, per pair
+    positive: Tuple[int, ...] = ()
+    guards: Tuple[Tuple[int, ...], ...] = ()  # per positive step
 
     @staticmethod
     def of(spec: "_PatternSpec") -> "_ChainCfg":
         pairs = tuple(_cap_pairs(spec))
+        positive = tuple(
+            i for i, el in enumerate(spec.elements) if not el.negated
+        )
+        guards: List[Tuple[int, ...]] = []
+        for k, elem in enumerate(positive):
+            lo = positive[k - 1] if k else -1
+            guards.append(
+                tuple(
+                    g
+                    for g in range(lo + 1, elem)
+                    if spec.elements[g].negated
+                )
+            )
         return _ChainCfg(
-            K=spec.n_elements,
+            K=len(positive),
             every=spec.every,
             has_within=spec.within is not None,
             pairs=pairs,
             cap_dtypes=tuple(
                 np.dtype(spec.cap_dtype[p]).name for p in pairs
             ),
+            positive=positive,
+            guards=tuple(guards),
         )
 
 
@@ -339,7 +385,8 @@ def _chain_core(
     cfg: _ChainCfg,
     P: int,
     state: Dict,
-    preds,  # bool[K, E]
+    preds,  # bool[n_elements, E] — positive AND guard rows, by
+    # ORIGINAL element index (cfg.K counts positive elements only)
     cap_srcs: Dict,  # pair -> value[E]
     within_val,  # int32 scalar (ignored unless cfg.has_within)
     ts,  # int32[E]
@@ -361,13 +408,20 @@ def _chain_core(
     cap_dtypes = {
         p: np.dtype(n) for p, n in zip(cfg.pairs, cfg.cap_dtypes)
     }
+    positive = cfg.positive
+    guards = cfg.guards
+    assert len(positive) == K and len(guards) == K
     arange = jnp.arange(E, dtype=jnp.int32)
 
-    # next_idx[k][p] = min q >= p with preds[k][q], else E; padded so a
+    # next_idx[e][p] = min q >= p with preds[e][q], else E; padded so a
     # gather at position E (or beyond-batch) safely reads "no match".
-    # All K-1 reverse cummins fuse into one Pallas pass on TPU.
+    # Needed for every positive target AND every absence guard; all the
+    # reverse cummins fuse into one Pallas pass on TPU.
+    scan_rows = list(positive[1:]) + [
+        g for gs in guards for g in gs
+    ]
     idxs = [
-        jnp.where(preds[k], arange, E) for k in range(1, K)
+        jnp.where(preds[e], arange, E) for e in scan_rows
     ]
     if use_pallas and idxs:
         from .pallas_ops import multi_reverse_cummin
@@ -378,10 +432,10 @@ def _chain_core(
             jax.lax.associative_scan(jnp.minimum, idx, reverse=True)
             for idx in idxs
         ]
-    nxt = [
-        jnp.concatenate([s, jnp.asarray([E], dtype=jnp.int32)])
-        for s in scans
-    ]
+    nxt = {
+        e: jnp.concatenate([s, jnp.asarray([E], dtype=jnp.int32)])
+        for e, s in zip(scan_rows, scans)
+    }
     ts_pad = jnp.concatenate([ts, jnp.asarray([0], dtype=jnp.int32)])
     env_pad = {
         pair: jnp.concatenate(
@@ -412,11 +466,19 @@ def _chain_core(
         )
         caps[pair] = jnp.concatenate([state[_skey("cap", *pair)], fresh])
 
-    # advance every partial through all remaining elements (K-1 gathers)
+    # advance every partial through all remaining positive elements
+    # (K-1 gathers); absence guards between steps kill a partial when a
+    # guard event arrives at or before the step's own match
     for k in range(1, K):
+        elem = positive[k]
         at_k = v_active & (v_step == k)
-        j = nxt[k - 1][jnp.clip(v_pos, 0, E)]
+        j = nxt[elem][jnp.clip(v_pos, 0, E)]
         found = at_k & (j < E)
+        for g in guards[k]:
+            jg = nxt[g][jnp.clip(v_pos, 0, E)]
+            violated = at_k & (jg <= j) & (jg < E)
+            v_active = v_active & ~violated
+            found = found & ~violated
         ts_j = ts_pad[j]
         if cfg.has_within:
             ok = (ts_j - v_start) <= within_val
@@ -424,7 +486,7 @@ def _chain_core(
             found = found & ok
             v_active = v_active & ~dead
         for pair in pairs:
-            if pair[0] == k:
+            if pair[0] == elem:
                 v = env_pad[pair][j]
                 caps[pair] = jnp.where(found, v, caps[pair])
         v_step = jnp.where(found, k + 1, v_step)
@@ -1121,5 +1183,10 @@ def compile_pattern_query(
     if _is_chain(spec):
         return ChainPatternArtifact(
             name=name, spec=spec, output_schema=out_schema
+        )
+    if any(el.negated for el in spec.elements):
+        raise SiddhiQLError(
+            "absence ('not') elements require a plain chain pattern "
+            "(no quantifiers)"
         )
     return SlotNFAArtifact(name=name, spec=spec, output_schema=out_schema)

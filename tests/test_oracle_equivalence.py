@@ -184,3 +184,60 @@ def test_time_window_groupby_vs_oracle():
         for (gg, gt, gc), (eg, et, ec) in zip(got, expected):
             assert gg == eg and gc == ec
             assert abs(gt - et) < 1e-3 * max(1.0, abs(et))
+
+
+def oracle_absence(ids, ts, a, b, c):
+    """`every A -> not B -> C`: partial opened per A; first later B or C
+    resolves it (B kills, C completes)."""
+    partials = []
+    matches = []
+    for eid, t in zip(ids, ts):
+        resolved = []
+        for i, (ta,) in enumerate(partials):
+            if eid == b:
+                resolved.append(i)  # killed
+            elif eid == c:
+                matches.append((ta, t))
+                resolved.append(i)
+        for i in reversed(resolved):
+            partials.pop(i)
+        if eid == a:
+            partials.append((t,))
+    return sorted(matches)
+
+
+@pytest.mark.parametrize("batch", [11, 128])
+def test_midchain_absence_vs_oracle(batch):
+    rng = np.random.default_rng(5)
+    n = 500
+    ids = rng.integers(0, 6, n).tolist()
+    ts = (1000 + np.arange(n) * 7).tolist()
+    expected = oracle_absence(ids, ts, 1, 2, 3)
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+    )
+    batches = []
+    for s in range(0, n, batch):
+        e = min(s + batch, n)
+        batches.append(
+            EventBatch(
+                "S", schema,
+                {
+                    "id": np.asarray(ids[s:e], np.int32),
+                    "timestamp": np.asarray(ts[s:e], np.int64),
+                },
+                np.asarray(ts[s:e], np.int64),
+            )
+        )
+    plan = compile_plan(
+        "from every s1 = S[id == 1] -> not S[id == 2] -> "
+        "s3 = S[id == 3] select s1.timestamp as t1, "
+        "s3.timestamp as t3 insert into o",
+        {"S": schema},
+    )
+    job = Job(
+        [plan], [BatchSource("S", schema, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    assert sorted(job.results("o")) == expected

@@ -307,3 +307,99 @@ def test_quantified_pattern_compaction_equivalence():
     small = make_job(512)  # full scan path
     assert len(big) > 0
     assert big == small
+
+
+def test_midchain_absence():
+    """`A -> not B -> C`: C completes the match only when no B arrived
+    in between (mid-chain absence)."""
+    from flink_siddhi_tpu import SiddhiCEP
+
+    @dataclasses.dataclass
+    class E:
+        id: int
+        timestamp: int
+
+    # stream: A(1) C(3)      -> match
+    #         A(1) B(2) C(3) -> no match (B intervenes)
+    ev = [E(1, 1000), E(3, 1100), E(1, 2000), E(2, 2100), E(3, 2200),
+          E(1, 3000), E(9, 3100), E(3, 3200)]
+    rows = (
+        SiddhiCEP.define("S", ev, ["id", "timestamp"])
+        .cql(
+            "from every s1 = S[id == 1] -> not S[id == 2] -> "
+            "s3 = S[id == 3] select s1.timestamp as t1, "
+            "s3.timestamp as t3 insert into o"
+        )
+        .returns("o")
+    )
+    assert rows == [(1000, 1100), (3000, 3200)]
+
+
+def test_midchain_absence_across_batches():
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.batch import EventBatch
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+    import numpy as np
+
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+    )
+    # A | (batch boundary) B C  -> killed by B in the later batch
+    # A | C                     -> match across the boundary
+    ids = [1, 7, 2, 3, 1, 7, 3]
+    ts = [1000, 1500, 2000, 2500, 3000, 3500, 4000]
+    batches = [
+        EventBatch("S", schema,
+                   {"id": np.asarray(ids[:2], np.int32),
+                    "timestamp": np.asarray(ts[:2], np.int64)},
+                   np.asarray(ts[:2], np.int64)),
+        EventBatch("S", schema,
+                   {"id": np.asarray(ids[2:], np.int32),
+                    "timestamp": np.asarray(ts[2:], np.int64)},
+                   np.asarray(ts[2:], np.int64)),
+    ]
+    plan = compile_plan(
+        "from every s1 = S[id == 1] -> not S[id == 2] -> "
+        "s3 = S[id == 3] select s1.timestamp as t1, s3.timestamp as t3 "
+        "insert into o",
+        {"S": schema},
+    )
+    job = Job([plan], [BatchSource("S", schema, iter(batches))],
+              batch_size=8, time_mode="processing")
+    job.run()
+    # first A killed by B at 2000; second A matches C at 4000
+    assert job.results("o") == [(3000, 4000)]
+
+
+def test_absence_validation_errors():
+    import pytest
+
+    from flink_siddhi_tpu import SiddhiCEP
+    from flink_siddhi_tpu.query.lexer import SiddhiQLError
+
+    @dataclasses.dataclass
+    class E:
+        id: int
+        timestamp: int
+
+    ev = [E(1, 1000)]
+    base = SiddhiCEP.define("S", ev, ["id", "timestamp"])
+    for bad in (
+        # terminal absence needs a duration (unsupported)
+        "from every s1 = S[id == 1] -> not S[id == 2] "
+        "select s1.id as a insert into o",
+        # absence cannot lead
+        "from not S[id == 2] -> s1 = S[id == 1] "
+        "select s1.id as a insert into o",
+        # absence is pattern-only (no sequences)
+        "from every s1 = S[id == 1], not S[id == 2], s3 = S[id == 3] "
+        "select s1.id as a insert into o",
+        # absent elements cannot be quantified
+        "from every s1 = S[id == 1] -> not S[id == 2]+ -> "
+        "s3 = S[id == 3] select s1.id as a insert into o",
+    ):
+        with pytest.raises(SiddhiQLError):
+            base.cql(bad).returns("o")
