@@ -53,24 +53,37 @@ def oracle_chain(ids, ts, steps, within=None, every=True):
     return sorted(matches)
 
 
-def run_engine(ids, ts, steps, within, batch, every=True):
-    schema = StreamSchema(
-        [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
-    )
-    n = len(ids)
-    batches = []
+ID_TS_SCHEMA = StreamSchema(
+    [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+)
+
+
+def make_batches(schema, cols, ts, batch):
+    """Split columns into EventBatches of ``batch`` events."""
+    n = len(ts)
+    out = []
     for s in range(0, n, batch):
         e = min(s + batch, n)
-        batches.append(
+        out.append(
             EventBatch(
                 "S", schema,
                 {
-                    "id": np.asarray(ids[s:e], np.int32),
-                    "timestamp": np.asarray(ts[s:e], np.int64),
+                    k: np.asarray(v[s:e], dt)
+                    for k, (v, dt) in cols.items()
                 },
                 np.asarray(ts[s:e], np.int64),
             )
         )
+    return out
+
+
+def run_engine(ids, ts, steps, within, batch, every=True):
+    schema = ID_TS_SCHEMA
+    batches = make_batches(
+        schema,
+        {"id": (ids, np.int32), "timestamp": (ts, np.int64)},
+        ts, batch,
+    )
     pat = " -> ".join(
         f"s{k} = S[id == {v}]" for k, v in enumerate(steps)
     )
@@ -154,20 +167,15 @@ def test_time_window_groupby_vs_oracle():
         ]
     )
     for batch in (41, 512):
-        batches = []
-        for s in range(0, n, batch):
-            e = min(s + batch, n)
-            batches.append(
-                EventBatch(
-                    "S", schema,
-                    {
-                        "id": np.asarray(ids[s:e], np.int32),
-                        "v": np.asarray(vals[s:e], np.float64),
-                        "timestamp": np.asarray(ts[s:e], np.int64),
-                    },
-                    np.asarray(ts[s:e], np.int64),
-                )
-            )
+        batches = make_batches(
+            schema,
+            {
+                "id": (ids, np.int32),
+                "v": (vals, np.float64),
+                "timestamp": (ts, np.int64),
+            },
+            ts, batch,
+        )
         plan = compile_plan(
             "from S#window.time(2 sec) select id, sum(v) as t, "
             "count() as c group by id insert into o",
@@ -213,22 +221,12 @@ def test_midchain_absence_vs_oracle(batch):
     ids = rng.integers(0, 6, n).tolist()
     ts = (1000 + np.arange(n) * 7).tolist()
     expected = oracle_absence(ids, ts, 1, 2, 3)
-    schema = StreamSchema(
-        [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+    schema = ID_TS_SCHEMA
+    batches = make_batches(
+        schema,
+        {"id": (ids, np.int32), "timestamp": (ts, np.int64)},
+        ts, batch,
     )
-    batches = []
-    for s in range(0, n, batch):
-        e = min(s + batch, n)
-        batches.append(
-            EventBatch(
-                "S", schema,
-                {
-                    "id": np.asarray(ids[s:e], np.int32),
-                    "timestamp": np.asarray(ts[s:e], np.int64),
-                },
-                np.asarray(ts[s:e], np.int64),
-            )
-        )
     plan = compile_plan(
         "from every s1 = S[id == 1] -> not S[id == 2] -> "
         "s3 = S[id == 3] select s1.timestamp as t1, "
@@ -241,3 +239,108 @@ def test_midchain_absence_vs_oracle(batch):
     )
     job.run()
     assert sorted(job.results("o")) == expected
+
+
+def oracle_sequence(ids, ts, steps, every=True):
+    """Per-event interpreter for `[every] e0, e1, ... (sequence)` where
+    each step is (match_id, min_count, max_count; max -1 = unbounded),
+    following the engine's documented rules (nfa.py module docstring):
+    strict continuity, greedy absorb-before-advance, optional-skip,
+    break kills (emitting if every remaining element is optional), and
+    `every` spawning an independent partial per first-element match.
+
+    Returns sorted tuples of (first_ts of step0, last_ts of step0,
+    ts of final matched step).
+    """
+    matches = []
+
+    def min_sum(a, b):  # sum of min_counts for steps in (a, b)
+        return sum(steps[i][1] for i in range(a + 1, b))
+
+    partials = []  # (step_idx, count, caps)
+    armed_done = False
+
+    def close(caps):
+        nonlocal armed_done
+        matches.append(_seq_result(caps))
+        armed_done = True
+
+    for eid, t in zip(ids, ts):
+        survivors = []
+        for step, count, caps in partials:
+            sid, mn, mx = steps[step]
+            if eid == sid and (mx < 0 or count < mx):
+                caps[step][1] = t
+                if caps[step][0] is None:
+                    caps[step][0] = t
+                if step == len(steps) - 1 and count + 1 == mx:
+                    close(caps)
+                else:
+                    survivors.append((step, count + 1, caps))
+                continue
+            advanced = False
+            if count >= mn:
+                for tgt in range(step + 1, len(steps)):
+                    if min_sum(step, tgt) == 0 and eid == steps[tgt][0]:
+                        caps[tgt][0] = caps[tgt][1] = t
+                        if (
+                            tgt == len(steps) - 1
+                            and steps[tgt][2] == 1
+                        ):
+                            close(caps)
+                        else:
+                            survivors.append((tgt, 1, caps))
+                        advanced = True
+                        break
+            if advanced:
+                continue
+            # break: emit if all remaining elements are optional
+            if count >= mn and min_sum(step, len(steps)) == 0:
+                close(caps)
+        partials = survivors
+        can_arm = every or (not armed_done and not partials)
+        if eid == steps[0][0] and can_arm:
+            caps = [[None, None] for _ in steps]
+            caps[0][0] = caps[0][1] = t
+            if len(steps) == 1 and steps[0][2] == 1:
+                close(caps)
+            else:
+                partials.append((0, 1, caps))
+    return sorted(matches)
+
+
+def _seq_result(caps):
+    last_step = max(i for i, c in enumerate(caps) if c[0] is not None)
+    return (caps[0][0], caps[0][1], caps[last_step][1])
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+@pytest.mark.parametrize("batch", [13, 256])
+def test_sequence_plus_vs_oracle(seed, batch):
+    """`every s1 = A[id==1]+ , s2 = A[id==2]` vs the oracle."""
+    rng = np.random.default_rng(seed)
+    n = 400
+    ids = rng.integers(0, 4, n).tolist()
+    ts = (1000 + np.arange(n) * 3).tolist()
+    expected = oracle_sequence(
+        ids, ts, [(1, 1, -1), (2, 1, 1)]
+    )
+    schema = ID_TS_SCHEMA
+    batches = make_batches(
+        schema,
+        {"id": (ids, np.int32), "timestamp": (ts, np.int64)},
+        ts, batch,
+    )
+    plan = compile_plan(
+        "from every s1 = S[id == 1]+ , s2 = S[id == 2] "
+        "select s1[0].timestamp as a, s1[last].timestamp as b, "
+        "s2.timestamp as c insert into o",
+        {"S": schema},
+    )
+    job = Job(
+        [plan], [BatchSource("S", schema, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    got = sorted(job.results("o"))
+    assert got == expected
