@@ -120,10 +120,12 @@ def warmup() -> bool:
     that is known-good — or silently use the XLA fallback. Returns
     whether the Pallas path is active."""
     global _RUN, _FAILED
+    if not available():
+        # NOT latched: availability is environmental (backend, FST_NO_PALLAS)
+        # and may change — e.g. a CPU-pinned dryrun in a TPU process must not
+        # permanently disable the kernel for later TPU plans
+        return False
     if _RUN is None and not _FAILED:
-        if not available():
-            _FAILED = True
-            return False
         try:
             run = _build()
             # probe spans FOUR grid blocks with random data so both the
