@@ -13,7 +13,7 @@ the in-JVM Siddhi runtime on a single-core 3-step pattern (siddhi-core's
 published simple-filter throughput is low-millions/sec; multi-step pattern
 state machines run well under that). North star: vs_baseline >= 20.
 
-Env knobs: BENCH_EVENTS (default 10_000_000), BENCH_BATCH (default 131072),
+Env knobs: BENCH_EVENTS (default 10_000_000), BENCH_BATCH (default 524288),
 BENCH_CONFIG (headline | filter | pattern2 | window_groupby | multiquery64).
 """
 
@@ -129,22 +129,45 @@ def build_job(config, n_events, batch):
     src = BatchSource("inputStream", schema, iter(batches))
     plan = compile_plan(cql, {"inputStream": schema}, plan_id="bench")
     return Job(
-        [plan], [src], batch_size=batch, time_mode="processing"
+        [plan], [src], batch_size=batch, time_mode="processing",
+        retain_results=False,
     )
 
 
 def main():
     config = os.environ.get("BENCH_CONFIG", "headline")
     n_events = int(os.environ.get("BENCH_EVENTS", 10_000_000))
-    batch = int(os.environ.get("BENCH_BATCH", 131_072))
+    batch = int(os.environ.get("BENCH_BATCH", 524_288))
     warmup_cycles = 3
 
     job = build_job(config, n_events, batch)
+
+    # p99 match latency (the second half of BASELINE.json's metric):
+    # wall time from a batch's ingest (run_cycle start) to its matches
+    # becoming host-visible (sink callback during a drain). Skipped for
+    # high-match-rate configs where per-row sink callbacks would
+    # themselves distort throughput.
+    arrivals = []
+    latencies = []
+    measure_latency = config in ("headline", "pattern2")
+    if measure_latency:
+        def sink(abs_ts, _row, _arr=arrivals, _lat=latencies):
+            # bench timestamps are 1000 + 1*index, so the emitting
+            # event's batch (= ingest cycle) is recoverable from ts
+            b = (abs_ts - 1_000) // batch
+            if warmup_cycles <= b < len(_arr):
+                _lat.append(time.perf_counter() - _arr[b])
+
+        for rt in job._plans.values():
+            for out_stream in rt.plan.output_streams():
+                job.add_sink(out_stream, sink)
+
     cycles = 0
     t_start = time.perf_counter()
     t0 = t_start
     counted_at = 0
     while not job.finished:
+        arrivals.append(time.perf_counter())
         job.run_cycle()
         cycles += 1
         if cycles == warmup_cycles:
@@ -159,18 +182,20 @@ def main():
         measured = job.processed_events
         elapsed = time.perf_counter() - t_start
     ev_per_sec = measured / max(elapsed, 1e-9)
-    print(
-        json.dumps(
-            {
-                "metric": f"events/sec ({config}, {n_events} events)",
-                "value": round(ev_per_sec, 1),
-                "unit": "events/sec",
-                "vs_baseline": round(
-                    ev_per_sec / BASELINE_EVENTS_PER_SEC, 3
-                ),
-            }
+    out = {
+        "metric": f"events/sec ({config}, {n_events} events)",
+        "value": round(ev_per_sec, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(ev_per_sec / BASELINE_EVENTS_PER_SEC, 3),
+    }
+    if latencies:
+        out["p99_match_latency_ms"] = round(
+            1e3 * float(np.percentile(latencies, 99)), 1
         )
-    )
+        out["p50_match_latency_ms"] = round(
+            1e3 * float(np.percentile(latencies, 50)), 1
+        )
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -53,6 +53,8 @@ class PipelineConfig:
     csv_header: bool = False
     csv_delim: str = ","
     chunk_bytes: int = 1 << 20  # ingest read granularity
+    allowed_lateness_ms: int = 0  # bounded ts disorder in the input
+    # (watermark holdback; 0 requires globally sorted ts_field)
 
     def schema(self) -> StreamSchema:
         return StreamSchema(
@@ -94,11 +96,13 @@ class CEPPipeline:
                 cfg.stream_id, schema, cfg.input_path,
                 delim=cfg.csv_delim, header=cfg.csv_header,
                 ts_field=cfg.ts_field, chunk_bytes=cfg.chunk_bytes,
+                allowed_lateness_ms=cfg.allowed_lateness_ms,
             )
         else:
             src = JsonLinesSource(
                 cfg.stream_id, schema, cfg.input_path,
                 ts_field=cfg.ts_field, chunk_bytes=cfg.chunk_bytes,
+                allowed_lateness_ms=cfg.allowed_lateness_ms,
             )
         plan = compile_plan(
             cfg.cql, {cfg.stream_id: schema}, extensions=self.extensions
@@ -108,6 +112,9 @@ class CEPPipeline:
             [src],
             batch_size=cfg.batch_size,
             time_mode=cfg.time_mode,
+            # rows go to the sink file; retaining them host-side too would
+            # grow memory without bound over an unbounded input stream
+            retain_results=False,
             control_sources=self._control_sources,
             plan_compiler=lambda cql, plan_id: compile_plan(
                 cql, {cfg.stream_id: schema},

@@ -328,6 +328,15 @@ class ColumnDecoder:
                         v = cell
                     elif kind == KIND_DOUBLE:
                         v = float(cell)  # '' / None invalid, like native
+                    elif (
+                        kind == KIND_BOOL
+                        and cell is not None
+                        and cell.strip().lower() in ("true", "false")
+                    ):
+                        # parity with the JSON path (and the native CSV
+                        # decoder): bool cells accept the literals, not
+                        # just 0/1
+                        v = 1 if cell.strip().lower() == "true" else 0
                     else:
                         v = int(cell)
                 except (TypeError, ValueError):

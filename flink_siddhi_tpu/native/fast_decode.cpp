@@ -13,6 +13,7 @@
 // Field kinds: 0 = int64, 1 = double, 2 = string (-> int64 code), 3 = bool.
 
 #include <algorithm>
+#include <charconv>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -21,6 +22,47 @@
 #include <vector>
 
 namespace {
+
+// Locale-independent numeric parsing (std::from_chars): strtod/strtoll are
+// LC_NUMERIC-sensitive — an embedding process with a comma-decimal locale
+// would silently parse "1.5" as 1 + trailing garbage and flag the row
+// invalid, diverging from the Python fallback. from_chars is also bounded
+// by an explicit end pointer (the input buffer is not NUL-terminated).
+// Out-of-range magnitudes are treated as parse failures (invalid row).
+inline bool parse_f64(const char* p, const char* end, double& v,
+                      const char*& ep) {
+    auto r = std::from_chars(p, end, v, std::chars_format::general);
+    if (r.ec != std::errc()) return false;
+    ep = r.ptr;
+    return true;
+}
+
+inline bool parse_i64(const char* p, const char* end, long long& v,
+                      const char*& ep) {
+    auto r = std::from_chars(p, end, v, 10);
+    if (r.ec != std::errc()) return false;
+    ep = r.ptr;
+    return true;
+}
+
+// Case-insensitive "true"/"false" for bool cells (kind 3). The JSON path
+// accepts the literals; CSV must too, or 'true' cells invalidate the row.
+inline bool parse_bool_word(const char* p, const char* end, long long& v) {
+    size_t len = static_cast<size_t>(end - p);
+    if (len == 4 && (p[0] == 't' || p[0] == 'T') &&
+        (p[1] == 'r' || p[1] == 'R') && (p[2] == 'u' || p[2] == 'U') &&
+        (p[3] == 'e' || p[3] == 'E')) {
+        v = 1;
+        return true;
+    }
+    if (len == 5 && (p[0] == 'f' || p[0] == 'F') &&
+        (p[1] == 'a' || p[1] == 'A') && (p[2] == 'l' || p[2] == 'L') &&
+        (p[3] == 's' || p[3] == 'S') && (p[4] == 'e' || p[4] == 'E')) {
+        v = 0;
+        return true;
+    }
+    return false;
+}
 
 struct Interner {
     std::unordered_map<std::string, int64_t> codes;
@@ -208,19 +250,19 @@ bool store_value(const FieldSpec& f, long row, Cursor& c) {
         return true;
     }
     // number
-    char* endptr = nullptr;
+    const char* endptr = nullptr;
     if (f.kind == 1) {
-        double v = std::strtod(c.p, &endptr);
-        if (endptr == c.p || endptr > c.end) return false;
+        double v;
+        if (!parse_f64(c.p, c.end, v, endptr)) return false;
         static_cast<double*>(f.out)[row] = v;
     } else {
-        // ints may still arrive as "1.5e3" — fall back through strtod
-        long long v = std::strtoll(c.p, &endptr, 10);
-        if (endptr == c.p || endptr > c.end) return false;
+        // ints may still arrive as "1.5e3" — fall back through double
+        long long v;
+        if (!parse_i64(c.p, c.end, v, endptr)) return false;
         if (endptr < c.end && (*endptr == '.' || *endptr == 'e' ||
                                *endptr == 'E')) {
-            double dv = std::strtod(c.p, &endptr);
-            if (endptr == c.p || endptr > c.end) return false;
+            double dv;
+            if (!parse_f64(c.p, c.end, dv, endptr)) return false;
             v = static_cast<long long>(dv);
         }
         static_cast<int64_t*>(f.out)[row] = v;
@@ -391,16 +433,34 @@ long long fd_decode_csv(const char* buf, long long buflen, const int* kinds,
             } else if (cell == cell_end) {
                 ok = false;  // empty numeric cell: invalid row
                 break;
-            } else if (f.kind == 1) {
-                char* ep = nullptr;
-                double v = std::strtod(cell, &ep);
-                if (ep != cell_end) { ok = false; break; }
-                static_cast<double*>(f.out)[row] = v;
             } else {
-                char* ep = nullptr;
-                long long v = std::strtoll(cell, &ep, 10);
-                if (ep != cell_end) { ok = false; break; }
-                static_cast<int64_t*>(f.out)[row] = v;
+                // parity with the Python fallback's int()/float(): strip
+                // surrounding whitespace and accept one leading '+'
+                // (from_chars itself recognizes neither)
+                while (cell < cell_end &&
+                       (*cell == ' ' || *cell == '\t')) ++cell;
+                while (cell_end > cell && (cell_end[-1] == ' ' ||
+                                           cell_end[-1] == '\t' ||
+                                           cell_end[-1] == '\r')) --cell_end;
+                const char* num = cell;
+                if (num < cell_end && *num == '+') ++num;
+                if (f.kind == 1) {
+                    const char* ep = nullptr;
+                    double v;
+                    if (num == cell_end || !parse_f64(num, cell_end, v, ep) ||
+                        ep != cell_end) { ok = false; break; }
+                    static_cast<double*>(f.out)[row] = v;
+                } else {
+                    long long v;
+                    if (f.kind == 3 && parse_bool_word(cell, cell_end, v)) {
+                        static_cast<int64_t*>(f.out)[row] = v;
+                        continue;
+                    }
+                    const char* ep = nullptr;
+                    if (num == cell_end || !parse_i64(num, cell_end, v, ep) ||
+                        ep != cell_end) { ok = false; break; }
+                    static_cast<int64_t*>(f.out)[row] = v;
+                }
             }
         }
         if (!ok)

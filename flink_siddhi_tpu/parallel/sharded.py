@@ -176,11 +176,14 @@ class ShardedJob(Job):
         if not involved:
             return
         shards = self._routers[plan.plan_id].route_all(involved)
-        cap = bucket_size(
-            max(sum(len(b) for b in sh) for sh in shards) or 1
+        # sticky capacity: pad the end-of-stream tail up to the compiled
+        # shape instead of bucketing down into a fresh XLA executable
+        rt.tape_capacity = max(
+            rt.tape_capacity,
+            bucket_size(max(sum(len(b) for b in sh) for sh in shards) or 1),
         )
         tapes = [
-            build_tape(plan.spec, sh, self._epoch_ms, cap)[0]
+            build_tape(plan.spec, sh, self._epoch_ms, rt.tape_capacity)[0]
             for sh in shards
         ]
         stacked_tape = _tree_stack(
@@ -223,7 +226,12 @@ class ShardedJob(Job):
             return
         if min_fill > 0 and max_n < min_fill * rt.plan.acc_capacity():
             return
-        data = np.asarray(rt.acc["buf"][:, :, :max_n])  # fetch two
+        # bucketed fetch width: stable slice shapes (see Job._drain_plan)
+        fetch_n = min(bucket_size(max_n, minimum=1024),
+                      rt.plan.acc_capacity())
+        data = np.asarray(
+            rt.acc["buf"][:, :, :fetch_n]
+        )[:, :, :max_n]  # fetch two
         rt.acc = rt.jitted_init_acc()
         rt._overflow_seen = None  # counters reset with the accumulator
         # merge each output's per-shard (already time-ordered) rows by

@@ -151,7 +151,16 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
             ref = rt.plan.grow_state(rt.states)
         restored_states = prec["states"]
         _check_compatible(ref, restored_states, plan_id)
-        rt.states = restored_states
+        # place restored host arrays on device NOW (with the plan's sharding
+        # in a sharded job): leaving numpy in rt.states makes the first
+        # post-restore step's donate_argnums unusable (extra copy + JAX
+        # 'donated buffers were not usable' warning)
+        sharding = getattr(job, "_state_sharding", None)
+        rt.states = (
+            jax.device_put(restored_states, sharding)
+            if sharding is not None
+            else jax.device_put(restored_states)
+        )
         rt.enabled = prec["enabled"]
         # output accumulators are drained pre-snapshot, never checkpointed
         if getattr(rt, "acc", None) is not None:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import heapq
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -25,7 +26,7 @@ import numpy as np
 from ..compiler.plan import CompiledPlan
 from ..schema.batch import EventBatch
 from .sources import Source
-from .tape import build_wire_tape
+from .tape import bucket_size, build_wire_tape
 
 MAX_WM = np.iinfo(np.int64).max
 MIN_WM = -(2 ** 62)  # pre-first-event watermark sentinel
@@ -39,9 +40,16 @@ class _PlanRuntime:
     jitted: Callable  # plan.step (kept for direct/step callers)
     jitted_acc: Callable = None  # plan.step_acc — the hot loop entry
     jitted_init_acc: Callable = None  # cached: zeroing program compiles once
+    jitted_flush: Callable = None  # plan.flush under jit (device states)
     acc: Dict = None  # device-side output accumulator (None: fetch-per-cycle)
     wire_kinds: Dict = None  # sticky per-column wire widths (build_wire_tape)
     enabled: bool = True
+    # sticky tape capacity: once a capacity is compiled, smaller batches
+    # (e.g. the end-of-stream tail) pad up to it instead of bucketing down
+    # — a mid-run capacity change costs a whole new XLA executable
+    tape_capacity: int = 0
+    flush_warm: object = None  # background flush-precompile future
+    inflight: int = 0  # dispatched cycles since the last device sync
 
 
 class Job:
@@ -55,11 +63,15 @@ class Job:
         time_mode: str = "event",  # 'event' | 'processing'
         control_sources: Sequence = (),
         plan_compiler: Optional[Callable] = None,  # (cql, plan_id) -> plan
+        retain_results: bool = True,  # keep rows in collected[] even when
+        # sinks consume them; False = sink-only streams don't grow host
+        # memory over an unbounded run (long-running pipeline mode)
     ) -> None:
         if time_mode not in ("event", "processing"):
             raise ValueError(time_mode)
         self.batch_size = batch_size
         self.time_mode = time_mode
+        self.retain_results = retain_results
         self._sources = list(sources)
         self._source_wm: List[int] = [MIN_WM] * len(self._sources)
         self._source_done: List[bool] = [False] * len(self._sources)
@@ -77,12 +89,25 @@ class Job:
         # output_stream -> list[(ts, row_tuple)] and field names
         self.collected: Dict[str, List[Tuple[int, Tuple]]] = {}
         self.output_fields: Dict[str, List[str]] = {}
+        self.emitted_counts: Dict[str, int] = {}  # total rows ever emitted
         self._sinks: Dict[str, List[Callable]] = {}
         self.processed_events = 0  # observability (reference logs per runtime)
         # drain the device accumulators at least every N cycles so a
         # long-running job can't overflow them (2 fetches per plan per drain)
         self.drain_every_cycles = 256
+        # bound match-visibility latency: a FULL drain (decode whatever has
+        # accumulated, not just a capacity check) at least this often. Each
+        # full drain costs a host sync (~one tunnel RTT), so this knob trades
+        # p99 match latency against pipeline depth.
+        self.drain_interval_ms = 500.0
+        self._last_full_drain = time.monotonic()
         self._cycles_since_drain = 0
+        # backpressure: cap dispatched-but-unfinished device cycles per
+        # plan. Without it the host races ahead of the device and match
+        # latency grows with the whole backlog; with it, latency is
+        # bounded by ~max_inflight_cycles * device_cycle_time + drain
+        # interval, and the device stays fed as long as it is >= 2.
+        self.max_inflight_cycles = 6
         # per-plan capacity-check cadence (recomputed as plans come and go)
         self._drain_hints: Dict[str, int] = {}
 
@@ -108,6 +133,7 @@ class Job:
             # every micro-batch
             jitted_acc=jax.jit(step_wire, donate_argnums=(0, 1)),
             jitted_init_acc=init_acc,
+            jitted_flush=jax.jit(plan.flush),
             acc=init_acc(),
             wire_kinds={},
         )
@@ -174,11 +200,56 @@ class Job:
         incomplete window out)."""
         for rt in self._plans.values():
             self._drain_plan(rt)
-            rt.states, outputs = rt.plan.flush(rt.states)
+            rt.states, outputs = self._flush_fn(rt)(rt.states)
             if outputs:
                 self._decode_outputs(
                     rt.plan, outputs, only=set(outputs)
                 )
+
+    @staticmethod
+    def _state_sig(states) -> Tuple:
+        return tuple(
+            (np.shape(x), np.dtype(getattr(x, "dtype", type(x))))
+            for x in jax.tree.leaves(states)
+        )
+
+    def _warm_flush(self, rt: _PlanRuntime) -> None:
+        """Precompile the end-of-stream flush program in the background:
+        its (cached) compile/deserialize costs seconds and would otherwise
+        land synchronously inside the final flush() call. Re-armed by
+        _step_plan whenever the state shapes change (group-table growth),
+        so the warm executable tracks the shapes flush() will see."""
+        import concurrent.futures
+
+        sig = self._state_sig(rt.states)
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), rt.states
+        )
+
+        def compile_it():
+            return rt.jitted_flush.lower(abstract).compile()
+
+        pool = getattr(self, "_compile_pool", None)
+        if pool is None:
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="fst-warm"
+            )
+            self._compile_pool = pool
+        rt.flush_warm = (sig, pool.submit(compile_it))
+
+    def _flush_fn(self, rt: _PlanRuntime) -> Callable:
+        """The flush executable: the background-precompiled one when its
+        input shapes still match, else the lazily-jitted fallback. The
+        signature check happens BEFORE blocking on the future, so a stale
+        warm compile is never waited for."""
+        if rt.flush_warm is not None:
+            sig, fut = rt.flush_warm
+            if sig == self._state_sig(rt.states):
+                try:
+                    return fut.result()
+                except Exception:
+                    pass  # fall back to the jit path
+        return rt.jitted_flush
 
     def drain_outputs(self, min_fill: float = 0.0) -> None:
         """Fetch and decode all on-device accumulated emissions (two
@@ -208,7 +279,12 @@ class Job:
             return
         if min_fill > 0 and max_n < min_fill * rt.plan.acc_capacity():
             return  # capacity check only: plenty of headroom, keep batching
-        data = np.asarray(rt.acc["buf"][:, :max_n])  # fetch 2
+        # bucket the fetch width: a distinct slice shape per drain would
+        # compile a fresh eager slice program every time (~1s each on a
+        # tunneled device); bucketing keeps it to a handful of shapes
+        fetch_n = min(bucket_size(max_n, minimum=1024),
+                      rt.plan.acc_capacity())
+        data = np.asarray(rt.acc["buf"][:, :fetch_n])[:, :max_n]  # fetch 2
         rt.acc = rt.jitted_init_acc()
         rt._overflow_seen = None  # counters reset with the accumulator
         decoded = rt.plan.drain_decode(counts, data)
@@ -222,17 +298,27 @@ class Job:
             return
         sid = schema.stream_id
         self.output_fields.setdefault(sid, schema.field_names)
-        bucket = self.collected.setdefault(sid, [])
         epoch = self._epoch_ms or 0
         sinks = self._sinks.get(sid)
+        self.emitted_counts[sid] = self.emitted_counts.get(sid, 0) + len(rows)
         if not sinks:  # bulk path: drains can carry millions of rows
-            bucket.extend(
+            self.collected.setdefault(sid, []).extend(
                 (epoch + rel_ts, row) for rel_ts, row in rows
             )
             return
+        # sink-consumed streams only retain rows when asked: an unbounded
+        # stream would otherwise grow collected[] without bound (the
+        # reference's StreamOutputHandler never retains — it collects
+        # downstream, StreamOutputHandler.java:62-92)
+        bucket = (
+            self.collected.setdefault(sid, [])
+            if self.retain_results
+            else None
+        )
         for rel_ts, row in rows:
             abs_ts = epoch + rel_ts
-            bucket.append((abs_ts, row))
+            if bucket is not None:
+                bucket.append((abs_ts, row))
             for sink in sinks:
                 sink(abs_ts, row)
 
@@ -253,17 +339,32 @@ class Job:
         self._pull_control()
         self._apply_ready_control()
         ready = self._release_ready()
-        if not ready:
-            return 0
-        total = sum(len(b) for b in ready)
-        self.processed_events += total
-        if self._epoch_ms is None:
-            self._epoch_ms = min(int(b.timestamps.min()) for b in ready)
-        for rt in list(self._plans.values()):
-            if rt.enabled:
-                self._step_plan(rt, ready)
-        self._cycles_since_drain += 1
-        if self._cycles_since_drain >= min(
+        total = 0
+        if ready:
+            total = sum(len(b) for b in ready)
+            self.processed_events += total
+            if self._epoch_ms is None:
+                self._epoch_ms = min(
+                    int(b.timestamps.min()) for b in ready
+                )
+            for rt in list(self._plans.values()):
+                if rt.enabled:
+                    self._step_plan(rt, ready)
+            self._cycles_since_drain += 1
+        now = time.monotonic()
+        if (
+            self.drain_interval_ms is not None
+            and (now - self._last_full_drain) * 1000.0
+            >= self.drain_interval_ms
+        ):
+            # latency-bounding drain: surface accumulated matches to
+            # collectors/sinks even when the buffer is nearly empty —
+            # including on idle cycles (a stalled source must not delay
+            # visibility of matches already produced)
+            self.drain_outputs()
+            self._cycles_since_drain = 0
+            self._last_full_drain = time.monotonic()
+        elif ready and self._cycles_since_drain >= min(
             self.drain_every_cycles,
             min(self._drain_hints.values(), default=self.drain_every_cycles),
         ):
@@ -352,8 +453,11 @@ class Job:
         ]
         if not involved:
             return
+        total = sum(len(b) for b in involved)
+        rt.tape_capacity = max(rt.tape_capacity, bucket_size(total))
         tape, _prov = build_wire_tape(
-            plan.spec, involved, self._epoch_ms, rt.wire_kinds
+            plan.spec, involved, self._epoch_ms, rt.wire_kinds,
+            capacity=rt.tape_capacity,
         )
         # host interning may have discovered new group keys: re-bucket state
         # tables before the jit call (shape change -> one-off retrace)
@@ -361,9 +465,21 @@ class Job:
         # NO device->host fetch here: emissions append to the on-device
         # accumulator and are drained in bulk (flush/results/periodic check)
         rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, tape)
+        # sawtooth backpressure: every K cycles wait for the device to
+        # catch up to NOW (the current states leaf is not yet donated, so
+        # this is safe); bounds in-flight work without holding references
+        # that would defeat buffer donation
+        rt.inflight = (rt.inflight or 0) + 1
+        if rt.inflight >= self.max_inflight_cycles:
+            jax.block_until_ready(jax.tree.leaves(rt.states)[0])
+            rt.inflight = 0
         self._update_drain_hint(
             plan, tape.capacity, lambda name: rt.states.get(name)
         )
+        if rt.flush_warm is None or (
+            rt.flush_warm[0] != self._state_sig(rt.states)
+        ):
+            self._warm_flush(rt)
 
     def _update_drain_hint(self, plan, tape_capacity, state_of) -> None:
         """Capacity-check cadence: each artifact declares its widest
@@ -470,10 +586,7 @@ class Job:
                 pid: {"enabled": rt.enabled}
                 for pid, rt in list(self._plans.items())
             },
-            "emitted": {
-                sid: len(rows)
-                for sid, rows in list(self.collected.items())
-            },
+            "emitted": dict(self.emitted_counts),
             "pending_batches": sum(
                 len(b) for b in list(self._pending.values())
             ),

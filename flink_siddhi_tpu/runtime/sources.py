@@ -190,7 +190,15 @@ class _DecodedLinesSource(Source):
     columnar decoder (flink_siddhi_tpu/native): reads a chunk of lines,
     decodes to columns in C++ (pure-Python fallback), assembles an
     EventBatch. Timestamps come from ``ts_field`` (epoch ms) or arrival
-    order."""
+    order.
+
+    Watermarks advance to each decoded chunk's max timestamp minus
+    ``allowed_lateness_ms``. With the default 0 the input's ``ts_field``
+    must be globally non-decreasing across chunks — a later chunk holding
+    older timestamps would be released after newer events and silently
+    change pattern/window results. For inputs with bounded disorder, set
+    ``allowed_lateness_ms`` to the max expected skew so the executor's
+    reorder buffer can re-sort within that horizon."""
 
     def __init__(
         self,
@@ -200,6 +208,7 @@ class _DecodedLinesSource(Source):
         ts_field: Optional[str] = None,
         chunk_bytes: int = 1 << 20,
         drop_invalid: bool = True,
+        allowed_lateness_ms: int = 0,
     ) -> None:
         from ..native import (
             KIND_BOOL,
@@ -219,6 +228,7 @@ class _DecodedLinesSource(Source):
         self._carry = b""
         self._done = False
         self._arrival = 0
+        self._lateness = int(allowed_lateness_ms)
         kind_of = {
             AttributeType.INT: KIND_INT,
             AttributeType.LONG: KIND_INT,
@@ -298,7 +308,7 @@ class _DecodedLinesSource(Source):
             columns = {k: v[keep] for k, v in columns.items()}
             ts = ts[keep]
         batch = EventBatch(self.stream_id, self.schema, columns, ts)
-        wm = int(ts.max()) if len(ts) else None
+        wm = int(ts.max()) - self._lateness if len(ts) else None
         if self._done:
             wm = np.iinfo(np.int64).max
         return (batch if len(ts) else None), wm, self._done

@@ -1,0 +1,126 @@
+"""Profile the headline bench hot loop on the real TPU: where does each
+cycle's wall time go? (host tape build vs transfer vs device step vs
+fetches). Run: python scripts/profile_hotloop.py [n_events] [batch]."""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+import jax
+import numpy as np
+
+from bench import build_job, make_batches
+
+
+def main():
+    n_events = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 131_072
+
+    config = os.environ.get("BENCH_CONFIG", "headline")
+    t0 = time.perf_counter()
+    job = build_job(config, n_events, batch)
+    print(f"build_job: {time.perf_counter() - t0:.2f}s")
+
+    # phase timers, monkeypatched around the executor internals
+    from flink_siddhi_tpu.runtime import executor as ex
+    from flink_siddhi_tpu.runtime import tape as tp
+
+    timers = {"pull": 0.0, "release": 0.0, "tape": 0.0, "step": 0.0,
+              "drain": 0.0, "decode": 0.0}
+    orig_pull = job._pull_sources
+    orig_release = job._release_ready
+    orig_tape = tp.build_wire_tape
+    orig_drain = job._drain_plan
+
+    def timed_drain(rt, min_fill=0.0):
+        t = time.perf_counter()
+        r = orig_drain(rt, min_fill)
+        timers["drain"] += time.perf_counter() - t
+        return r
+
+    job._drain_plan = timed_drain
+
+    def timed_pull():
+        t = time.perf_counter(); r = orig_pull(); timers["pull"] += time.perf_counter() - t; return r
+
+    def timed_release():
+        t = time.perf_counter(); r = orig_release(); timers["release"] += time.perf_counter() - t; return r
+
+    def timed_tape(*a, **k):
+        t = time.perf_counter(); r = orig_tape(*a, **k); timers["tape"] += time.perf_counter() - t; return r
+
+    job._pull_sources = timed_pull
+    job._release_ready = timed_release
+    ex.build_wire_tape = timed_tape
+
+    rt = list(job._plans.values())[0]
+    orig_decode = rt.plan.drain_decode
+
+    def timed_decode(counts, data):
+        t = time.perf_counter()
+        r = orig_decode(counts, data)
+        timers["decode"] += time.perf_counter() - t
+        return r
+
+    rt.plan.drain_decode = timed_decode
+    orig_acc = rt.jitted_acc
+
+    def timed_acc(states, acc, wire):
+        t = time.perf_counter()
+        out = orig_acc(states, acc, wire)
+        timers["step"] += time.perf_counter() - t  # dispatch (async) time
+        return out
+
+    rt.jitted_acc = timed_acc
+
+    sync_each = bool(os.environ.get("PROF_SYNC"))
+    warmup = 3
+    cycles = 0
+    t_start = time.perf_counter()
+    t_meas = t_start
+    counted = 0
+    cycle_walls = []
+    while not job.finished:
+        c0 = time.perf_counter()
+        job.run_cycle()
+        if sync_each:
+            jax.block_until_ready(rt.states)
+        dt = time.perf_counter() - c0
+        cycle_walls.append(dt)
+        if sync_each and cycles < 20:
+            print(f"  cycle {cycles}: {dt*1e3:.1f}ms")
+        cycles += 1
+        if cycles == warmup:
+            t_meas = time.perf_counter()
+            counted = job.processed_events
+            for k in timers:
+                timers[k] = 0.0
+    t_sync0 = time.perf_counter()
+    jax.block_until_ready(rt.states)
+    sync_tail = time.perf_counter() - t_sync0
+    t_flush0 = time.perf_counter()
+    job.flush()
+    flush_t = time.perf_counter() - t_flush0
+    elapsed = time.perf_counter() - t_meas
+    measured = job.processed_events - counted
+    walls = np.array(cycle_walls[warmup:])
+    print(f"cycles: {cycles}, measured events: {measured}")
+    print(f"elapsed (post-warmup): {elapsed:.3f}s -> {measured/elapsed:,.0f} ev/s")
+    print(f"device sync tail: {sync_tail:.3f}s  flush: {flush_t:.3f}s")
+    print("phase totals (post-warmup):",
+          {k: round(v, 3) for k, v in timers.items()})
+    print(f"cycle wall: mean {walls.mean()*1e3:.1f}ms p50 "
+          f"{np.percentile(walls,50)*1e3:.1f}ms p99 "
+          f"{np.percentile(walls,99)*1e3:.1f}ms max {walls.max()*1e3:.1f}ms")
+    print("matches:", len(job.results("matches")))
+
+
+if __name__ == "__main__":
+    main()

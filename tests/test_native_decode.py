@@ -211,3 +211,91 @@ def test_csv_source_e2e(tmp_path):
     job = Job([plan], [src], batch_size=64)
     job.run()
     assert len(job.results("big")) == 9
+
+
+def test_csv_bool_literals_both_decoders():
+    # bool cells accept case-insensitive true/false (and 0/1), matching
+    # the JSON path; previously only strtoll parsed and 'true' cells
+    # silently invalidated the row
+    from flink_siddhi_tpu.native import KIND_BOOL
+
+    def make_bool_decoder():
+        table = StringTable()
+        fields = [("id", KIND_INT, None), ("flag", KIND_BOOL, None)]
+        return ColumnDecoder(fields)
+
+    data = (
+        b"1,true\n2,False\n3,TRUE\n4,0\n5,1\n6,maybe\n"
+        b"+7,true \n 8 , FALSE\n"  # signs/whitespace: int()/float() parity
+    )
+    native_dec = make_bool_decoder()
+    py_dec = make_bool_decoder()
+    py_dec._lib = None  # force fallback
+    py_dec._mirrors = []
+    for dec in (native_dec, py_dec):
+        cols, valid, n = dec.decode_csv(data, 10)
+        assert n == 8
+        assert valid.tolist() == [1, 1, 1, 1, 1, 0, 1, 1], dec.native
+        assert cols[0][6:8].tolist() == [7, 8], dec.native
+        assert (
+            cols[1][:5].tolist() + cols[1][6:8].tolist()
+        ) == [1, 0, 1, 0, 1, 1, 0], dec.native
+
+
+def test_source_allowed_lateness(tmp_path):
+    # bounded-disorder input: with allowed_lateness_ms the watermark holds
+    # back, so a later chunk carrying older timestamps still reorders
+    # correctly through the executor's reorder buffer
+    from flink_siddhi_tpu.runtime.sources import JsonLinesSource
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+    )
+    lines = [
+        {"id": 0, "timestamp": 1000},
+        {"id": 1, "timestamp": 1200},  # chunk 1 max ts = 1200
+        {"id": 2, "timestamp": 1100},  # older than chunk 1's max
+        {"id": 3, "timestamp": 1300},
+    ]
+    raw = "\n".join(json.dumps(r) for r in lines).encode() + b"\n"
+    src = JsonLinesSource(
+        "S", schema, io.BytesIO(raw), ts_field="timestamp",
+        chunk_bytes=40, allowed_lateness_ms=200,
+    )
+    batch, wm, done = src.poll(10)
+    assert wm == int(batch.timestamps.max()) - 200
+
+
+def test_sink_streams_skip_retention_when_disabled():
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.batch import EventBatch
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+    )
+    ids = np.arange(100, dtype=np.int64) % 4
+    ts = 1000 + np.arange(100, dtype=np.int64)
+    batch = EventBatch("S", schema, {"id": ids, "timestamp": ts}, ts)
+    plan = compile_plan(
+        "from S[id == 2] select id, timestamp insert into out",
+        {"S": schema},
+    )
+    got = []
+    job = Job(
+        [plan],
+        [BatchSource("S", schema, iter([batch]))],
+        batch_size=64,
+        retain_results=False,
+    )
+    job.add_sink("out", lambda ts, row: got.append(row))
+    job.run()
+    assert len(got) == 25
+    # sink consumed every row; host retention skipped, counter still live
+    assert job.results("out") == []
+    assert job.emitted_counts["out"] == 25
