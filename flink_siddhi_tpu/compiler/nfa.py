@@ -140,6 +140,69 @@ class CaptureResolver:
         return ResolvedAttr(_cap_key(alias, which, attr.name), atype, table)
 
 
+class _ElemFilterResolver:
+    """Resolves an element filter that references earlier elements'
+    captures: own attributes -> tape columns (recorded in ``evt_keys``),
+    foreign aliases -> capture env keys via the shared CaptureResolver
+    (which records the capture for slot state)."""
+
+    def __init__(
+        self,
+        own_idx: int,
+        own_el,
+        own_schema,
+        elements,
+        cap_resolver: "CaptureResolver",
+        evt_keys: List[str],
+    ) -> None:
+        self._own_idx = own_idx
+        self._own = own_el
+        self._schema = own_schema
+        self._elements = elements
+        self._cap = cap_resolver
+        self._evt_keys = evt_keys
+        self._aliases = {el.alias for el in elements}
+
+    def resolve(self, attr: ast.Attr) -> ResolvedAttr:
+        q = attr.qualifier
+        own = q is None or q == self._own.alias or (
+            q == self._own.stream_id and q not in self._aliases
+        )
+        if own:
+            if attr.index is not None:
+                raise SiddhiQLError(
+                    "indexed references are not valid on the element's "
+                    "own attributes in a filter"
+                )
+            if attr.name not in self._schema:
+                raise SiddhiQLError(
+                    f"stream {self._own.stream_id!r} has no attribute "
+                    f"{attr.name!r}"
+                )
+            key = f"{self._own.stream_id}.{attr.name}"
+            if key not in self._evt_keys:
+                self._evt_keys.append(key)
+            return ResolvedAttr(
+                key,
+                self._schema.field_type(attr.name),
+                self._schema.string_tables.get(attr.name),
+            )
+        info = self._cap._by_alias.get(q)
+        if info is None:
+            raise SiddhiQLError(f"unknown stream reference {q!r}")
+        ref_idx = info[0]
+        if ref_idx >= self._own_idx:
+            raise SiddhiQLError(
+                f"element filter of {self._own.alias!r} can only "
+                f"reference EARLIER elements; {q!r} has not matched yet"
+            )
+        if self._elements[ref_idx].negated:
+            raise SiddhiQLError(
+                f"cannot reference absent ('not') element {q!r} in a filter"
+            )
+        return self._cap.resolve(attr)
+
+
 # --------------------------------------------------------------------------
 # Shared compile-time pieces
 # --------------------------------------------------------------------------
@@ -163,10 +226,27 @@ class _PatternSpec:
     # plain capture reference, else None (lets the stacked engine emit
     # straight from the stacked capture buffers with zero per-query ops)
     proj_srcs: Tuple[Optional[Tuple[int, str]], ...] = ()
+    # cross-element filters (`s2 = S[price > s1.price]`): per element,
+    # the full filter compiled against BOTH the current event's columns
+    # and earlier elements' captures; such elements have pred_fns None
+    # (the event-only mask is just the stream gate) and are evaluated
+    # per-slot inside the scan engine. siddhi-core supports these
+    # conditions natively (SURVEY.md §2.10 pattern surface).
+    cross_fns: Tuple[Optional[Callable], ...] = ()
+    evt_keys: Tuple[str, ...] = ()  # tape columns the cross filters read
+    # per element: indices of earlier elements its cross filter reads; a
+    # referenced element that was SKIPPED (optional, min 0) must make the
+    # filter false (Siddhi: comparisons with null never hold), not read a
+    # zero-initialized capture
+    cross_refs: Tuple[Tuple[int, ...], ...] = ()
 
     @property
     def n_elements(self) -> int:
         return len(self.elements)
+
+    @property
+    def has_cross(self) -> bool:
+        return any(f is not None for f in self.cross_fns)
 
 
 def _build_spec(
@@ -206,25 +286,58 @@ def _build_spec(
         if el.stream_id not in stream_codes:
             raise SiddhiQLError(f"stream {el.stream_id!r} is not defined")
 
-    # per-element predicate kernels (current-event only; cross-element
-    # capture references in element filters are a later milestone)
-    pred_fns = []
-    for el in inp.elements:
+    cap_resolver = CaptureResolver(inp.elements, schemas)
+
+    # per-element predicate kernels. A filter referencing ONLY the current
+    # event compiles to a whole-batch mask (fast path); one referencing
+    # earlier elements' captures (`s2 = S[price > s1.price]`) compiles to
+    # a cross fn evaluated per partial-match slot inside the scan engine.
+    alias_idx = {el.alias: i for i, el in enumerate(inp.elements)}
+    pred_fns: List[Optional[Callable]] = []
+    cross_fns: List[Optional[Callable]] = []
+    cross_refs: List[Tuple[int, ...]] = []
+    evt_keys: List[str] = []
+    for i, el in enumerate(inp.elements):
         schema = schemas[el.stream_id]
-        scopes = {
-            el.alias: (el.stream_id, schema),
-            el.stream_id: (el.stream_id, schema),
+        if el.filter is None:
+            pred_fns.append(None)
+            cross_fns.append(None)
+            cross_refs.append(())
+            continue
+        foreign = {
+            a.qualifier
+            for a in ast.iter_attrs(el.filter)
+            if a.qualifier is not None
+            and a.qualifier in alias_idx
+            and a.qualifier != el.alias
         }
-        resolver = ExprResolver(scopes, default_scope=el.alias)
-        if el.filter is not None:
+        if not foreign:
+            scopes = {
+                el.alias: (el.stream_id, schema),
+                el.stream_id: (el.stream_id, schema),
+            }
+            resolver = ExprResolver(scopes, default_scope=el.alias)
             ce = compile_expr(el.filter, resolver, extensions)
             if ce.atype != AttributeType.BOOL:
                 raise SiddhiQLError("pattern element filter must be boolean")
             pred_fns.append(ce.fn)
-        else:
-            pred_fns.append(None)
-
-    cap_resolver = CaptureResolver(inp.elements, schemas)
+            cross_fns.append(None)
+            cross_refs.append(())
+            continue
+        if el.negated:
+            raise SiddhiQLError(
+                "cross-element references are not supported in absent "
+                "('not') element filters"
+            )
+        resolver = _ElemFilterResolver(
+            i, el, schema, inp.elements, cap_resolver, evt_keys
+        )
+        ce = compile_expr(el.filter, resolver, extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError("pattern element filter must be boolean")
+        pred_fns.append(None)  # event-only mask = stream gate
+        cross_fns.append(ce.fn)
+        cross_refs.append(tuple(sorted(alias_idx[a] for a in foreign)))
     if q.selector.is_star:
         raise SiddhiQLError(
             "select * is not valid for pattern queries; name the captures"
@@ -279,6 +392,9 @@ def _build_spec(
         out_fields=tuple(out_fields),
         output_stream=q.output_stream,
         proj_srcs=tuple(proj_srcs),
+        cross_fns=tuple(cross_fns),
+        evt_keys=tuple(evt_keys),
+        cross_refs=tuple(cross_refs),
     )
 
 
@@ -922,6 +1038,9 @@ class SlotNFAArtifact:
             "count": jnp.zeros(S, dtype=jnp.int32),
             "start": jnp.zeros(S, dtype=jnp.int32),
             "last": jnp.zeros(S, dtype=jnp.int32),
+            # bitmask of elements the slot has actually matched (vs
+            # skipped optionals) — gates cross-element filter references
+            "matched": jnp.zeros(S, dtype=jnp.int32),
             "done": jnp.asarray(False),
             "started": jnp.asarray(False),
             "overflow": jnp.asarray(0, dtype=jnp.int32),
@@ -971,10 +1090,40 @@ class SlotNFAArtifact:
             step = st["step"]
             count = st["count"]
 
+            # cross-element filters: evaluate this event against each
+            # slot's captured values -> ok[k] is bool[S], gating both
+            # absorb-at-k and advance-to-k (the event-only m[k] for these
+            # elements is just the stream gate)
+            cross_ok: Dict[int, jnp.ndarray] = {}
+            if spec.has_cross:
+                cenv: ColumnEnv = {
+                    key: caps_e[f"evt:{key}"] for key in spec.evt_keys
+                }
+                for elem, col, which in spec.captures:
+                    alias = spec.elements[elem].alias
+                    cenv[_cap_key(alias, which, col)] = st[
+                        _skey(which, elem, col)
+                    ]
+                for k, fn in enumerate(spec.cross_fns):
+                    if fn is not None:
+                        ok = jnp.broadcast_to(jnp.asarray(fn(cenv)), (S,))
+                        # a referenced element that was skipped (optional)
+                        # has no capture: the filter can never hold
+                        ref_mask = 0
+                        for r in spec.cross_refs[k]:
+                            ref_mask |= 1 << r
+                        if ref_mask:
+                            ok = ok & (
+                                (st["matched"] & ref_mask) == ref_mask
+                            )
+                        cross_ok[k] = ok
+
             if spec.within is not None:
                 alive = (ts_e - st["start"]) <= jnp.int32(spec.within)
                 active = active & (alive | ~valid_e)
             m_at = m[jnp.clip(step, 0, K - 1)]  # pred of current element
+            for k, ok in cross_ok.items():
+                m_at = m_at & jnp.where(step == k, ok, True)
             absorb = active & valid_e & m_at & (count < maxs[step])
 
             # advance target: smallest t > step whose predicate matches,
@@ -990,6 +1139,8 @@ class SlotNFAArtifact:
                     & self._skipfree(step, t)
                     & m[t]
                 )
+                if t in cross_ok:
+                    reach = reach & cross_ok[t]
                 adv_t = jnp.where(reach, t, adv_t)
             advance = ~absorb & (adv_t < K)  # greedy: absorb wins
 
@@ -1018,6 +1169,18 @@ class SlotNFAArtifact:
             new_step = jnp.where(advance, adv_t, step)
             new_count = jnp.where(advance, 1, new_count)
             new_last = jnp.where(absorb | advance, ts_e, st["last"])
+            new_matched = st["matched"]
+            new_matched = jnp.where(
+                absorb,
+                new_matched | jnp.left_shift(jnp.int32(1), step),
+                new_matched,
+            )
+            new_matched = jnp.where(
+                advance,
+                new_matched
+                | jnp.left_shift(jnp.int32(1), jnp.clip(adv_t, 0, K - 1)),
+                new_matched,
+            )
 
             new_first = {}
             new_lastc = {}
@@ -1078,6 +1241,7 @@ class SlotNFAArtifact:
             new_count = jnp.where(one_hot, 1, new_count)
             new_start = jnp.where(one_hot, ts_e, st["start"])
             new_last = jnp.where(one_hot, ts_e, new_last)
+            new_matched = jnp.where(one_hot, 1, new_matched)
             for pair in pairs:
                 if pair[0] == 0:
                     new_first[pair] = jnp.where(
@@ -1097,6 +1261,7 @@ class SlotNFAArtifact:
                 count=new_count,
                 start=new_start,
                 last=new_last,
+                matched=new_matched,
                 done=any_done,
                 started=started_now | want_start,
                 overflow=st["overflow"]
@@ -1107,12 +1272,10 @@ class SlotNFAArtifact:
                 new_st[_skey("last", *pair)] = new_lastc[pair]
             return (new_st, new_buf), None
 
-        xs = (
-            tape.ts,
-            tape.valid,
-            pred_mat,
-            {_skey("src", *pair): cap_srcs[pair] for pair in pairs},
-        )
+        xcols = {_skey("src", *pair): cap_srcs[pair] for pair in pairs}
+        for key in spec.evt_keys:
+            xcols[f"evt:{key}"] = tape.cols[key]
+        xs = (tape.ts, tape.valid, pred_mat, xcols)
         # Relevance compaction (pattern kind only): '->' ignores events
         # matching no element, so the sequential scan — the expensive part,
         # ~E dependent steps — only needs the events whose predicate row is
@@ -1137,10 +1300,7 @@ class SlotNFAArtifact:
                 tape.ts[idx],
                 cvalid,
                 pred_mat[idx] & cvalid[:, None],
-                {
-                    _skey("src", *pair): cap_srcs[pair][idx]
-                    for pair in pairs
-                },
+                {k: v[idx] for k, v in xcols.items()},
             )
             (new_state, buf), _ = jax.lax.cond(
                 cnt <= R,
@@ -1180,13 +1340,15 @@ def compile_pattern_query(
 ):
     spec = _build_spec(q, schemas, stream_codes, extensions)
     out_schema = OutputSchema(spec.output_stream, spec.out_fields)
-    if _is_chain(spec):
+    if _is_chain(spec) and not spec.has_cross:
         return ChainPatternArtifact(
             name=name, spec=spec, output_schema=out_schema
         )
     if any(el.negated for el in spec.elements):
         raise SiddhiQLError(
             "absence ('not') elements require a plain chain pattern "
-            "(no quantifiers)"
+            "(no quantifiers or cross-element references)"
         )
+    # cross-element filters route to the slot engine even for plain
+    # chains: per-slot predicate evaluation needs each partial's captures
     return SlotNFAArtifact(name=name, spec=spec, output_schema=out_schema)

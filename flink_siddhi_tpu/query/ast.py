@@ -97,6 +97,20 @@ def contains_aggregate(e: Expr) -> bool:
     return False
 
 
+def iter_attrs(e: Expr):
+    """Yield every Attr node in an expression tree."""
+    if isinstance(e, Attr):
+        yield e
+    elif isinstance(e, Unary):
+        yield from iter_attrs(e.operand)
+    elif isinstance(e, Binary):
+        yield from iter_attrs(e.left)
+        yield from iter_attrs(e.right)
+    elif isinstance(e, Call):
+        for a in e.args:
+            yield from iter_attrs(a)
+
+
 # --------------------------------------------------------------------------
 # Selection
 # --------------------------------------------------------------------------

@@ -63,9 +63,10 @@ class Job:
         time_mode: str = "event",  # 'event' | 'processing'
         control_sources: Sequence = (),
         plan_compiler: Optional[Callable] = None,  # (cql, plan_id) -> plan
-        retain_results: bool = True,  # keep rows in collected[] even when
-        # sinks consume them; False = sink-only streams don't grow host
-        # memory over an unbounded run (long-running pipeline mode)
+        retain_results: bool = True,  # keep emitted rows in collected[]
+        # (the results() path); False = no host retention at all — rows
+        # reach sinks only, so an unbounded run cannot grow host memory
+        # (long-running pipeline / pure-benchmark mode)
     ) -> None:
         if time_mode not in ("event", "processing"):
             raise ValueError(time_mode)
@@ -301,15 +302,16 @@ class Job:
         epoch = self._epoch_ms or 0
         sinks = self._sinks.get(sid)
         self.emitted_counts[sid] = self.emitted_counts.get(sid, 0) + len(rows)
-        if not sinks:  # bulk path: drains can carry millions of rows
-            self.collected.setdefault(sid, []).extend(
-                (epoch + rel_ts, row) for rel_ts, row in rows
-            )
+        if not sinks:
+            # retention off means off everywhere: an unbounded run must
+            # not grow collected[] whether or not a sink consumes the
+            # stream (the reference's StreamOutputHandler never retains —
+            # it collects downstream, StreamOutputHandler.java:62-92)
+            if self.retain_results:  # bulk path: drains carry millions
+                self.collected.setdefault(sid, []).extend(
+                    (epoch + rel_ts, row) for rel_ts, row in rows
+                )
             return
-        # sink-consumed streams only retain rows when asked: an unbounded
-        # stream would otherwise grow collected[] without bound (the
-        # reference's StreamOutputHandler never retains — it collects
-        # downstream, StreamOutputHandler.java:62-92)
         bucket = (
             self.collected.setdefault(sid, [])
             if self.retain_results

@@ -344,3 +344,163 @@ def test_sequence_plus_vs_oracle(seed, batch):
     job.run()
     got = sorted(job.results("o"))
     assert got == expected
+
+
+# --------------------------------------------------------------------------
+# Cross-element filter references (s2 = S[price > s1.price])
+# --------------------------------------------------------------------------
+
+PRICE_SCHEMA = StreamSchema(
+    [
+        ("id", AttributeType.INT),
+        ("price", AttributeType.DOUBLE),
+        ("timestamp", AttributeType.LONG),
+    ]
+)
+
+
+def oracle_cross(ids, prices, ts, kind="pattern", within=None):
+    """Per-event interpreter for
+    ``every s1 = S[id==1] (->|,) s2 = S[id==2 and price > s1.price]``.
+    Pattern: non-matching events are skipped; sequence: the immediately
+    next event must match or the partial dies (emitting nothing)."""
+    partials = []  # list of s1 price/ts
+    matches = []
+    for eid, p, t in zip(ids, prices, ts):
+        nxt = []
+        for (p1, t1) in partials:
+            if within is not None and t - t1 > within:
+                continue
+            if eid == 2 and p > p1:
+                matches.append((p1, p))
+            elif kind == "pattern":
+                nxt.append((p1, t1))
+            # sequence: any non-advancing event kills the partial
+        partials = nxt
+        if eid == 1:
+            partials.append((p, t))
+    return sorted(matches)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("batch", [5, 64])
+@pytest.mark.parametrize("kind", ["pattern", "sequence"])
+def test_cross_element_filter_vs_oracle(seed, batch, kind):
+    rng = np.random.default_rng(seed)
+    n = 300
+    ids = rng.integers(0, 4, n).tolist()
+    prices = np.round(rng.random(n) * 10, 1).tolist()
+    ts = (1000 + np.cumsum(rng.integers(1, 5, n))).tolist()
+    sep = " -> " if kind == "pattern" else ", "
+    cql = (
+        f"from every s1 = S[id == 1]{sep}"
+        "s2 = S[id == 2 and price > s1.price] "
+        "select s1.price as p1, s2.price as p2 insert into o"
+    )
+    plan = compile_plan(cql, {"S": PRICE_SCHEMA})
+    batches = make_batches(
+        PRICE_SCHEMA,
+        {
+            "id": (ids, np.int32),
+            "price": (prices, np.float64),
+            "timestamp": (ts, np.int64),
+        },
+        ts, batch,
+    )
+    job = Job(
+        [plan], [BatchSource("S", PRICE_SCHEMA, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    got = sorted(
+        (round(p1, 1), round(p2, 1)) for p1, p2 in job.results("o")
+    )
+    assert got == oracle_cross(ids, prices, ts, kind)
+
+
+def test_cross_element_quantified_last_ref():
+    # s2 must exceed the LAST event absorbed by the quantified s1+
+    ids = [1, 1, 1, 2, 2]
+    prices = [3.0, 6.0, 4.0, 5.0, 7.0]
+    ts = [1000 + i for i in range(5)]
+    cql = (
+        "from every s1 = S[id == 1]+, s2 = S[price > s1[last].price] "
+        "select s1[0].price as first1, s1[last].price as last1, "
+        "s2.price as p2 insert into o"
+    )
+    plan = compile_plan(cql, {"S": PRICE_SCHEMA})
+    batches = make_batches(
+        PRICE_SCHEMA,
+        {
+            "id": (ids, np.int32),
+            "price": (prices, np.float64),
+            "timestamp": (ts, np.int64),
+        },
+        ts, 8,
+    )
+    job = Job(
+        [plan], [BatchSource("S", PRICE_SCHEMA, iter(batches))],
+        batch_size=8, time_mode="processing",
+    )
+    job.run()
+    # greedy s1+ absorbs 3,6,4 (others die on non-absorbing events);
+    # s2 needs price > 4 -> the id==2@5.0 event completes it
+    assert (3.0, 4.0, 5.0) in job.results("o")
+
+
+def test_cross_element_forward_reference_rejected():
+    from flink_siddhi_tpu.query.lexer import SiddhiQLError
+
+    cql = (
+        "from every s1 = S[price > s2.price] -> s2 = S[id == 2] "
+        "select s1.price as p insert into o"
+    )
+    with pytest.raises(SiddhiQLError, match="EARLIER"):
+        compile_plan(cql, {"S": PRICE_SCHEMA})
+
+
+def test_cross_ref_to_skipped_optional_never_matches():
+    # s2 is optional and absent from the input; s3's filter references
+    # s2 -> the comparison is against nothing (Siddhi: null), so no match
+    ids = [1, 3]
+    prices = [9.0, 5.0]
+    ts = [1000, 1001]
+    cql = (
+        "from every s1 = S[id == 1], s2 = S[id == 2]?, "
+        "s3 = S[id == 3 and price > s2.price] "
+        "select s1.price as p1, s3.price as p3 insert into o"
+    )
+    plan = compile_plan(cql, {"S": PRICE_SCHEMA})
+    batches = make_batches(
+        PRICE_SCHEMA,
+        {
+            "id": (ids, np.int32),
+            "price": (prices, np.float64),
+            "timestamp": (ts, np.int64),
+        },
+        ts, 8,
+    )
+    job = Job(
+        [plan], [BatchSource("S", PRICE_SCHEMA, iter(batches))],
+        batch_size=8, time_mode="processing",
+    )
+    job.run()
+    assert job.results("o") == []
+    # and WITH the optional present, the filter applies to its capture
+    ids2, prices2, ts2 = [1, 2, 3], [9.0, 4.0, 5.0], [1000, 1001, 1002]
+    plan2 = compile_plan(cql, {"S": PRICE_SCHEMA})
+    job2 = Job(
+        [plan2],
+        [BatchSource("S", PRICE_SCHEMA, iter(make_batches(
+            PRICE_SCHEMA,
+            {
+                "id": (ids2, np.int32),
+                "price": (prices2, np.float64),
+                "timestamp": (ts2, np.int64),
+            },
+            ts2, 8,
+        )))],
+        batch_size=8, time_mode="processing",
+    )
+    job2.run()
+    assert job2.results("o") == [(9.0, 5.0)]
