@@ -94,6 +94,20 @@ class CaptureResolver:
         if key not in self.referenced:
             self.referenced.append(key)
 
+    def element_of(self, attr: ast.Attr) -> Optional[int]:
+        """The element index an attribute reference resolves to, or None
+        (unknown / ambiguous). Mirrors resolve()'s rules without raising
+        or recording."""
+        if attr.qualifier is not None:
+            info = self._by_alias.get(attr.qualifier)
+            return info[0] if info is not None else None
+        hits = [
+            info[0]
+            for alias, info in self._by_alias.items()
+            if attr.name in info[2] and alias not in self._negated
+        ]
+        return hits[0] if len(hits) == 1 else None
+
     def resolve(self, attr: ast.Attr) -> ResolvedAttr:
         if attr.qualifier is None:
             hits = [
@@ -154,6 +168,7 @@ class _ElemFilterResolver:
         elements,
         cap_resolver: "CaptureResolver",
         evt_keys: List[str],
+        g_of: Optional[Dict[int, int]] = None,
     ) -> None:
         self._own_idx = own_idx
         self._own = own_el
@@ -162,6 +177,7 @@ class _ElemFilterResolver:
         self._cap = cap_resolver
         self._evt_keys = evt_keys
         self._aliases = {el.alias for el in elements}
+        self._g_of = g_of or {}
 
     def resolve(self, attr: ast.Attr) -> ResolvedAttr:
         q = attr.qualifier
@@ -195,6 +211,14 @@ class _ElemFilterResolver:
             raise SiddhiQLError(
                 f"element filter of {self._own.alias!r} can only "
                 f"reference EARLIER elements; {q!r} has not matched yet"
+            )
+        if self._g_of and self._g_of.get(ref_idx) == self._g_of.get(
+            self._own_idx
+        ):
+            raise SiddhiQLError(
+                f"element filter of {self._own.alias!r} cannot reference "
+                f"{q!r}: members of one 'and'/'or' group match in any "
+                "order"
             )
         if self._elements[ref_idx].negated:
             raise SiddhiQLError(
@@ -239,6 +263,14 @@ class _PatternSpec:
     # filter false (Siddhi: comparisons with null never hold), not read a
     # zero-initialized capture
     cross_refs: Tuple[Tuple[int, ...], ...] = ()
+    # logical steps: each group is a tuple of element indices advancing
+    # as ONE step ('and': all must arrive, any order; 'or': any one)
+    groups: Tuple[Tuple[int, ...], ...] = ()
+    group_ops: Tuple[Optional[str], ...] = ()  # None for singletons
+    # per projection: 'or'-group member elements it references — exactly
+    # one member of an or-group fires, so projections over the OTHER
+    # member must decode as None (Siddhi: null), not a zeroed capture
+    proj_or_deps: Tuple[Tuple[int, ...], ...] = ()
 
     @property
     def n_elements(self) -> int:
@@ -260,11 +292,41 @@ def _build_spec(
     aliases = [el.alias for el in inp.elements]
     if len(set(aliases)) != len(aliases):
         raise SiddhiQLError("pattern aliases must be unique")
+
+    # logical steps: group_link chains consecutive elements into one step
+    groups: List[Tuple[int, ...]] = []
+    group_ops: List[Optional[str]] = []
+    for i, el in enumerate(inp.elements):
+        if el.group_link is None:
+            groups.append((i,))
+            group_ops.append(None)
+        else:
+            groups[-1] = groups[-1] + (i,)
+            group_ops[-1] = el.group_link
+    g_of = {e: g for g, mem in enumerate(groups) for e in mem}
+    for g, mem in enumerate(groups):
+        if len(mem) == 1:
+            continue
+        if inp.kind == "sequence":
+            raise SiddhiQLError(
+                "'and'/'or' groups are not supported in sequences yet"
+            )
+        for e in mem:
+            el = inp.elements[e]
+            if el.negated:
+                raise SiddhiQLError(
+                    "absent ('not') elements inside 'and'/'or' groups "
+                    "are not supported yet"
+                )
+            if (el.min_count, el.max_count) != (1, 1):
+                raise SiddhiQLError(
+                    "elements of an 'and'/'or' group cannot be quantified"
+                )
     for i, el in enumerate(inp.elements):
         if el.negated:
-            # mid-chain absence only: `A -> not B -> C` (C must arrive
-            # with no B in between). Timer-based terminal absence
-            # (`... -> not B for 5 sec`) is a later milestone.
+            # mid-chain absence: `A -> not B -> C` (C must arrive with no
+            # B in between); terminal TIMED absence: `A -> not B for 5
+            # sec` (emit when the window elapses with no B)
             if inp.kind == "sequence":
                 raise SiddhiQLError(
                     "absence ('not') is not supported in sequences"
@@ -273,16 +335,25 @@ def _build_spec(
                 raise SiddhiQLError(
                     "a pattern cannot start with an absent ('not') element"
                 )
-            if i == len(inp.elements) - 1:
+            last = i == len(inp.elements) - 1
+            if last and el.absent_for is None:
                 raise SiddhiQLError(
-                    "terminal absence ('-> not B') needs a duration and "
-                    "is not supported yet; only mid-chain absence "
-                    "('A -> not B -> C') is"
+                    "terminal absence needs a duration: "
+                    "'-> not B for 5 sec'"
+                )
+            if not last and el.absent_for is not None:
+                raise SiddhiQLError(
+                    "timed absence ('not B for t') must be the last "
+                    "pattern element"
                 )
             if (el.min_count, el.max_count) != (1, 1):
                 raise SiddhiQLError(
                     "absent ('not') elements cannot be quantified"
                 )
+        elif el.absent_for is not None:
+            raise SiddhiQLError(
+                "'for <duration>' is only valid on absent ('not') elements"
+            )
         if el.stream_id not in stream_codes:
             raise SiddhiQLError(f"stream {el.stream_id!r} is not defined")
 
@@ -330,7 +401,7 @@ def _build_spec(
                 "('not') element filters"
             )
         resolver = _ElemFilterResolver(
-            i, el, schema, inp.elements, cap_resolver, evt_keys
+            i, el, schema, inp.elements, cap_resolver, evt_keys, g_of
         )
         ce = compile_expr(el.filter, resolver, extensions)
         if ce.atype != AttributeType.BOOL:
@@ -342,12 +413,29 @@ def _build_spec(
         raise SiddhiQLError(
             "select * is not valid for pattern queries; name the captures"
         )
+    or_members = {
+        e
+        for g, mem in enumerate(groups)
+        if len(mem) > 1 and group_ops[g] == "or"
+        for e in mem
+    }
+
+    def _or_deps(expr) -> Tuple[int, ...]:
+        deps = set()
+        for a in ast.iter_attrs(expr):
+            elem = cap_resolver.element_of(a)
+            if elem is not None and elem in or_members:
+                deps.add(elem)
+        return tuple(sorted(deps))
+
     proj_fns, out_fields, proj_srcs = [], [], []
+    proj_or_deps: List[Tuple[int, ...]] = []
     for item in q.selector.items:
         if ast.contains_aggregate(item.expr):
             raise SiddhiQLError(
                 "aggregations over pattern matches are not supported"
             )
+        proj_or_deps.append(_or_deps(item.expr))
         ce = compile_expr(item.expr, cap_resolver, extensions)
         proj_fns.append(ce.fn)
         out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
@@ -395,6 +483,9 @@ def _build_spec(
         cross_fns=tuple(cross_fns),
         evt_keys=tuple(evt_keys),
         cross_refs=tuple(cross_refs),
+        groups=tuple(groups),
+        group_ops=tuple(group_ops),
+        proj_or_deps=tuple(proj_or_deps),
     )
 
 
@@ -467,6 +558,10 @@ class _ChainCfg:
     cap_dtypes: Tuple[str, ...]  # numpy dtype names, per pair
     positive: Tuple[int, ...] = ()
     guards: Tuple[Tuple[int, ...], ...] = ()  # per positive step
+    # terminal timed absence (`... -> not B for t`): the guard element's
+    # index; partials that finish all positive steps WAIT, and emit at
+    # (last positive ts + t) unless a guard match lands inside the window
+    t_guard: Optional[int] = None
 
     @staticmethod
     def of(spec: "_PatternSpec") -> "_ChainCfg":
@@ -484,6 +579,12 @@ class _ChainCfg:
                     if spec.elements[g].negated
                 )
             )
+        last = spec.elements[-1]
+        t_guard = (
+            len(spec.elements) - 1
+            if last.negated and last.absent_for is not None
+            else None
+        )
         return _ChainCfg(
             K=len(positive),
             every=spec.every,
@@ -494,6 +595,7 @@ class _ChainCfg:
             ),
             positive=positive,
             guards=tuple(guards),
+            t_guard=t_guard,
         )
 
 
@@ -508,6 +610,7 @@ def _chain_core(
     ts,  # int32[E]
     valid,  # bool[E]
     use_pallas: bool = False,  # single-query callers only (not vmappable)
+    tfor_val=None,  # int32 scalar (required when cfg.t_guard is set)
 ):
     """One micro-batch of the chain matcher for ONE query: advance carried
     partials + fresh starts through all elements, find completions, and
@@ -536,6 +639,8 @@ def _chain_core(
     scan_rows = list(positive[1:]) + [
         g for gs in guards for g in gs
     ]
+    if cfg.t_guard is not None:
+        scan_rows.append(cfg.t_guard)
     idxs = [
         jnp.where(preds[e], arange, E) for e in scan_rows
     ]
@@ -571,8 +676,14 @@ def _chain_core(
     v_start = jnp.concatenate([state["start"], ts])
     # fresh starts already completed element 0 at their own position, so a
     # single-element pattern (K == 1) emits at the start event's ts; K > 1
-    # overwrites this on the final advance
-    v_emit_ts = jnp.concatenate([jnp.zeros(P, dtype=jnp.int32), ts])
+    # overwrites this on the final advance. With a terminal timed absence
+    # the pool carries emit_ts (the waiting deadline's base) across batches.
+    carried_emit = (
+        state["emit_ts"]
+        if cfg.t_guard is not None
+        else jnp.zeros(P, dtype=jnp.int32)
+    )
+    v_emit_ts = jnp.concatenate([carried_emit, ts])
     caps = {}
     for pair in pairs:
         elem, _col = pair
@@ -610,7 +721,34 @@ def _chain_core(
         if k == K - 1:
             v_emit_ts = jnp.where(found, ts_j, v_emit_ts)
 
-    complete = v_active & (v_step == K)
+    batch_max = jnp.max(jnp.where(valid, ts, -_BIG))
+    still_waiting = None
+    if cfg.t_guard is not None:
+        # partials that finished every positive step WAIT for the absence
+        # window: a guard match inside (last_ts, last_ts + t] kills them
+        # (strictly after the last positive event — same-timestamp guards
+        # do not, matching the oracle's t1 < t2); once batch time proves
+        # the window elapsed guard-free, they mature and emit at the
+        # deadline
+        waiting = v_active & (v_step == K)
+        deadline = v_emit_ts + tfor_val
+        # first guard with ts STRICTLY inside (last_ts, last_ts + t]: a
+        # same-timestamp guard must neither kill (oracle: t1 < t2) nor
+        # mask later in-window guards, so the search starts at the first
+        # position whose ts exceeds last_ts (the tape is ts-sorted)
+        past_emit = jnp.searchsorted(
+            ts, v_emit_ts, side="right"
+        ).astype(jnp.int32)
+        jg = nxt[cfg.t_guard][
+            jnp.clip(jnp.maximum(v_pos, past_emit), 0, E)
+        ]
+        guard_hit = waiting & (jg < E) & (ts_pad[jg] <= deadline)
+        matured = waiting & ~guard_hit & (deadline <= batch_max)
+        complete = matured
+        v_emit_ts = jnp.where(matured, deadline, v_emit_ts)
+        still_waiting = waiting & ~guard_hit & ~matured
+    else:
+        complete = v_active & (v_step == K)
     if not cfg.every:
         # exactly one match: earliest start, then earliest completion
         # (two-stage int32 argmin; device has no int64)
@@ -623,6 +761,9 @@ def _chain_core(
         one = jnp.zeros(V, dtype=bool).at[winner].set(True)
         complete = complete & one & ~state["done"]
         new_done = state["done"] | complete.any()
+        if still_waiting is not None:
+            # the single match is taken: waiting partials are void
+            still_waiting = still_waiting & ~new_done
     else:
         new_done = state["done"]
 
@@ -632,19 +773,25 @@ def _chain_core(
     # time-ordered batches, so on overflow the newest partials drop.
     survive = v_active & (v_step < K)
     if cfg.has_within:
-        batch_max = jnp.max(jnp.where(valid, ts, -_BIG))
         survive = survive & ((batch_max - v_start) <= within_val)
+    if still_waiting is not None:
+        survive = survive | still_waiting
     keep_pos = jnp.cumsum(survive.astype(jnp.int32)) - 1
     pool_dest = jnp.where(survive & (keep_pos < P), keep_pos, P)
     n_survive = survive.sum().astype(jnp.int32)
 
+    fixed_rows = [_as_i32(survive), v_step, v_start]
+    fixed_fill = [0, 1, 0]
+    if cfg.t_guard is not None:
+        fixed_rows.append(v_emit_ts)
+        fixed_fill.append(0)
+    n_fixed = len(fixed_rows)
     pool_rows = jnp.stack(
-        [_as_i32(survive), v_step, v_start]
-        + [_as_i32(caps[pair]) for pair in pairs]
+        fixed_rows + [_as_i32(caps[pair]) for pair in pairs]
     )
     pool_fill = jnp.concatenate(
         [
-            jnp.asarray([0, 1, 0], dtype=jnp.int32),
+            jnp.asarray(fixed_fill, dtype=jnp.int32),
             jnp.zeros(len(pairs), dtype=jnp.int32),
         ]
     )
@@ -662,16 +809,23 @@ def _chain_core(
         "overflow": state["overflow"]
         + jnp.maximum(n_survive - P, 0).astype(jnp.int32),
     }
+    if cfg.t_guard is not None:
+        new_state["emit_ts"] = pool_packed[3]
     for j, pair in enumerate(pairs):
         new_state[_skey("cap", *pair)] = _from_i32(
-            pool_packed[3 + j], cap_dtypes[pair]
+            pool_packed[n_fixed + j], cap_dtypes[pair]
         )
     return new_state, complete, v_emit_ts, caps
 
 
 def _is_chain(spec: _PatternSpec) -> bool:
-    return spec.kind == "pattern" and all(
-        el.min_count == 1 and el.max_count == 1 for el in spec.elements
+    return (
+        spec.kind == "pattern"
+        and all(
+            el.min_count == 1 and el.max_count == 1
+            for el in spec.elements
+        )
+        and all(len(g) == 1 for g in spec.groups)
     )
 
 
@@ -696,6 +850,10 @@ class ChainPatternArtifact:
         """Widest per-cycle emission block (drain-cadence contract)."""
         return tape_capacity + self.pool
 
+    def _tfor_ms(self) -> Optional[int]:
+        last = self.spec.elements[-1]
+        return last.absent_for if last.negated else None
+
     def init_state(self) -> Dict:
         P = self.pool
         K = self.spec.n_elements
@@ -707,6 +865,9 @@ class ChainPatternArtifact:
             "done": jnp.asarray(False),  # non-every: already matched
             "overflow": jnp.asarray(0, dtype=jnp.int32),
         }
+        if self._tfor_ms() is not None:
+            # timed-absence waiting partials carry their deadline base
+            state["emit_ts"] = jnp.zeros(P, dtype=jnp.int32)
         for pair in _cap_pairs(self.spec):
             state[_skey("cap", *pair)] = jnp.zeros(
                 P, dtype=self.spec.cap_dtype[pair]
@@ -730,6 +891,7 @@ class ChainPatternArtifact:
         state, complete, v_emit_ts, caps = _chain_core(
             _ChainCfg.of(spec), P, state, preds, cap_srcs, within_val,
             tape.ts, tape.valid, use_pallas=True,
+            tfor_val=jnp.int32(self._tfor_ms() or 0),
         )
         # emit matches: O(V) cumsum-scatter compaction into the first
         # n_matches rows; all output rows (ts + projections) compact
@@ -758,6 +920,57 @@ class ChainPatternArtifact:
             .set(emit_rows, mode="drop")
         )
         return state, (n_matches, packed)
+
+    def flush(self, state: Dict) -> Tuple[Dict, Tuple]:
+        """End-of-stream: with a terminal timed absence, stream end means
+        time advances past every pending deadline guard-free (the +inf
+        watermark), so all waiting partials mature and emit."""
+        spec = self.spec
+        P = self.pool
+        C = len(spec.proj_fns)
+        tfor = self._tfor_ms()
+        if tfor is None:
+            return state, (
+                jnp.asarray(0, jnp.int32),
+                jnp.zeros((1 + C, 1), jnp.int32),
+            )
+        K = _ChainCfg.of(spec).K
+        waiting = state["active"] & (state["step"] == K)
+        deadline = state["emit_ts"] + jnp.int32(tfor)
+        if not spec.every:
+            # exactly-one-match rule holds at end of stream too: nothing
+            # if already matched, else the earliest-start (then earliest
+            # deadline) waiting partial
+            waiting = waiting & ~state["done"]
+            start_key = jnp.where(waiting, state["start"], _BIG)
+            min_start = jnp.min(start_key)
+            dl_key = jnp.where(
+                waiting & (state["start"] == min_start), deadline, _BIG
+            )
+            winner = jnp.argmin(dl_key)
+            one = jnp.zeros(P, dtype=bool).at[winner].set(True)
+            waiting = waiting & one
+        n = waiting.sum().astype(jnp.int32)
+        pos = jnp.cumsum(waiting.astype(jnp.int32)) - 1
+        dest = jnp.where(waiting, pos, P)
+        emit_env = _emit_env(
+            spec,
+            {
+                (e, c, w): state[_skey("cap", e, c)]
+                for e, c, w in spec.captures
+            },
+        )
+        rows = jnp.stack(
+            [_as_i32(deadline)]
+            + [
+                _as_i32(jnp.broadcast_to(jnp.asarray(p(emit_env)), (P,)))
+                for p in spec.proj_fns
+            ]
+        )
+        packed = jnp.zeros_like(rows).at[:, dest].set(rows, mode="drop")
+        new_state = dict(state)
+        new_state["active"] = state["active"] & ~waiting
+        return new_state, (n, packed)
 
 
 # --------------------------------------------------------------------------
@@ -820,6 +1033,8 @@ class StackedChainArtifact:
             "done": jnp.zeros(Q, dtype=bool),
             "overflow": jnp.zeros(Q, dtype=jnp.int32),
         }
+        if self._cfg.t_guard is not None:
+            state["emit_ts"] = jnp.zeros((Q, P), dtype=jnp.int32)
         spec0 = self.members[0].spec
         for pair in _cap_pairs(spec0):
             state[_skey("cap", *pair)] = jnp.zeros(
@@ -854,12 +1069,16 @@ class StackedChainArtifact:
         within_vec = jnp.asarray(
             [m.spec.within or 0 for m in self.members], dtype=jnp.int32
         )
+        tfor_vec = jnp.asarray(
+            [m._tfor_ms() or 0 for m in self.members], dtype=jnp.int32
+        )
 
         new_state, complete, emit_ts, caps = jax.vmap(
-            lambda st, pr, cs, wv: _chain_core(
-                cfg, P, st, pr, cs, wv, tape.ts, tape.valid
+            lambda st, pr, cs, wv, tv: _chain_core(
+                cfg, P, st, pr, cs, wv, tape.ts, tape.valid,
+                tfor_val=tv,
             )
-        )(state, preds, cap_srcs, within_vec)
+        )(state, preds, cap_srcs, within_vec, tfor_vec)
 
         # projections: when every member's column c is the same plain
         # capture reference (the overwhelmingly common select shape), the
@@ -949,6 +1168,52 @@ class StackedChainArtifact:
             out.append((schema, rows))
         return out
 
+    def flush(self, state: Dict) -> Tuple[Dict, Tuple]:
+        """Timed-absence maturation at end of stream (per member query)."""
+        Q = len(self.members)
+        P = self.pool
+        C = len(self.members[0].spec.proj_fns)
+        if self._cfg.t_guard is None:
+            return state, (
+                jnp.asarray(0, jnp.int32),
+                jnp.zeros((2 + C, 1), jnp.int32),
+                jnp.asarray(0, jnp.int32),
+            )
+        per_q = []
+        new_state = dict(state)
+        new_active = []
+        for qi, m in enumerate(self.members):
+            sub = {
+                k: v[qi]
+                for k, v in state.items()
+            }
+            st2, (n_q, packed_q) = m.flush(sub)
+            new_active.append(st2["active"])
+            qid = jnp.full(P, qi, dtype=jnp.int32)
+            per_q.append(
+                (n_q, jnp.concatenate(
+                    [packed_q[:1], qid[None, :], packed_q[1:]], axis=0
+                ))
+            )
+        new_state["active"] = jnp.stack(new_active)
+        # concatenate member emissions front-compacted per member; the
+        # packed blocks are already zero-padded past each n_q, so stack
+        # them side by side and compact once
+        blocks = jnp.concatenate([b for _, b in per_q], axis=1)  # (2+C, Q*P)
+        keep = jnp.concatenate(
+            [
+                jnp.arange(P, dtype=jnp.int32) < n_q
+                for n_q, _ in per_q
+            ]
+        )
+        n_total = keep.sum().astype(jnp.int32)
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        dest = jnp.where(keep, pos, Q * P)
+        packed = (
+            jnp.zeros_like(blocks).at[:, dest].set(blocks, mode="drop")
+        )
+        return new_state, (n_total, packed, jnp.asarray(0, jnp.int32))
+
 
 def group_chain_artifacts(artifacts: List) -> List:
     """Replace runs of structurally-identical ChainPatternArtifacts with
@@ -1006,25 +1271,79 @@ class SlotNFAArtifact:
         """Widest per-cycle emission block (drain-cadence contract)."""
         return tape_capacity + self.slots
 
+    @property
+    def _needs_mbits(self) -> bool:
+        """Projections over 'or'-group members need the emitting slot's
+        matched bitmask on the wire so the unfired member decodes None."""
+        return any(self.spec.proj_or_deps)
+
+    @property
+    def acc_rows(self) -> int:
+        return (
+            1
+            + len(self.output_schema.fields)
+            + (1 if self._needs_mbits else 0)
+        )
+
+    def decode_packed(self, n: int, block: "np.ndarray"):
+        """Accumulator block -> rows; with or-groups, the trailing mbits
+        row nullifies projections whose fired-member bit is absent."""
+        schema = self.output_schema
+        C = len(schema.fields)
+        if not self._needs_mbits:
+            return [(schema, schema.decode_packed_block(n, block))]
+        mbits = np.asarray(block[1 + C, :n])
+        rows = schema.decode_packed_block(n, block[: 1 + C])
+        deps = self.spec.proj_or_deps
+        out = []
+        for i, (ts_v, row) in enumerate(rows):
+            mb = int(mbits[i])
+            row = tuple(
+                None
+                if d and any(not (mb >> e) & 1 for e in d)
+                else v
+                for v, d in zip(row, deps)
+            )
+            out.append((ts_v, row))
+        return [(schema, out)]
+
     def __post_init__(self):
         spec = self.spec
-        K = spec.n_elements
         last = spec.elements[-1]
         if spec.kind == "pattern" and last.max_count < 0:
             raise SiddhiQLError(
                 "a '->' pattern cannot end with an unbounded quantifier "
                 "(the match would never complete); bound it with <m:n>"
             )
-        self._mins = np.array(
-            [el.min_count for el in spec.elements], dtype=np.int32
+        # step machinery is indexed by logical GROUP: singletons keep
+        # their element's quantifier; 'and' groups need all n members
+        # (any order, distinct members enforced per absorb); 'or' groups
+        # need any one
+        self._groups = spec.groups or tuple(
+            (i,) for i in range(spec.n_elements)
         )
-        maxs = [
-            el.max_count if el.max_count >= 0 else 2**30
-            for el in spec.elements
-        ]
+        self._gops = spec.group_ops or (None,) * len(self._groups)
+        self._g_of = {
+            e: g for g, mem in enumerate(self._groups) for e in mem
+        }
+        mins, maxs = [], []
+        for mem, op in zip(self._groups, self._gops):
+            if len(mem) == 1:
+                el = spec.elements[mem[0]]
+                mins.append(el.min_count)
+                maxs.append(
+                    el.max_count if el.max_count >= 0 else 2**30
+                )
+            elif op == "and":
+                mins.append(len(mem))
+                maxs.append(len(mem))
+            else:  # 'or'
+                mins.append(1)
+                maxs.append(1)
+        self._mins = np.array(mins, dtype=np.int32)
         self._maxs = np.array(maxs, dtype=np.int32)
-        # prefix[i] = sum of min counts of elements [0, i); lets
-        # "all elements in (a, b] optional" be a subtraction
+        # prefix[i] = sum of min counts of groups [0, i); lets
+        # "all groups in (a, b] optional" be a subtraction
         self._min_prefix = np.concatenate(
             [[0], np.cumsum(self._mins)]
         ).astype(np.int32)
@@ -1060,6 +1379,9 @@ class SlotNFAArtifact:
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         spec = self.spec
         K = spec.n_elements
+        GM = self._groups
+        gops = self._gops
+        G = len(GM)
         S = self.slots
         E = tape.capacity
         M = E + S  # match buffer capacity
@@ -1073,13 +1395,20 @@ class SlotNFAArtifact:
             pair: tape.cols[spec.cap_src_key[pair]] for pair in pairs
         }
 
+        # scan-carry zeros derive from a (possibly shard-varying) input so
+        # the carry's varying type matches under shard_map (a fresh
+        # replicated constant would trip the scan-vma check)
+        zero_i = tape.ts[0].astype(jnp.int32) * 0
         buf_init = {
-            "ts": jnp.zeros(M, dtype=jnp.int32),
-            "n": jnp.asarray(0, jnp.int32),
+            "ts": jnp.zeros(M, dtype=jnp.int32) + zero_i,
+            "n": zero_i,
         }
+        if self._needs_mbits:
+            buf_init["mbits"] = jnp.zeros(M, dtype=jnp.int32) + zero_i
         for elem, col, which in spec.captures:
-            buf_init[_skey(which, elem, col)] = jnp.zeros(
-                M, dtype=spec.cap_dtype[(elem, col)]
+            dt = spec.cap_dtype[(elem, col)]
+            buf_init[_skey(which, elem, col)] = (
+                jnp.zeros(M, dtype=dt) + zero_i.astype(dt)
             )
 
         def body(carry, x):
@@ -1091,9 +1420,7 @@ class SlotNFAArtifact:
             count = st["count"]
 
             # cross-element filters: evaluate this event against each
-            # slot's captured values -> ok[k] is bool[S], gating both
-            # absorb-at-k and advance-to-k (the event-only m[k] for these
-            # elements is just the stream gate)
+            # slot's captured values -> ok[k] is bool[S]
             cross_ok: Dict[int, jnp.ndarray] = {}
             if spec.has_cross:
                 cenv: ColumnEnv = {
@@ -1118,41 +1445,67 @@ class SlotNFAArtifact:
                             )
                         cross_ok[k] = ok
 
+            # per-slot effective member predicates, then per-GROUP masks:
+            # entry (advance into the group: any member) and need (absorb
+            # at the group: 'and' groups require a still-unmatched member)
+            def has_bit(e):
+                return (st["matched"] & jnp.int32(1 << e)) != 0
+
+            eff = []
+            for e in range(K):
+                v = jnp.broadcast_to(m[e], (S,))
+                if e in cross_ok:
+                    v = v & cross_ok[e]
+                eff.append(v)
+            entry_g, need_g = [], []
+            for g, (mem, op) in enumerate(zip(GM, gops)):
+                ent = eff[mem[0]]
+                for e in mem[1:]:
+                    ent = ent | eff[e]
+                if len(mem) > 1 and op == "and":
+                    nee = eff[mem[0]] & ~has_bit(mem[0])
+                    for e in mem[1:]:
+                        nee = nee | (eff[e] & ~has_bit(e))
+                else:
+                    nee = ent
+                entry_g.append(ent)
+                need_g.append(nee)
+
             if spec.within is not None:
                 alive = (ts_e - st["start"]) <= jnp.int32(spec.within)
                 active = active & (alive | ~valid_e)
-            m_at = m[jnp.clip(step, 0, K - 1)]  # pred of current element
-            for k, ok in cross_ok.items():
-                m_at = m_at & jnp.where(step == k, ok, True)
+            m_at = jnp.zeros(S, dtype=bool)
+            for g in range(G):
+                m_at = jnp.where(step == g, need_g[g], m_at)
             absorb = active & valid_e & m_at & (count < maxs[step])
 
             # advance target: smallest t > step whose predicate matches,
-            # with only optional elements skipped in between
+            # with only optional groups skipped in between
             can_leave = count >= mins[step]
-            adv_t = jnp.full(S, K, dtype=jnp.int32)
-            for t in range(K - 1, 0, -1):
+            adv_t = jnp.full(S, G, dtype=jnp.int32)
+            for t in range(G - 1, 0, -1):
                 reach = (
                     active
                     & valid_e
                     & (step < t)
                     & can_leave
                     & self._skipfree(step, t)
-                    & m[t]
+                    & entry_g[t]
                 )
-                if t in cross_ok:
-                    reach = reach & cross_ok[t]
                 adv_t = jnp.where(reach, t, adv_t)
-            advance = ~absorb & (adv_t < K)  # greedy: absorb wins
+            advance = ~absorb & (adv_t < G)  # greedy: absorb wins
 
-            # completion from current position: all later elements optional
-            completable = active & can_leave & self._skipfree(step, K)
+            # completion from current position: all later groups optional
+            completable = active & can_leave & self._skipfree(step, G)
             at_last_full = (
                 active
-                & (step == K - 1)
-                & (count + absorb.astype(jnp.int32) >= maxs[K - 1])
-                & (count + absorb.astype(jnp.int32) >= mins[K - 1])
+                & (step == G - 1)
+                & (count + absorb.astype(jnp.int32) >= maxs[G - 1])
+                & (count + absorb.astype(jnp.int32) >= mins[G - 1])
             )
-            moved_to_last = advance & (adv_t == K - 1) & (maxs[K - 1] == 1)
+            moved_to_last = (
+                advance & (adv_t == G - 1) & (self._maxs[G - 1] == 1)
+            )
 
             if spec.kind == "sequence":
                 miss = active & valid_e & ~absorb & ~advance
@@ -1169,31 +1522,48 @@ class SlotNFAArtifact:
             new_step = jnp.where(advance, adv_t, step)
             new_count = jnp.where(advance, 1, new_count)
             new_last = jnp.where(absorb | advance, ts_e, st["last"])
+
+            # which MEMBER fired: one element per absorb/advance, lowest
+            # matching (for 'and' groups, lowest still-unmatched) wins
+            fire: Dict[int, jnp.ndarray] = {}
+            for g, (mem, op) in enumerate(zip(GM, gops)):
+                at_g = (absorb & (step == g)) | (advance & (adv_t == g))
+                taken = jnp.zeros(S, dtype=bool)
+                for e in mem:
+                    cand = eff[e]
+                    if len(mem) > 1 and op == "and":
+                        cand = cand & ~has_bit(e)
+                    f = at_g & cand & ~taken
+                    taken = taken | f
+                    fire[e] = f
             new_matched = st["matched"]
-            new_matched = jnp.where(
-                absorb,
-                new_matched | jnp.left_shift(jnp.int32(1), step),
-                new_matched,
-            )
-            new_matched = jnp.where(
-                advance,
-                new_matched
-                | jnp.left_shift(jnp.int32(1), jnp.clip(adv_t, 0, K - 1)),
-                new_matched,
-            )
+            for e in range(K):
+                new_matched = jnp.where(
+                    fire[e],
+                    new_matched | jnp.int32(1 << e),
+                    new_matched,
+                )
 
             new_first = {}
             new_lastc = {}
             for pair in pairs:
                 elem = pair[0]
-                f = st[_skey("first", *pair)]
-                l = st[_skey("last", *pair)]
-                took = (absorb & (step == elem)) | (advance & (adv_t == elem))
-                first_take = (advance & (adv_t == elem)) | (
-                    absorb & (step == elem) & (count == 0)
+                g = self._g_of[elem]
+                f0 = st[_skey("first", *pair)]
+                l0 = st[_skey("last", *pair)]
+                took = fire[elem]
+                if len(GM[g]) == 1:
+                    first_take = took & (
+                        (advance & (adv_t == g)) | (count == 0)
+                    )
+                else:
+                    first_take = took  # group members fire once each
+                new_first[pair] = jnp.where(
+                    first_take, caps_e[_skey("src", *pair)], f0
                 )
-                new_first[pair] = jnp.where(first_take, caps_e[_skey("src", *pair)], f)
-                new_lastc[pair] = jnp.where(took, caps_e[_skey("src", *pair)], l)
+                new_lastc[pair] = jnp.where(
+                    took, caps_e[_skey("src", *pair)], l0
+                )
 
             # emissions: scatter completed slots into the match buffer
             emit_ts = jnp.where(
@@ -1204,6 +1574,10 @@ class SlotNFAArtifact:
             pos = jnp.where(emit, n0 + offs, M)  # M = dropped (overflow)
             new_buf = dict(buf)
             new_buf["ts"] = buf["ts"].at[pos].set(emit_ts, mode="drop")
+            if self._needs_mbits:
+                new_buf["mbits"] = buf["mbits"].at[pos].set(
+                    new_matched, mode="drop"
+                )
             for elem, col, which in spec.captures:
                 bkey = _skey(which, elem, col)
                 vals = (
@@ -1224,12 +1598,23 @@ class SlotNFAArtifact:
             # (or the single match is done) — a killed/expired partial
             # re-arms matching on the next start event
             started_now = st["started"] & (active2.any() | st["done"])
+            # arming matches ANY member of group 0 (cross refs cannot
+            # appear there); the lowest matching member is the one armed
+            m0 = m[GM[0][0]]
+            for e in GM[0][1:]:
+                m0 = m0 | m[e]
+            arm_sel: Dict[int, jnp.ndarray] = {}
+            arm_taken = jnp.asarray(False)
+            for e in GM[0]:
+                s_e = m[e] & ~arm_taken
+                arm_taken = arm_taken | m[e]
+                arm_sel[e] = s_e
             if spec.every:
                 any_done = st["done"]
-                want_start = m[0] & valid_e
+                want_start = m0 & valid_e
             else:
                 any_done = st["done"] | emit.any()
-                want_start = m[0] & valid_e & ~started_now & ~any_done
+                want_start = m0 & valid_e & ~started_now & ~any_done
             free_slot = jnp.argmin(active2.astype(jnp.int32))
             has_free = ~active2[free_slot]
             do_start = want_start & has_free
@@ -1241,14 +1626,24 @@ class SlotNFAArtifact:
             new_count = jnp.where(one_hot, 1, new_count)
             new_start = jnp.where(one_hot, ts_e, st["start"])
             new_last = jnp.where(one_hot, ts_e, new_last)
-            new_matched = jnp.where(one_hot, 1, new_matched)
+            arm_bits = jnp.int32(0)
+            for e in GM[0]:
+                arm_bits = jnp.where(
+                    arm_sel[e], jnp.int32(1 << e), arm_bits
+                )
+            new_matched = jnp.where(one_hot, arm_bits, new_matched)
             for pair in pairs:
-                if pair[0] == 0:
+                if pair[0] in GM[0]:
+                    armed_here = one_hot & arm_sel[pair[0]]
                     new_first[pair] = jnp.where(
-                        one_hot, caps_e[_skey("src", *pair)], new_first[pair]
+                        armed_here,
+                        caps_e[_skey("src", *pair)],
+                        new_first[pair],
                     )
                     new_lastc[pair] = jnp.where(
-                        one_hot, caps_e[_skey("src", *pair)], new_lastc[pair]
+                        armed_here,
+                        caps_e[_skey("src", *pair)],
+                        new_lastc[pair],
                     )
             # a start-element event that fully satisfies a 1-element pattern
             # (K==1, max 1) completes immediately on the next event's break /
@@ -1324,6 +1719,10 @@ class SlotNFAArtifact:
             jnp.broadcast_to(jnp.asarray(p(emit_env)), (M,))
             for p in spec.proj_fns
         )
+        if self._needs_mbits:
+            # trailing wire row: the emitting slot's matched bitmask
+            # (decode_packed strips it and nullifies unfired or-members)
+            out_cols = out_cols + (buf["mbits"],)
         return new_state, (buf["n"], buf["ts"], out_cols)
 
 
@@ -1349,6 +1748,16 @@ def compile_pattern_query(
             "absence ('not') elements require a plain chain pattern "
             "(no quantifiers or cross-element references)"
         )
-    # cross-element filters route to the slot engine even for plain
-    # chains: per-slot predicate evaluation needs each partial's captures
+    if (
+        len(spec.groups) == 1
+        and len(spec.groups[0]) > 1
+        and spec.group_ops[0] == "or"
+    ):
+        raise SiddhiQLError(
+            "a pattern that is ONE 'or' group matches single events; "
+            "use a filter union (two queries into one output) instead"
+        )
+    # cross-element filters and and/or groups route to the slot engine
+    # even for plain chains: per-slot evaluation needs each partial's
+    # captures / member-matched bits
     return SlotNFAArtifact(name=name, spec=spec, output_schema=out_schema)

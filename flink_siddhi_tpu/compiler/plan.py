@@ -372,6 +372,112 @@ def compile_plan(
     )
 
 
+def _rewrite_partitioned(q: ast.Query, schemas) -> ast.Query:
+    """Lower ``partition with (key of S) begin ... end`` semantics.
+
+    Patterns: every non-first element gets an implicit cross-element
+    equality filter ``el.key == e0.key`` — a partial match only advances
+    on its own key's events, which is exactly Siddhi's per-partition NFA
+    instance. Combined with key-hash routing (planner: groupby on the
+    key), this also scales patterns across shards with exact results
+    (reference analog: keyBy passthrough, SiddhiStream.java:88-97).
+    Aggregations: the key joins the group-by clause (per-key state).
+    """
+    import dataclasses
+
+    if not q.partition_with:
+        return q
+    keymap = dict(q.partition_with)
+    inp = q.input
+    if isinstance(inp, ast.StreamInput):
+        if inp.stream_id not in keymap:
+            raise SiddhiQLError(
+                f"stream {inp.stream_id!r} has no partition key; add "
+                f"'<attr> of {inp.stream_id}' to the partition clause"
+            )
+        attr = keymap[inp.stream_id]
+        if attr not in schemas[inp.stream_id]:
+            raise SiddhiQLError(
+                f"partition key {attr!r} is not an attribute of "
+                f"{inp.stream_id!r}"
+            )
+        sel = q.selector
+        has_agg = sel.group_by or any(
+            ast.contains_aggregate(i.expr) for i in sel.items
+        )
+        if inp.windows:
+            raise SiddhiQLError(
+                "windows inside 'partition with' are not supported yet "
+                "(a per-partition window is not a group-by over a shared "
+                "window)"
+            )
+        if has_agg and attr not in sel.group_by:
+            sel = dataclasses.replace(
+                sel, group_by=tuple(sel.group_by) + (attr,)
+            )
+            return dataclasses.replace(q, selector=sel)
+        return q
+    if isinstance(inp, ast.JoinInput):
+        raise SiddhiQLError(
+            "joins inside 'partition with' are not supported yet"
+        )
+    # pattern / sequence
+    if inp.kind == "sequence":
+        raise SiddhiQLError(
+            "sequences inside 'partition with' are not supported yet "
+            "(strict continuity is per-partition, not global)"
+        )
+    if not inp.every_:
+        raise SiddhiQLError(
+            "non-'every' patterns inside 'partition with' are not "
+            "supported yet (the single-match rule is per partition key, "
+            "but the engine's match gate is per instance)"
+        )
+    els = inp.elements
+    el0 = els[0]
+    if (el0.min_count, el0.max_count) != (1, 1):
+        raise SiddhiQLError(
+            "the first element of a partitioned pattern cannot be "
+            "quantified yet"
+        )
+    if len(els) > 1 and els[1].group_link is not None:
+        raise SiddhiQLError(
+            "an 'and'/'or' group as the first step of a partitioned "
+            "pattern is not supported yet"
+        )
+    for sid in {el.stream_id for el in els}:
+        if sid not in keymap:
+            raise SiddhiQLError(
+                f"stream {sid!r} has no partition key; add "
+                f"'<attr> of {sid}' to the partition clause"
+            )
+    new_els = [el0]
+    attr0 = keymap[el0.stream_id]
+    if attr0 not in schemas[el0.stream_id]:
+        raise SiddhiQLError(
+            f"partition key {attr0!r} is not an attribute of "
+            f"{el0.stream_id!r}"
+        )
+    for el in els[1:]:
+        if el.negated:
+            raise SiddhiQLError(
+                "absent ('not') elements inside 'partition with' "
+                "patterns are not supported yet"
+            )
+        eq = ast.Binary(
+            "==",
+            ast.Attr(keymap[el.stream_id], qualifier=el.alias),
+            ast.Attr(attr0, qualifier=el0.alias),
+        )
+        filt = (
+            eq if el.filter is None else ast.Binary("and", el.filter, eq)
+        )
+        new_els.append(dataclasses.replace(el, filter=filt))
+    return dataclasses.replace(
+        q, input=dataclasses.replace(inp, elements=tuple(new_els))
+    )
+
+
 def _compile_query(
     q: ast.Query,
     name: str,
@@ -381,6 +487,7 @@ def _compile_query(
     table_schemas: Optional[Dict[str, StreamSchema]] = None,
 ):
     table_schemas = table_schemas or {}
+    q = _rewrite_partitioned(q, schemas)
     if q.output_stream in table_schemas or q.output_action in (
         "update", "delete",
     ):

@@ -186,8 +186,14 @@ class PatternElement:
     filter: Optional[Expr] = None
     min_count: int = 1
     max_count: int = 1
-    # 'not' patterns (absence) — parsed, compiled in a later milestone
+    # 'not' patterns (absence)
     negated: bool = False
+    # timed terminal absence (`A -> not B for 5 sec`): emit when the
+    # window elapses with no B; only valid on the last, negated element
+    absent_for: Optional[int] = None  # ms
+    # logical groups (`e1 = A and e2 = B`, `e1 = A or e2 = B`): 'and'/'or'
+    # links this element into the SAME step as the previous element
+    group_link: Optional[str] = None
 
 
 @dataclass(frozen=True)
@@ -230,6 +236,9 @@ class Query:
     name: Optional[str] = None  # @info(name='...')
     # update/delete row-match condition: ``update T on T.x == x``
     on_condition: Optional[Expr] = None
+    # `partition with (attr of Stream, ...) begin ... end`: per-key
+    # isolated execution — (stream_id -> key attribute) for this query
+    partition_with: Tuple[Tuple[str, str], ...] = ()
 
     def input_stream_ids(self) -> Tuple[str, ...]:
         inp = self.input

@@ -75,11 +75,14 @@ def parse_plan(text: str) -> ast.ExecutionPlan:
                 stream_defs.append(d)
             else:
                 table_defs.append(d)
+        elif ts.at_keyword("partition"):
+            queries.extend(_parse_partition(ts, name=pending_name))
         elif ts.at_keyword("from"):
             queries.append(_parse_query(ts, name=pending_name))
         else:
             ts.error(
-                f"expected 'define' or 'from', found {ts.current.text!r}"
+                f"expected 'define', 'partition' or 'from', found "
+                f"{ts.current.text!r}"
             )
     return ast.ExecutionPlan(
         tuple(stream_defs), tuple(table_defs), tuple(queries)
@@ -156,6 +159,46 @@ def _parse_query(ts: TokenStream, name: Optional[str] = None) -> ast.Query:
     selector = _parse_selector(ts)
     action, out, on = _parse_output(ts)
     return ast.Query(input_clause, selector, out, action, name, on)
+
+
+def _parse_partition(
+    ts: TokenStream, name: Optional[str] = None
+) -> List[ast.Query]:
+    """``partition with (attr of Stream, ...) begin <query>+ end``:
+    per-key isolated execution of the enclosed queries (Siddhi partition
+    semantics). Each enclosed query carries the key map."""
+    ts.expect_keyword("partition")
+    ts.expect_keyword("with")
+    ts.expect_op("(")
+    keys: List[Tuple[str, str]] = []
+    while True:
+        attr = ts.expect_id().text
+        ts.expect_keyword("of")
+        stream = ts.expect_id().text
+        keys.append((stream, attr))
+        if not ts.accept_op(","):
+            break
+    ts.expect_op(")")
+    ts.expect_keyword("begin")
+    out: List[ast.Query] = []
+    import dataclasses
+
+    while not ts.at_keyword("end"):
+        ts.accept_op(";")
+        if ts.at_keyword("end"):
+            break
+        inner_name = _parse_annotations(ts) or (
+            f"{name}_{len(out)}" if name else None
+        )
+        q = _parse_query(ts, name=inner_name)
+        out.append(
+            dataclasses.replace(q, partition_with=tuple(keys))
+        )
+        ts.accept_op(";")
+    ts.expect_keyword("end")
+    if not out:
+        ts.error("partition block contains no queries")
+    return out
 
 
 # --------------------------------------------------------------------------
@@ -245,7 +288,7 @@ def _parse_join(ts: TokenStream, left: ast.StreamInput) -> ast.JoinInput:
 
 def _parse_pattern(ts: TokenStream) -> ast.PatternInput:
     every = bool(ts.accept_keyword("every"))
-    elements: List[ast.PatternElement] = [_parse_pattern_element(ts)]
+    elements: List[ast.PatternElement] = list(_parse_pattern_step(ts))
     kind: Optional[str] = None
     while True:
         if ts.at_op("->"):
@@ -266,13 +309,38 @@ def _parse_pattern(ts: TokenStream) -> ast.PatternInput:
             ts.error(
                 "'every' on a non-first pattern element is not supported"
             )
-        elements.append(_parse_pattern_element(ts))
+        elements.extend(_parse_pattern_step(ts))
     within = None
     if ts.accept_keyword("within"):
         within = _parse_time_duration(ts)
     return ast.PatternInput(
         tuple(elements), kind or "pattern", every, within
     )
+
+
+def _parse_pattern_step(ts: TokenStream) -> List[ast.PatternElement]:
+    """One logical step: a single element, or an and/or group
+    (``e1 = A and e2 = B``, optionally parenthesized)."""
+    import dataclasses
+
+    paren = bool(ts.accept_op("("))
+    members = [_parse_pattern_element(ts)]
+    op: Optional[str] = None
+    while ts.at_keyword("and") or ts.at_keyword("or"):
+        if ts.accept_keyword("and"):
+            this_op = "and"
+        else:
+            ts.accept_keyword("or")
+            this_op = "or"
+        if op is None:
+            op = this_op
+        elif op != this_op:
+            ts.error("cannot mix 'and' and 'or' in one pattern step")
+        el = _parse_pattern_element(ts)
+        members.append(dataclasses.replace(el, group_link=op))
+    if paren:
+        ts.expect_op(")")
+    return members
 
 
 def _parse_pattern_element(ts: TokenStream) -> ast.PatternElement:
@@ -308,8 +376,12 @@ def _parse_pattern_element(ts: TokenStream) -> ast.PatternElement:
         else:
             max_count = min_count
         ts.expect_op(">")
+    absent_for = None
+    if negated and ts.accept_keyword("for"):
+        absent_for = _parse_time_duration(ts)
     return ast.PatternElement(
-        alias, stream_id, filt, min_count, max_count, negated
+        alias, stream_id, filt, min_count, max_count, negated,
+        absent_for,
     )
 
 

@@ -93,12 +93,28 @@ def infer_stream_partitions(
                 put(inp.left.stream_id, StreamPartition("broadcast"))
                 put(inp.right.stream_id, StreamPartition("broadcast"))
         elif isinstance(inp, ast.PatternInput):
-            # pattern state is a single NFA instance over the whole stream
-            # (unless the plan wraps it in `partition with`): all events of
-            # all involved streams must reach that instance -> broadcast to
-            # its shard; group-by on selector keys only affects aggregation
-            for sid in q.input_stream_ids():
-                put(sid, StreamPartition("broadcast"))
+            if q.partition_with:
+                # `partition with (key of S)`: per-key NFA instances,
+                # every key's events owned by one shard -> key-hash
+                # routing scales patterns across the mesh with exact
+                # results (reference analog: keyBy passthrough,
+                # SiddhiStream.java:88-97)
+                keymap = dict(q.partition_with)
+                for sid in q.input_stream_ids():
+                    attr = keymap.get(sid)
+                    if attr is None:
+                        raise SiddhiQLError(
+                            f"stream {sid!r} has no partition key in "
+                            "the partition clause"
+                        )
+                    put(sid, StreamPartition("groupby", (attr,)))
+            else:
+                # pattern state is a single NFA instance over the whole
+                # stream: all events of all involved streams must reach
+                # that instance -> broadcast to its shard; group-by on
+                # selector keys only affects aggregation
+                for sid in q.input_stream_ids():
+                    put(sid, StreamPartition("broadcast"))
         else:
             raise TypeError(type(inp))
     return partitions

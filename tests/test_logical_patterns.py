@@ -1,0 +1,294 @@
+"""Logical pattern groups (`and`/`or`) and terminal timed absence
+(`A -> not B for t`) — parity-pinned against per-event Python oracles.
+
+Reference capability surface: siddhi-core pattern processing
+(package-info.java:36-38, README.md:84); the reference's own tests only
+exercise `->` chains, so these semantics are pinned by oracle instead.
+"""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.query.lexer import SiddhiQLError
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.sources import BatchSource
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+SCHEMA = StreamSchema(
+    [("id", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+)
+
+
+def run(cql, ids, ts, batch=16):
+    bs = []
+    for s in range(0, len(ids), batch):
+        e = min(s + batch, len(ids))
+        bs.append(
+            EventBatch(
+                "S", SCHEMA,
+                {
+                    "id": np.array(ids[s:e], np.int32),
+                    "timestamp": np.array(ts[s:e], np.int64),
+                },
+                np.array(ts[s:e], np.int64),
+            )
+        )
+    plan = compile_plan(cql, {"S": SCHEMA})
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(bs))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return sorted(job.results("o"))
+
+
+# --------------------------------------------------------------------------
+# and / or groups
+# --------------------------------------------------------------------------
+
+def oracle_or_chain(ids, ts, first, pair, last):
+    """every s1=[first] -> (a=[pair0] or b=[pair1]) -> s4=[last]"""
+    partials = []  # (t1, stage) stage: 1=want group, 2=want last
+    out = []
+    for eid, t in zip(ids, ts):
+        nxt = []
+        for t1, stage in partials:
+            if stage == 1 and eid in pair:
+                nxt.append((t1, 2))
+            elif stage == 2 and eid == last:
+                out.append((t1, t))
+            else:
+                nxt.append((t1, stage))
+        partials = nxt
+        if eid == first:
+            partials.append((t, 1))
+    return sorted(out)
+
+
+def oracle_and_chain(ids, ts, first, pair):
+    """every s1=[first] -> (a=[pair0] and b=[pair1]): any order."""
+    partials = []  # (t1, {member: ts})
+    out = []
+    for eid, t in zip(ids, ts):
+        nxt = []
+        for t1, seen in partials:
+            if eid in pair and eid not in seen:
+                seen2 = dict(seen)
+                seen2[eid] = t
+                if len(seen2) == 2:
+                    out.append((t1, seen2[pair[0]], seen2[pair[1]]))
+                else:
+                    nxt.append((t1, seen2))
+            else:
+                nxt.append((t1, seen))
+        partials = nxt
+        if eid == first:
+            partials.append((t, {}))
+    return sorted(out)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("batch", [5, 64])
+def test_or_group_vs_oracle(seed, batch):
+    rng = np.random.default_rng(seed)
+    n = 200
+    ids = rng.integers(0, 6, n).tolist()
+    ts = (1000 + np.cumsum(rng.integers(1, 5, n))).astype(int).tolist()
+    cql = (
+        "from every s1 = S[id == 1] -> "
+        "(a = S[id == 2] or b = S[id == 3]) -> s4 = S[id == 4] "
+        "select s1.timestamp as t1, s4.timestamp as t4 insert into o"
+    )
+    assert run(cql, ids, ts, batch) == oracle_or_chain(
+        ids, ts, 1, (2, 3), 4
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("batch", [5, 64])
+def test_and_group_vs_oracle(seed, batch):
+    rng = np.random.default_rng(100 + seed)
+    n = 150
+    ids = rng.integers(0, 5, n).tolist()
+    ts = (1000 + np.cumsum(rng.integers(1, 5, n))).astype(int).tolist()
+    cql = (
+        "from every s1 = S[id == 1] -> "
+        "(a = S[id == 2] and b = S[id == 3]) "
+        "select s1.timestamp as t1, a.timestamp as ta, "
+        "b.timestamp as tb insert into o"
+    )
+    assert run(cql, ids, ts, batch) == oracle_and_chain(ids, ts, 1, (2, 3))
+
+
+def test_and_group_any_order_and_arming():
+    cql = (
+        "from every (a = S[id == 2] and b = S[id == 3]) -> s4 = S[id == 4] "
+        "select a.timestamp as ta, b.timestamp as tb insert into o"
+    )
+    # b arrives first, then a, then the trailing element
+    assert run(cql, [3, 2, 4], [1000, 1001, 1002]) == [(1001, 1000)]
+
+
+def test_group_validation_errors():
+    with pytest.raises(SiddhiQLError, match="mix 'and' and 'or'"):
+        compile_plan(
+            "from every (a = S[id == 1] and b = S[id == 2] or c = S[id == 3])"
+            " -> d = S[id == 4] select a.timestamp as t insert into o",
+            {"S": SCHEMA},
+        )
+    with pytest.raises(SiddhiQLError, match="cannot be quantified"):
+        compile_plan(
+            "from every (a = S[id == 1]+ and b = S[id == 2]) -> c = S[id==3]"
+            " select b.timestamp as t insert into o",
+            {"S": SCHEMA},
+        )
+    with pytest.raises(SiddhiQLError, match="ONE 'or' group"):
+        compile_plan(
+            "from every (a = S[id == 1] or b = S[id == 2]) "
+            "select a.timestamp as t insert into o",
+            {"S": SCHEMA},
+        )
+    with pytest.raises(SiddhiQLError, match="match in any order"):
+        compile_plan(
+            "from every s0 = S[id == 9] -> "
+            "(a = S[id == 1] and b = S[id == 2 and b.timestamp > "
+            "a.timestamp]) select a.timestamp as t insert into o",
+            {"S": SCHEMA},
+        )
+
+
+# --------------------------------------------------------------------------
+# timed terminal absence
+# --------------------------------------------------------------------------
+
+def oracle_timed_absence(ids, ts, first, guard, tfor):
+    """every s1=[first] -> not [guard] for tfor. Emits (t1,) at deadline
+    t1+tfor when no guard event lands in (t1, t1+tfor]. End of stream
+    matures all pending windows."""
+    out = []
+    for i, (eid, t1) in enumerate(zip(ids, ts)):
+        if eid != first:
+            continue
+        ok = True
+        for eid2, t2 in zip(ids[i + 1:], ts[i + 1:]):
+            if eid2 == guard and t1 < t2 <= t1 + tfor:
+                ok = False
+                break
+        if ok:
+            out.append((t1,))
+    return sorted(out)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("batch", [4, 64])
+def test_timed_absence_vs_oracle(seed, batch):
+    rng = np.random.default_rng(seed)
+    n = 120
+    ids = rng.integers(0, 4, n).tolist()
+    ts = (1000 + np.cumsum(rng.integers(50, 800, n))).astype(int).tolist()
+    cql = (
+        "from every s1 = S[id == 1] -> not S[id == 2] for 2 sec "
+        "select s1.timestamp as t1 insert into o"
+    )
+    assert run(cql, ids, ts, batch) == oracle_timed_absence(
+        ids, ts, 1, 2, 2000
+    )
+
+
+def test_timed_absence_after_chain():
+    # full chain then absence window: s1 -> s2 -> not s3 for 1 sec
+    cql = (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] "
+        "-> not S[id == 3] for 1 sec "
+        "select s1.timestamp as t1, s2.timestamp as t2 insert into o"
+    )
+    # first chain killed by id3@2500 (inside 2000+1000); second survives
+    ids = [1, 2, 3, 1, 2, 9]
+    ts = [1000, 2000, 2500, 5000, 5100, 9000]
+    assert run(cql, ids, ts) == [(5000, 5100)]
+
+
+def test_timed_absence_emission_timestamp_is_deadline():
+    cql = (
+        "from every s1 = S[id == 1] -> not S[id == 2] for 2 sec "
+        "select s1.timestamp as t1 insert into o"
+    )
+    plan = compile_plan(cql, {"S": SCHEMA})
+    ids, ts = [1, 9], [1000, 8000]
+    b = EventBatch(
+        "S", SCHEMA,
+        {
+            "id": np.array(ids, np.int32),
+            "timestamp": np.array(ts, np.int64),
+        },
+        np.array(ts, np.int64),
+    )
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter([b]))],
+        batch_size=8, time_mode="processing",
+    )
+    job.run()
+    rows = job.results_with_ts("o")
+    assert rows == [(3000, (1000,))]  # visible at t1 + 2 sec
+
+
+def test_absence_validation_errors():
+    with pytest.raises(SiddhiQLError, match="needs a duration"):
+        compile_plan(
+            "from every s1 = S[id == 1] -> not S[id == 2] "
+            "select s1.timestamp as t insert into o",
+            {"S": SCHEMA},
+        )
+    with pytest.raises(SiddhiQLError, match="must be the last"):
+        compile_plan(
+            "from every s1 = S[id == 1] -> not S[id == 2] for 1 sec "
+            "-> s3 = S[id == 3] select s1.timestamp as t insert into o",
+            {"S": SCHEMA},
+        )
+
+
+def test_or_group_unfired_member_is_null():
+    cql = (
+        "from every s1 = S[id == 1] -> (a = S[id == 2] or b = S[id == 3]) "
+        "select s1.timestamp as t1, a.timestamp as ta, b.timestamp as tb "
+        "insert into o"
+    )
+    got = run(cql, [1, 3, 1, 2], [1000, 2000, 3000, 4000])
+    # exactly one member fires per match; the other decodes None
+    assert sorted(got, key=str) == sorted(
+        [(1000, None, 2000), (3000, 4000, None)], key=str
+    )
+
+
+def test_non_every_timed_absence_single_match():
+    cql = (
+        "from s1 = S[id == 1] -> not S[id == 2] for 2 sec "
+        "select s1.timestamp as t1 insert into o"
+    )
+    # two waiting partials at flush: only the earliest emits
+    assert run(cql, [1, 1, 9], [1000, 1500, 1600]) == [(1000,)]
+    # match matured in-stream: flush must not add a second
+    assert run(cql, [1, 9, 1, 9], [1000, 4000, 4100, 4200]) == [(1000,)]
+
+
+def test_same_timestamp_guard_does_not_kill():
+    # window is (t1, t1 + t]: a guard AT t1 (later arrival, equal ts)
+    # does not kill the absence window — matches the oracle's t1 < t2
+    cql = (
+        "from every s1 = S[id == 1] -> not S[id == 2] for 2 sec "
+        "select s1.timestamp as t1 insert into o"
+    )
+    assert run(cql, [1, 2, 9], [1000, 1000, 5000]) == [(1000,)]
+
+
+def test_same_ts_guard_does_not_mask_later_guard():
+    # a same-timestamp guard must not hide a LATER guard inside the
+    # window: id2@1000 is outside (t1, t1+t], but id2@2000 is inside
+    cql = (
+        "from every s1 = S[id == 1] -> not S[id == 2] for 2 sec "
+        "select s1.timestamp as t1 insert into o"
+    )
+    assert run(cql, [1, 2, 2, 9], [1000, 1000, 2000, 9000]) == []

@@ -1,0 +1,196 @@
+"""`partition with (key of S)` — per-key pattern/aggregation isolation
+and key-hash scaling across the shard mesh.
+
+Reference analog: keyed-stream passthrough (SiddhiStream.java:88-97) +
+group-key routing (AddRouteOperator.java:79-92); Siddhi's `partition
+with` gives each key its own NFA instance, which is what makes pattern
+queries scale across shards with exact results (VERDICT round-1 #4).
+"""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.parallel import ShardedJob
+from flink_siddhi_tpu.parallel.router import Router
+from flink_siddhi_tpu.query.lexer import SiddhiQLError
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.sources import BatchSource
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+SCHEMA = StreamSchema(
+    [
+        ("id", AttributeType.INT),
+        ("user", AttributeType.INT),
+        ("price", AttributeType.DOUBLE),
+        ("timestamp", AttributeType.LONG),
+    ]
+)
+
+PAT_CQL = """
+partition with (user of S)
+begin
+  from every s1 = S[id == 1] -> s2 = S[id == 2] -> s3 = S[id == 3]
+  select s1.timestamp as t1, s3.timestamp as t3, s1.user as u
+  insert into o;
+end
+"""
+
+
+def make_data(seed=3, n=600, n_users=16):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 5, n).astype(np.int32)
+    users = rng.integers(0, n_users, n).astype(np.int32)
+    prices = rng.random(n)
+    ts = (1000 + np.arange(n)).astype(np.int64)
+    return ids, users, prices, ts
+
+
+def make_batches(ids, users, prices, ts, batch=64):
+    return [
+        EventBatch(
+            "S", SCHEMA,
+            {
+                "id": ids[s:s + batch],
+                "user": users[s:s + batch],
+                "price": prices[s:s + batch],
+                "timestamp": ts[s:s + batch],
+            },
+            ts[s:s + batch],
+        )
+        for s in range(0, len(ts), batch)
+    ]
+
+
+def oracle_per_key_chain(ids, users, ts):
+    out = []
+    per_user = {}
+    for eid, u, t in zip(ids.tolist(), users.tolist(), ts.tolist()):
+        lst = per_user.setdefault(u, [])
+        nxt = []
+        for (t1, step) in lst:
+            if eid == step + 1:
+                if step + 1 == 3:
+                    out.append((t1, t, u))
+                else:
+                    nxt.append((t1, step + 1))
+            else:
+                nxt.append((t1, step))
+        per_user[u] = nxt
+        if eid == 1:
+            per_user[u].append((t, 1))
+    return sorted(out)
+
+
+def test_partitioned_pattern_matches_per_key_oracle():
+    ids, users, prices, ts = make_data()
+    plan = compile_plan(PAT_CQL, {"S": SCHEMA})
+    assert plan.partitions["S"].kind == "groupby"
+    assert plan.partitions["S"].keys == ("user",)
+    job = Job(
+        [plan],
+        [BatchSource("S", SCHEMA, iter(make_batches(ids, users, prices, ts)))],
+        batch_size=64, time_mode="processing",
+    )
+    job.run()
+    assert sorted(job.results("o")) == oracle_per_key_chain(ids, users, ts)
+
+
+def test_partitioned_pattern_scales_across_shards():
+    # VERDICT #4 'done' criterion: an 8-shard mesh where a keyed 3-step
+    # pattern uses >1 shard and matches the single-device result
+    ids, users, prices, ts = make_data()
+    plan = compile_plan(PAT_CQL, {"S": SCHEMA})
+    router = Router(8, plan.partitions)
+    shards = router.route_all(make_batches(ids, users, prices, ts)[:1])
+    assert sum(1 for sh in shards if sh) > 1, "pattern pinned to one shard"
+    sj = ShardedJob(
+        [plan],
+        [BatchSource("S", SCHEMA, iter(make_batches(ids, users, prices, ts)))],
+        n_shards=8, batch_size=64, time_mode="processing",
+    )
+    sj.run()
+    assert sorted(sj.results("o")) == oracle_per_key_chain(ids, users, ts)
+
+
+def test_partitioned_aggregation_is_per_key():
+    ids, users, prices, ts = make_data(n=200)
+    cql = """
+partition with (user of S)
+begin
+  from S select user, sum(price) as total insert into totals;
+end
+"""
+    plan = compile_plan(cql, {"S": SCHEMA})
+    job = Job(
+        [plan],
+        [BatchSource("S", SCHEMA, iter(make_batches(ids, users, prices, ts)))],
+        batch_size=64, time_mode="processing",
+    )
+    job.run()
+    rows = job.results("totals")
+    # cumulative per-key running sum: the last row per user equals the
+    # user's total
+    last = {}
+    for u, total in rows:
+        last[u] = total
+    expect = {}
+    for u, p in zip(users.tolist(), prices.tolist()):
+        expect[u] = expect.get(u, 0.0) + p
+    assert set(last) == set(expect)
+    for u in expect:
+        np.testing.assert_allclose(last[u], expect[u], rtol=1e-5)
+
+
+def test_partition_validation_errors():
+    with pytest.raises(SiddhiQLError, match="no partition key"):
+        compile_plan(
+            """
+partition with (user of Other)
+begin
+  from every s1 = S[id == 1] -> s2 = S[id == 2]
+  select s1.timestamp as t insert into o;
+end
+""",
+            {
+                "S": SCHEMA,
+                "Other": SCHEMA,
+            },
+        )
+    with pytest.raises(SiddhiQLError, match="not supported yet"):
+        compile_plan(
+            """
+partition with (user of S)
+begin
+  from every s1 = S[id == 1], s2 = S[id == 2]
+  select s1.timestamp as t insert into o;
+end
+""",
+            {"S": SCHEMA},
+        )
+    with pytest.raises(SiddhiQLError, match="windows inside"):
+        compile_plan(
+            """
+partition with (user of S)
+begin
+  from S#window.length(10) select user, sum(price) as t insert into o;
+end
+""",
+            {"S": SCHEMA},
+        )
+
+
+def test_partitioned_non_every_rejected():
+    with pytest.raises(SiddhiQLError, match="per partition key"):
+        compile_plan(
+            """
+partition with (user of S)
+begin
+  from s1 = S[id == 1] -> s2 = S[id == 2]
+  select s1.user as u insert into o;
+end
+""",
+            {"S": SCHEMA},
+        )
