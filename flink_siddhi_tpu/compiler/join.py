@@ -30,16 +30,88 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import jax.numpy as jnp
 from jax import lax
 
+import numpy as np
+
 from ..query import ast
 from ..query.lexer import SiddhiQLError
 from ..schema.types import AttributeType
-from .expr import ColumnEnv, ExprResolver, compile_expr
+from .expr import ColumnEnv, ExprResolver, ResolvedAttr, compile_expr
 from .output import OutputField, OutputSchema
-from .window import _window_of, _referenced_keys
+from .window import _window_of
 
 JOIN_WINDOW_CAPACITY = 128  # ring slots per side when the window is
 # unbounded or time-based (bounded-slot policy, SURVEY.md §7 hard part 2)
 JOIN_OUT_FACTOR = 4  # output buffer capacity = factor * tape capacity
+
+
+class _JoinResolver:
+    """Side-qualified attribute resolution for join pair expressions.
+
+    Every reference resolves to an env key unique to its SIDE
+    (``l:S.x`` / ``r:S.x``) so self-joins (`from S as a join S as b`)
+    can tell ``a.x`` from ``b.x``; ``used`` records each env key's
+    (side tag, tape column key, type) for ring buffering."""
+
+    def __init__(self, left_si, right_si, schemas) -> None:
+        self._by_ref: Dict[str, Tuple[str, str, object]] = {}
+        for tag, si in (("l", left_si), ("r", right_si)):
+            if si.ref_name in self._by_ref:
+                raise SiddhiQLError(
+                    "self-join sides need distinct aliases: "
+                    f"'from {si.stream_id} as a join {si.stream_id} as b'"
+                )
+            self._by_ref[si.ref_name] = (
+                tag, si.stream_id, schemas[si.stream_id]
+            )
+        # stream-id qualifiers are allowed when exactly one side uses
+        # that stream (and the id is not already a ref name)
+        by_sid: Dict[str, List] = {}
+        for ent in self._by_ref.values():
+            by_sid.setdefault(ent[1], []).append(ent)
+        for sid, ents in by_sid.items():
+            if sid not in self._by_ref and len(ents) == 1:
+                self._by_ref[sid] = ents[0]
+        self.used: Dict[str, Tuple[str, str, AttributeType]] = {}
+
+    def resolve(self, attr: ast.Attr) -> ResolvedAttr:
+        if attr.index is not None:
+            raise SiddhiQLError(
+                "indexed references are not valid in join expressions"
+            )
+        if attr.qualifier is not None:
+            ent = self._by_ref.get(attr.qualifier)
+            if ent is None:
+                raise SiddhiQLError(
+                    f"unknown stream reference {attr.qualifier!r}"
+                )
+            hits = [ent]
+        else:
+            seen = set()
+            hits = []
+            for ref, ent in self._by_ref.items():
+                if ent[0] in seen:
+                    continue
+                if attr.name in ent[2]:
+                    seen.add(ent[0])
+                    hits.append(ent)
+            if not hits:
+                raise SiddhiQLError(f"unknown attribute {attr.name!r}")
+            if len(hits) > 1:
+                raise SiddhiQLError(
+                    f"ambiguous attribute {attr.name!r}; qualify it with "
+                    "a stream alias"
+                )
+        tag, sid, schema = hits[0]
+        if attr.name not in schema:
+            raise SiddhiQLError(
+                f"stream {sid!r} has no attribute {attr.name!r}"
+            )
+        atype = schema.field_type(attr.name)
+        key = f"{tag}:{sid}.{attr.name}"
+        self.used[key] = (tag, f"{sid}.{attr.name}", atype)
+        return ResolvedAttr(
+            key, atype, schema.string_tables.get(attr.name)
+        )
 
 
 @dataclass
@@ -51,7 +123,9 @@ class _Side:
     window_mode: str  # 'length' | 'time'
     window_n: int  # length bound (ring capacity for time/unbounded)
     time_ms: Optional[int]
-    cols: List[str]  # tape column keys buffered in this side's ring
+    # (env_key, tape_key) buffered in this side's ring — env keys are
+    # side-prefixed so a self-join's two rings stay distinct
+    cols: List[Tuple[str, str]]
     col_types: List[AttributeType]
     outer: bool  # emit this side's unmatched arrivals
 
@@ -65,11 +139,52 @@ class JoinArtifact:
     on_fn: Optional[Callable]
     within: Optional[int]
     proj_fns: List[Callable]
+    # per projection: the side tags ('l'/'r') it references — outer-join
+    # rows decode None for projections over the missing side
+    proj_tags: Tuple[frozenset, ...] = ()
     output_mode: str = "buffered"
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
         """Widest per-cycle emission block (drain-cadence contract)."""
         return JOIN_OUT_FACTOR * tape_capacity
+
+    @property
+    def _nullable(self) -> bool:
+        return self.left.outer or self.right.outer
+
+    @property
+    def acc_rows(self) -> int:
+        return (
+            1
+            + len(self.output_schema.fields)
+            + (1 if self._nullable else 0)
+        )
+
+    def decode_packed(self, n: int, block: "np.ndarray"):
+        """Accumulator block -> rows; outer joins carry a trailing
+        missing-side row (0 = pair, 1 = right missing, 2 = left missing)
+        nullifying projections over the absent side (Siddhi null, not a
+        zero-filled value)."""
+        schema = self.output_schema
+        C = len(schema.fields)
+        if not self._nullable:
+            return [(schema, schema.decode_packed_block(n, block))]
+        # decode_buffered re-sorts rows by timestamp (stable); the
+        # missing-side row must follow the SAME permutation
+        order = np.argsort(np.asarray(block[0, :n]), kind="stable")
+        missing = np.asarray(block[1 + C, :n])[order]
+        rows = schema.decode_packed_block(n, block[: 1 + C])
+        out = []
+        for i, (ts_v, row) in enumerate(rows):
+            m = int(missing[i])
+            if m:
+                gone = "r" if m == 1 else "l"
+                row = tuple(
+                    None if gone in tags else v
+                    for v, tags in zip(row, self.proj_tags)
+                )
+            out.append((ts_v, row))
+        return [(schema, out)]
 
     def init_state(self) -> Dict:
         st = {"enabled": jnp.asarray(True),
@@ -98,11 +213,13 @@ class JoinArtifact:
             C = side.window_n
             carry = state[f"{tag}_seen"]
             comb = {
-                key: jnp.concatenate(
+                env_key: jnp.concatenate(
                     [state[f"{tag}_c{j}"],
-                     env[key][order].astype(state[f"{tag}_c{j}"].dtype)]
+                     env[tape_key][order].astype(
+                         state[f"{tag}_c{j}"].dtype
+                     )]
                 )
-                for j, key in enumerate(side.cols)
+                for j, (env_key, tape_key) in enumerate(side.cols)
             }
             ts_comb = jnp.concatenate(
                 [state[f"{tag}_ts"], tape.ts[order]]
@@ -119,6 +236,12 @@ class JoinArtifact:
                 side=side, mask=mask, M=M, comb=comb, ts=ts_comb,
                 valid=valid_comb, ords=ord_comb,
                 cum=carry + jnp.cumsum(mask).astype(jnp.int32),
+                # tape position of each in-batch combined entry (-1 for
+                # carried ring entries): identifies THE SAME event across
+                # a self-join's two sides regardless of per-side filters
+                posid=jnp.concatenate(
+                    [jnp.full(C, -1, jnp.int32), order.astype(jnp.int32)]
+                ),
             )
 
         segs = []  # (flags, ts, cols) per emission segment
@@ -129,11 +252,12 @@ class JoinArtifact:
 
         # concatenate all segments and compact into the output buffer
         cap = JOIN_OUT_FACTOR * E
+        n_out = len(self.proj_fns) + (1 if self._nullable else 0)
         flags = jnp.concatenate([s[0] for s in segs])
         ts_all = jnp.concatenate([s[1] for s in segs])
         cols_all = tuple(
             jnp.concatenate([s[2][i] for s in segs])
-            for i in range(len(self.proj_fns))
+            for i in range(n_out)
         )
         order = jnp.argsort(jnp.logical_not(flags))[:cap]
         n = flags.sum().astype(jnp.int32)
@@ -149,9 +273,9 @@ class JoinArtifact:
             s = sides[tag]
             C = s["side"].window_n
             M = s["M"]
-            for j, key in enumerate(s["side"].cols):
+            for j, (env_key, _tk) in enumerate(s["side"].cols):
                 new_state[f"{tag}_c{j}"] = lax.dynamic_slice(
-                    s["comb"][key], (M,), (C,)
+                    s["comb"][env_key], (M,), (C,)
                 )
             new_state[f"{tag}_ts"] = lax.dynamic_slice(s["ts"], (M,), (C,))
             new_state[f"{tag}_valid"] = lax.dynamic_slice(
@@ -171,6 +295,14 @@ class JoinArtifact:
         bside: _Side = b["side"]
         member = b["valid"][None, :] & a["mask"][:, None]
         member = member & (b["ords"][None, :] < b["cum"][:, None])
+        if aside.stream_code == bside.stream_code:
+            # self-join: an event never pairs with itself (it would
+            # otherwise appear once per direction); identity = same tape
+            # position, robust to differing per-side filters
+            member = member & (
+                b["posid"][None, :]
+                != jnp.arange(E, dtype=jnp.int32)[:, None]
+            )
         if bside.window_mode == "length":
             member = member & (
                 b["ords"][None, :] >= b["cum"][:, None] - bside.window_n
@@ -185,10 +317,10 @@ class JoinArtifact:
             )
 
         pair_env: ColumnEnv = {}
-        for key in aside.cols:
-            pair_env[key] = env[key][:, None]
-        for j, key in enumerate(bside.cols):
-            pair_env[key] = b["comb"][key][None, :]
+        for env_key, tape_key in aside.cols:
+            pair_env[env_key] = env[tape_key][:, None]
+        for env_key, _tk in bside.cols:
+            pair_env[env_key] = b["comb"][env_key][None, :]
         if self.on_fn is not None:
             member = member & self.on_fn(pair_env)
 
@@ -199,21 +331,26 @@ class JoinArtifact:
             jnp.broadcast_to(jnp.asarray(p(pair_env)), (E, N)).reshape(-1)
             for p in self.proj_fns
         )
+        if self._nullable:
+            cols = cols + (jnp.zeros(E * N, jnp.int32),)  # 0 = real pair
         segs = [(flags, ts_mat, cols)]
 
         if aside.outer:
             unmatched = a["mask"] & ~member.any(axis=1)
             null_env: ColumnEnv = {}
-            for key in aside.cols:
-                null_env[key] = env[key]
-            for j, key in enumerate(bside.cols):
-                null_env[key] = jnp.zeros(
-                    1, b["comb"][key].dtype
+            for env_key, tape_key in aside.cols:
+                null_env[env_key] = env[tape_key]
+            for env_key, _tk in bside.cols:
+                null_env[env_key] = jnp.zeros(
+                    1, b["comb"][env_key].dtype
                 )
             ncols = tuple(
                 jnp.broadcast_to(jnp.asarray(p(null_env)), (E,))
                 for p in self.proj_fns
             )
+            # missing-side marker: 1 = right side absent, 2 = left absent
+            missing = 1 if bside is self.right else 2
+            ncols = ncols + (jnp.full(E, missing, jnp.int32),)
             segs.append((unmatched, ts_i, ncols))
         return segs
 
@@ -228,21 +365,9 @@ def compile_join_query(
     inp = q.input
     assert isinstance(inp, ast.JoinInput)
     li, ri = inp.left, inp.right
-    if li.stream_id == ri.stream_id:
-        raise SiddhiQLError(
-            "self-joins (same stream on both sides) are not supported yet"
-        )
-
-    scopes = {
-        li.ref_name: (li.stream_id, schemas[li.stream_id]),
-        ri.ref_name: (ri.stream_id, schemas[ri.stream_id]),
-    }
-    for si in (li, ri):
-        if si.ref_name != si.stream_id:
-            scopes.setdefault(
-                si.stream_id, (si.stream_id, schemas[si.stream_id])
-            )
-    resolver = ExprResolver(scopes, default_scope=None)
+    # self-joins are supported: the resolver side-prefixes env keys so
+    # `from S as a join S as b on a.x == b.y` keeps the sides distinct
+    resolver = _JoinResolver(li, ri, schemas)
 
     def side_of(si: ast.StreamInput, outer: bool) -> _Side:
         sres = ExprResolver(
@@ -302,19 +427,6 @@ def compile_join_query(
             "group by / having on a join query is not supported yet"
         )
 
-    # which tape columns each side must buffer in its ring
-    refs: Dict[str, AttributeType] = {}
-    for item in items:
-        _referenced_keys(item.expr, resolver, refs)
-    if inp.on is not None:
-        _referenced_keys(inp.on, resolver, refs)
-    for key, atype in sorted(refs.items()):
-        sid = key.split(".", 1)[0]
-        for side in (left, right):
-            if side.stream_id == sid:
-                side.cols.append(key)
-                side.col_types.append(atype)
-
     on_fn = None
     if inp.on is not None:
         ce = compile_expr(inp.on, resolver, extensions)
@@ -324,10 +436,25 @@ def compile_join_query(
 
     proj_fns = []
     out_fields = []
+    proj_tags: List[frozenset] = []
     for item in items:
+        proj_tags.append(
+            frozenset(
+                resolver.used[resolver.resolve(a).key][0]
+                for a in ast.iter_attrs(item.expr)
+                if not a.name.startswith("@")
+            )
+        )
         ce = compile_expr(item.expr, resolver, extensions)
         proj_fns.append(ce.fn)
         out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
+
+    # which columns each side must buffer in its ring (side-prefixed
+    # env keys recorded by the resolver during on/projection compiles)
+    for env_key, (tag, tape_key, atype) in sorted(resolver.used.items()):
+        side = left if tag == "l" else right
+        side.cols.append((env_key, tape_key))
+        side.col_types.append(atype)
 
     art = JoinArtifact(
         name=name,
@@ -337,6 +464,7 @@ def compile_join_query(
         on_fn=on_fn,
         within=inp.within,
         proj_fns=proj_fns,
+        proj_tags=tuple(proj_tags),
     )
     art.encoded_columns = ()
     return art

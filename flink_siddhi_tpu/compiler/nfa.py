@@ -1292,7 +1292,10 @@ class SlotNFAArtifact:
         C = len(schema.fields)
         if not self._needs_mbits:
             return [(schema, schema.decode_packed_block(n, block))]
-        mbits = np.asarray(block[1 + C, :n])
+        # decode_buffered re-sorts rows by timestamp (stable); the mbits
+        # row must follow the SAME permutation
+        order = np.argsort(np.asarray(block[0, :n]), kind="stable")
+        mbits = np.asarray(block[1 + C, :n])[order]
         rows = schema.decode_packed_block(n, block[: 1 + C])
         deps = self.spec.proj_or_deps
         out = []

@@ -78,15 +78,25 @@ class CompiledPlan:
         return out
 
     def flush(self, states: Dict) -> Tuple[Dict, Dict]:
-        """End-of-stream flush (timeBatch final windows etc.)."""
+        """End-of-stream flush (timeBatch final windows etc.). Artifacts
+        writing to tables flush THROUGH the table state (windowed table
+        inserts land their final rows)."""
         new_states = dict(states)
         outputs = {}
+        tables = states.get("@tables", {})
         for a in self.artifacts:
+            flt = getattr(a, "flush_tables", None)
+            if flt is not None:
+                s, tables, _out = flt(states[a.name], tables)
+                new_states[a.name] = s
+                continue
             fl = getattr(a, "flush", None)
             if fl is not None:
                 s, out = fl(states[a.name])
                 new_states[a.name] = s
                 outputs[a.name] = out
+        if "@tables" in states:
+            new_states["@tables"] = tables
         return new_states, outputs
 
     # -- device-side output accumulation ------------------------------------

@@ -139,6 +139,27 @@ def _masked(tape, stream_code, filter_fns, enabled, env):
     return mask & enabled
 
 
+def _ring_append(tbl: Dict, table_id: str, keep, named_vals) -> Tuple[Dict, object]:
+    """Append ``keep``-masked rows to the table ring. If one batch
+    inserts more than C rows, only the newest C land (ring semantics);
+    clamping also keeps scatter indices unique, since XLA scatter order
+    for duplicates is unspecified. Returns (tbl, n_appended)."""
+    tbl = dict(tbl)
+    C = tbl["valid"].shape[0]
+    rank = jnp.cumsum(keep) - 1
+    M = keep.sum()
+    keep2 = keep & (rank >= M - C)
+    pos = jnp.where(keep2, (tbl["ptr"] + rank) % C, C)  # C -> dropped
+    for cname, vals in named_vals:
+        key = table_key(table_id, cname)
+        tbl[key] = tbl[key].at[pos].set(
+            vals.astype(tbl[key].dtype), mode="drop"
+        )
+    tbl["valid"] = tbl["valid"].at[pos].set(True, mode="drop")
+    tbl["ptr"] = (tbl["ptr"] + M) % C
+    return tbl, M
+
+
 @dataclass
 class TableInsertArtifact:
     """``from S select ... insert into T`` — appends projected rows."""
@@ -163,23 +184,14 @@ class TableInsertArtifact:
             tape, self.stream_code, self.filter_fns, state["enabled"], env
         )
         E = tape.capacity
-        tbl = dict(tables[self.table_id])
+        named = [
+            (cname, jnp.broadcast_to(jnp.asarray(p(env)), (E,)))
+            for cname, p in zip(self.col_names, self.proj_fns)
+        ]
+        tbl, M = _ring_append(
+            tables[self.table_id], self.table_id, mask, named
+        )
         C = tbl["valid"].shape[0]
-        rank = jnp.cumsum(mask) - 1
-        M = mask.sum()
-        # if one batch inserts more than C rows, only the newest C land
-        # (ring semantics); clamping also keeps scatter indices unique,
-        # since XLA scatter order for duplicates is unspecified
-        keep = mask & (rank >= M - C)
-        pos = jnp.where(keep, (tbl["ptr"] + rank) % C, C)  # C -> dropped
-        for cname, p in zip(self.col_names, self.proj_fns):
-            key = table_key(self.table_id, cname)
-            vals = jnp.broadcast_to(jnp.asarray(p(env)), (E,))
-            tbl[key] = tbl[key].at[pos].set(
-                vals.astype(tbl[key].dtype), mode="drop"
-            )
-        tbl["valid"] = tbl["valid"].at[pos].set(True, mode="drop")
-        tbl["ptr"] = (tbl["ptr"] + M) % C
         new_state = dict(state)
         new_state["overflow"] = state["overflow"] + jnp.maximum(M - C, 0)
         state = new_state
@@ -192,6 +204,92 @@ class TableInsertArtifact:
                   for f in self.output_schema.fields),
         )
         return state, new_tables, empty
+
+
+@dataclass
+class WindowedTableInsertArtifact:
+    """``from S#window... select <aggs> ... insert into T``: a full window
+    /aggregation artifact whose emitted rows append to the table ring
+    instead of an output stream (the reference's siddhi-core allows
+    windows and aggregations in table inserts; SURVEY.md §2.10)."""
+
+    name: str
+    output_schema: OutputSchema  # degenerate: no stream output
+    table_id: str
+    col_names: List[str]
+    inner: object  # compiled window/aggregation artifact
+    uses_tables: bool = True
+    output_mode: str = "buffered"
+
+    @property
+    def encoded_columns(self):
+        # group-by keys still need host interning
+        return getattr(self.inner, "encoded_columns", ())
+
+    def init_state(self) -> Dict:
+        return {
+            "win": self.inner.init_state(),
+            "overflow": jnp.asarray(0, jnp.int32),
+        }
+
+    def grow_state(self, state: Dict) -> Dict:
+        g = getattr(self.inner, "grow_state", None)
+        if g is None:
+            return state
+        out = dict(state)
+        out["win"] = g(state["win"])
+        return out
+
+    def _empty(self):
+        return (
+            jnp.asarray(0, jnp.int32),
+            jnp.zeros(1, jnp.int32),
+            (),
+        )
+
+    def _apply(self, out, tables):
+        if self.inner.output_mode == "aligned":
+            mask, _ts, cols = out
+            keep = mask
+            L = mask.shape[0]
+        else:  # buffered
+            nrows, ts, cols = out
+            L = ts.shape[0]
+            keep = jnp.arange(L) < nrows
+        named = [
+            (cname, jnp.broadcast_to(jnp.asarray(vals), (L,)))
+            for cname, vals in zip(self.col_names, cols)
+        ]
+        tbl, M = _ring_append(
+            tables[self.table_id], self.table_id, keep, named
+        )
+        new_tables = dict(tables)
+        new_tables[self.table_id] = tbl
+        over = jnp.maximum(M - tbl["valid"].shape[0], 0)
+        return new_tables, over
+
+    def step_tables(self, state, tables, tape):
+        wst, out = self.inner.step(state["win"], tape)
+        new_tables, over = self._apply(out, tables)
+        new_state = {
+            "win": wst,
+            "overflow": state["overflow"] + over,
+        }
+        return new_state, new_tables, self._empty()
+
+    def flush_tables(self, state, tables):
+        """End-of-stream: the inner window's final flush rows (timeBatch
+        carry-out) still land in the table."""
+        fl = getattr(self.inner, "flush", None)
+        if fl is None:
+            return state, tables, self._empty()
+        wst, out = fl(state["win"])
+        new_tables, over = self._apply(out, tables)
+        new_state = {
+            "win": wst,
+            "overflow": state["overflow"] + over,
+        }
+        return new_state, new_tables, self._empty()
 
 
 @dataclass
@@ -352,6 +450,37 @@ def compile_table_write(
 ):
     tid = q.output_stream
     tschema = table_schemas[tid]
+    inp0 = q.input
+    if (
+        q.output_action == "insert"
+        and isinstance(inp0, ast.StreamInput)
+        and (
+            inp0.windows
+            or q.selector.group_by
+            or any(
+                ast.contains_aggregate(i.expr) for i in q.selector.items
+            )
+        )
+    ):
+        # windowed / aggregated insert: compile the full window artifact
+        # and redirect its emissions into the table ring
+        from .window import compile_window_query
+
+        inner = compile_window_query(
+            q, f"{name}@win", schemas, stream_codes, extensions
+        )
+        for f in inner.output_schema.fields:
+            if f.name not in tschema:
+                raise SiddhiQLError(
+                    f"table {tid!r} has no column {f.name!r}"
+                )
+        return WindowedTableInsertArtifact(
+            name=name,
+            output_schema=OutputSchema(f"@void:{name}", ()),
+            table_id=tid,
+            col_names=[f.name for f in inner.output_schema.fields],
+            inner=inner,
+        )
     inp, resolver, filter_fns, proj = _stream_front(
         q, schemas, stream_codes, extensions
     )
