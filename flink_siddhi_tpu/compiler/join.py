@@ -169,10 +169,10 @@ class JoinArtifact:
         C = len(schema.fields)
         if not self._nullable:
             return [(schema, schema.decode_packed_block(n, block))]
-        # decode_buffered re-sorts rows by timestamp (stable); the
-        # missing-side row must follow the SAME permutation
-        order = np.argsort(np.asarray(block[0, :n]), kind="stable")
-        missing = np.asarray(block[1 + C, :n])[order]
+        from .output import emission_order
+
+        # the missing-side row must follow decode's row permutation
+        missing = np.asarray(block[1 + C, :n])[emission_order(block[0], n)]
         rows = schema.decode_packed_block(n, block[: 1 + C])
         out = []
         for i, (ts_v, row) in enumerate(rows):

@@ -1215,6 +1215,309 @@ class StackedChainArtifact:
         return new_state, (n_total, packed, jnp.asarray(0, jnp.int32))
 
 
+# --------------------------------------------------------------------------
+# Engine 1c: dynamic (parametric) chain group — runtime query add/remove as
+# a DATA update, not an XLA recompile (SURVEY.md §7 hard part 4). The group
+# pre-allocates padded query slots; a structurally-identical chain query
+# (same shape, per-element `attr == literal` filters over the same
+# attributes) folds into a free slot by writing its literals/within into
+# per-slot device arrays. Reference analog: the add path of
+# AbstractSiddhiOperator.onEventReceived (:416-424), which pays a full
+# SiddhiQL compile per add.
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ChainTemplate:
+    """The static shape shared by all members of a dynamic chain group.
+    Everything here is traced into the compiled program; everything NOT
+    here (filter literals, within values, enable flags) is state."""
+
+    K: int
+    every: bool
+    has_within: bool
+    stream_ids: Tuple[str, ...]  # per element
+    filter_keys: Tuple[Optional[str], ...]  # tape col key or None
+    pairs: Tuple[Tuple[int, str], ...]
+    cap_dtypes: Tuple[str, ...]
+    proj_srcs: Tuple[Tuple[int, str], ...]
+
+
+def chain_template_of(
+    artifact, column_types: Optional[Dict] = None
+) -> Optional[Tuple["ChainTemplate", List, int]]:
+    """(template, per-element literal params, within_ms) when the chain
+    fits the parametric family, else None. With ``column_types``, a
+    literal that does not losslessly convert to its column's device type
+    rejects the template (a truncated param would match DIFFERENT events
+    than the statically-compiled query, which promotes to a common type)."""
+    if not isinstance(artifact, ChainPatternArtifact):
+        return None
+    spec = artifact.spec
+    if spec.kind != "pattern" or spec.has_cross:
+        return None
+    if any(len(g) > 1 for g in spec.groups):
+        return None
+    if any(
+        el.negated or (el.min_count, el.max_count) != (1, 1)
+        for el in spec.elements
+    ):
+        return None
+    if not spec.proj_srcs or any(s is None for s in spec.proj_srcs):
+        return None
+    filter_keys: List[Optional[str]] = []
+    params: List = []
+    for el in spec.elements:
+        if el.filter is None:
+            filter_keys.append(None)
+            params.append(None)
+            continue
+        f = el.filter
+        if not (
+            isinstance(f, ast.Binary)
+            and f.op == "=="
+            and isinstance(f.left, ast.Attr)
+            and f.left.qualifier in (None, el.alias, el.stream_id)
+            and f.left.index is None
+            and isinstance(f.right, ast.Literal)
+        ):
+            return None
+        key = f"{el.stream_id}.{f.left.name}"
+        val = f.right.value
+        if column_types is not None:
+            atype = column_types.get(key)
+            if atype is None:
+                return None
+            if (
+                np.dtype(atype.device_dtype).kind in "iu"
+                and isinstance(val, float)
+                and not float(val).is_integer()
+            ):
+                return None  # int column vs 5.5: never equal statically
+        filter_keys.append(key)
+        params.append(val)
+    pairs = tuple(_cap_pairs(spec))
+    return (
+        ChainTemplate(
+            K=spec.n_elements,
+            every=spec.every,
+            has_within=spec.within is not None,
+            stream_ids=tuple(el.stream_id for el in spec.elements),
+            filter_keys=tuple(filter_keys),
+            pairs=pairs,
+            cap_dtypes=tuple(
+                np.dtype(spec.cap_dtype[p]).name for p in pairs
+            ),
+            proj_srcs=tuple(spec.proj_srcs),
+        ),
+        params,
+        spec.within or 0,
+    )
+
+
+DYN_QUERY_SLOTS = 8  # pre-padded slots per dynamic chain group
+
+
+@dataclass
+class DynamicChainGroup:
+    """Padded parametric chain group: up to ``capacity`` structurally-
+    identical chain queries advanced by ONE vmapped program; per-query
+    predicates are `tape_col == param[q]` with params in device state,
+    so add/update/remove/enable are data writes."""
+
+    name: str
+    template: ChainTemplate
+    stream_code_of: Tuple[int, ...]  # codes in the HOST plan's spec
+    column_types: Dict[str, object]  # tape col key -> AttributeType
+    members: List  # per slot: None | (plan_id, OutputSchema)
+    pool: int = DEFAULT_PARTIAL_POOL
+    capacity: int = DYN_QUERY_SLOTS
+    output_mode: str = "packed"
+    out_cap_factor: int = 8
+
+    @property
+    def output_schema(self) -> OutputSchema:
+        for m in self.members:
+            if m is not None:
+                return m[1]
+        raise RuntimeError("dynamic chain group has no members")
+
+    @property
+    def acc_rows(self) -> int:
+        return 2 + len(self.template.proj_srcs)  # ts + qid + columns
+
+    def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
+        q = self.capacity
+        return (
+            min(q, self.out_cap_factor) * tape_capacity + q * self.pool
+        )
+
+    def _param_dtype(self, k: int):
+        key = self.template.filter_keys[k]
+        return self.column_types[key].device_dtype
+
+    def init_state(self) -> Dict:
+        Qc, P = self.capacity, self.pool
+        st = {
+            "enabled": jnp.zeros(Qc, dtype=bool),
+            "active": jnp.zeros((Qc, P), dtype=bool),
+            "step": jnp.ones((Qc, P), dtype=jnp.int32),
+            "start": jnp.zeros((Qc, P), dtype=jnp.int32),
+            "done": jnp.zeros(Qc, dtype=bool),
+            "overflow": jnp.zeros(Qc, dtype=jnp.int32),
+        }
+        if self.template.has_within:
+            st["within"] = jnp.zeros(Qc, dtype=jnp.int32)
+        for k, key in enumerate(self.template.filter_keys):
+            if key is not None:
+                st[f"param{k}"] = jnp.zeros(
+                    Qc, dtype=self._param_dtype(k)
+                )
+        for pair, dt in zip(self.template.pairs, self.template.cap_dtypes):
+            st[_skey("cap", *pair)] = jnp.zeros((Qc, P), dtype=np.dtype(dt))
+        return st
+
+    # -- host-side slot management (applied to rt.states by the Job) ----
+    def free_slot(self) -> Optional[int]:
+        for s, m in enumerate(self.members):
+            if m is None:
+                return s
+        return None
+
+    def admit(self, state: Dict, slot: int, plan_id: str, schema,
+              params: List, within_ms: int, string_tables) -> Dict:
+        """Write one query into ``slot`` — pure data updates."""
+        self.members[slot] = (plan_id, schema)
+        st = dict(state)
+        st["enabled"] = state["enabled"].at[slot].set(True)
+        st["done"] = st["done"].at[slot].set(False)
+        st["active"] = st["active"].at[slot].set(False)
+        st["overflow"] = st["overflow"].at[slot].set(0)
+        if self.template.has_within:
+            st["within"] = st["within"].at[slot].set(within_ms)
+        for k, (key, val) in enumerate(
+            zip(self.template.filter_keys, params)
+        ):
+            if key is None:
+                continue
+            atype = self.column_types[key]
+            if atype == AttributeType.STRING:
+                val = string_tables[key].intern(val)
+            st[f"param{k}"] = st[f"param{k}"].at[slot].set(val)
+        return st
+
+    def evict(self, state: Dict, slot: int) -> Dict:
+        self.members[slot] = None
+        st = dict(state)
+        st["enabled"] = state["enabled"].at[slot].set(False)
+        st["active"] = st["active"].at[slot].set(False)
+        return st
+
+    def set_enabled(self, state: Dict, slot: int, on: bool) -> Dict:
+        st = dict(state)
+        st["enabled"] = state["enabled"].at[slot].set(on)
+        return st
+
+    # -- device step ----------------------------------------------------
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        t = self.template
+        Qc, P, K = self.capacity, self.pool, t.K
+        E = tape.capacity
+        V = P + E
+
+        rows = []
+        for k in range(K):
+            base = tape.valid & (tape.stream == self.stream_code_of[k])
+            key = t.filter_keys[k]
+            if key is not None:
+                col = tape.cols[key]
+                pk = state[f"param{k}"]
+                row = base[None, :] & (col[None, :] == pk[:, None])
+            else:
+                row = jnp.broadcast_to(base, (Qc, E))
+            rows.append(row & state["enabled"][:, None])
+        preds = jnp.stack(rows, axis=1)  # (Qc, K, E)
+
+        cap_srcs = {
+            pair: jnp.broadcast_to(
+                tape.cols[f"{t.stream_ids[pair[0]]}.{pair[1]}"], (Qc, E)
+            )
+            for pair in t.pairs
+        }
+        within_vec = (
+            state["within"]
+            if t.has_within
+            else jnp.zeros(Qc, dtype=jnp.int32)
+        )
+        cfg = _ChainCfg(
+            K=K,
+            every=t.every,
+            has_within=t.has_within,
+            pairs=t.pairs,
+            cap_dtypes=t.cap_dtypes,
+            positive=tuple(range(K)),
+            guards=((),) * K,
+        )
+        core_keys = [
+            "enabled", "active", "step", "start", "done", "overflow"
+        ] + [_skey("cap", *p) for p in t.pairs]
+        core_state = {k: state[k] for k in core_keys}
+
+        new_core, complete, emit_ts, caps = self._vmapped(
+            cfg, P, core_state, preds, cap_srcs, within_vec, tape
+        )
+
+        new_state = dict(state)
+        new_state.update(new_core)
+
+        # uniform emission: qid row + stacked capture buffers
+        qid_row = jnp.broadcast_to(
+            jnp.arange(Qc, dtype=jnp.int32)[:, None], (Qc, V)
+        )
+        stacked_rows = [_as_i32(emit_ts), qid_row] + [
+            _as_i32(caps[pair]) for pair in t.proj_srcs
+        ]
+        flat_rows = jnp.stack([r.reshape(Qc * V) for r in stacked_rows])
+        R = len(stacked_rows)
+        flags = complete.reshape(Qc * V)
+        out_w = min(Qc, self.out_cap_factor) * E + Qc * P
+        n_total = flags.sum().astype(jnp.int32)
+        posn = jnp.cumsum(flags.astype(jnp.int32)) - 1
+        dest = jnp.where(flags & (posn < out_w), posn, out_w)
+        packed = (
+            jnp.zeros((R, out_w), dtype=jnp.int32)
+            .at[:, dest]
+            .set(flat_rows, mode="drop")
+        )
+        n_emitted = jnp.minimum(n_total, jnp.int32(out_w))
+        return new_state, (n_emitted, packed, n_total - n_emitted)
+
+    def _vmapped(self, cfg, P, core_state, preds, cap_srcs, within_vec,
+                 tape):
+        return jax.vmap(
+            lambda st, pr, cs, wv: _chain_core(
+                cfg, P, st, pr, cs, wv, tape.ts, tape.valid
+            )
+        )(core_state, preds, cap_srcs, within_vec)
+
+    def decode_packed(self, n: int, block: np.ndarray):
+        """Split the packed block by query slot -> member streams."""
+        out = []
+        qid = block[1, :n]
+        for s, m in enumerate(self.members):
+            if m is None:
+                continue
+            sel = np.nonzero(qid == s)[0]
+            if sel.size == 0:
+                continue
+            schema = m[1]
+            sub = block[:, :n][:, sel]
+            rows = schema.decode_packed_block(
+                int(sel.size), sub, data_row=2
+            )
+            out.append((schema, rows))
+        return out
+
+
 def group_chain_artifacts(artifacts: List) -> List:
     """Replace runs of structurally-identical ChainPatternArtifacts with
     one StackedChainArtifact (multi-query parallelism)."""
@@ -1292,10 +1595,10 @@ class SlotNFAArtifact:
         C = len(schema.fields)
         if not self._needs_mbits:
             return [(schema, schema.decode_packed_block(n, block))]
-        # decode_buffered re-sorts rows by timestamp (stable); the mbits
-        # row must follow the SAME permutation
-        order = np.argsort(np.asarray(block[0, :n]), kind="stable")
-        mbits = np.asarray(block[1 + C, :n])[order]
+        from .output import emission_order
+
+        # the mbits row must follow decode's row permutation
+        mbits = np.asarray(block[1 + C, :n])[emission_order(block[0], n)]
         rows = schema.decode_packed_block(n, block[: 1 + C])
         deps = self.spec.proj_or_deps
         out = []

@@ -110,7 +110,7 @@ class OutputSchema:
         ts_arr = np.asarray(ts)[:n]
         # buffers are compacted on device in slot order, not time order;
         # restore by-timestamp emission order here (n is small)
-        order = np.argsort(ts_arr, kind="stable")
+        order = emission_order(ts_arr, n)
         ts_list = ts_arr[order].astype(np.int64).tolist()
         col_lists = [
             f.decode_column(np.asarray(c)[:n][order])
@@ -118,3 +118,14 @@ class OutputSchema:
         ]
         rows = zip(*col_lists) if col_lists else ((),) * n
         return list(zip(ts_list, map(tuple, rows)))
+
+
+def emission_order(ts, n: int):
+    """THE permutation buffered/packed decode applies to emitted rows
+    (stable by-timestamp sort). Artifacts that ship side-channel rows
+    alongside the packed block (slot-NFA mbits, join missing-side
+    markers) MUST reorder them with this same helper, or the side rows
+    desync from their data rows."""
+    import numpy as _np
+
+    return _np.argsort(_np.asarray(ts)[:n], kind="stable")

@@ -115,8 +115,12 @@ class CompiledPlan:
         row = 0
         for a in self.artifacts:
             # default: ts + columns; stacked artifacts add a query-id row
-            n_rows = getattr(
-                a, "acc_rows", 1 + len(a.output_schema.fields)
+            # (getattr's default would evaluate output_schema eagerly,
+            # which dynamic groups can't do before their first member)
+            n_rows = (
+                a.acc_rows
+                if hasattr(a, "acc_rows")
+                else 1 + len(a.output_schema.fields)
             )
             out.append((row, n_rows))
             row += n_rows
@@ -263,11 +267,16 @@ class CompiledPlan:
         contributes every member's schema)."""
         by_stream: Dict[str, List] = {}
         for a in self.artifacts:
-            schemas = (
-                [m.output_schema for m in a.members]
-                if hasattr(a, "members")
-                else [a.output_schema]
-            )
+            if hasattr(a, "members"):
+                # stacked groups hold artifacts; dynamic groups hold
+                # (plan_id, schema) tuples with None for free slots
+                schemas = [
+                    m.output_schema if hasattr(m, "output_schema") else m[1]
+                    for m in a.members
+                    if m is not None
+                ]
+            else:
+                schemas = [a.output_schema]
             for sch in schemas:
                 by_stream.setdefault(sch.stream_id, []).append(sch)
         return by_stream

@@ -127,7 +127,10 @@ class ShardedJob(Job):
         super().__init__(plans, sources, **kwargs)
 
     # -- plan management -----------------------------------------------------
-    def add_plan(self, plan: CompiledPlan) -> None:
+    def add_plan(self, plan: CompiledPlan, dynamic: bool = False) -> None:
+        # dynamic-group folding is a single-device optimization; sharded
+        # adds keep one runtime per plan (dynamic flag accepted for API
+        # parity)
         stacked = _tree_stack([plan.init_state()] * self.n_shards)
         stacked = jax.device_put(stacked, self._state_sharding)
         init_acc = jax.jit(

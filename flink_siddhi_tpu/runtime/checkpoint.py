@@ -95,6 +95,13 @@ def snapshot_job(job) -> Dict[str, Any]:
         "control_pending": list(job._control_pending),
         "sources": sources,
         "routers": routers,
+        # dynamically-added queries (control plane): CQL + group slot map
+        # so restore can replay them into identical runtimes/slots
+        "dynamic": {
+            "cql": dict(getattr(job, "_dynamic_cql", {})),
+            "folded": dict(getattr(job, "_folded", {})),
+            "enabled": dict(getattr(job, "_folded_enabled", {})),
+        },
     }
 
 
@@ -112,6 +119,20 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
         )
     job._epoch_ms = snap["epoch_ms"]
     job.processed_events = snap["processed_events"]
+
+    # dynamically-added queries: replay them (same runtimes, same group
+    # slots) BEFORE the plan-set compatibility check below
+    dyn = snap.get("dynamic") or {}
+    if dyn.get("cql"):
+        if job._plan_compiler is None:
+            raise ValueError(
+                "checkpoint contains dynamically-added queries but the "
+                "job has no plan compiler; rebuild it through the "
+                "dynamic cql() path"
+            )
+        job._replay_dynamic(
+            dyn["cql"], dyn.get("folded", {}), dyn.get("enabled", {})
+        )
 
     # 1. shared string dictionary (identity-preserving, every schema of the
     # environment references the same object)

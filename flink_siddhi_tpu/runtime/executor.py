@@ -85,6 +85,13 @@ class Job:
         self._pending: Dict[str, List[EventBatch]] = {}
         self._epoch_ms: Optional[int] = None
         self._plans: Dict[str, _PlanRuntime] = {}
+        # dynamic chain groups: user plan_id -> (host runtime id, slot).
+        # A structurally-identical chain query folds into a pre-padded
+        # group slot as a DATA update — no XLA recompile (SURVEY.md §7
+        # hard part 4)
+        self._folded: Dict[str, Tuple[str, int]] = {}
+        self._folded_enabled: Dict[str, bool] = {}  # host-side mirror
+        self._dynamic_cql: Dict[str, str] = {}  # for checkpoint replay
         for p in plans:
             self.add_plan(p)
         # output_stream -> list[(ts, row_tuple)] and field names
@@ -116,16 +123,34 @@ class Job:
     # Parity: AbstractSiddhiOperator.onEventReceived (:399-467) — add/update/
     # remove QueryRuntimeHandlers, enable/disable gating — applied here at
     # micro-batch boundaries.
-    def add_plan(self, plan: CompiledPlan) -> None:
+    def add_plan(self, plan: CompiledPlan, dynamic: bool = False) -> None:
+        """``dynamic=True`` (the control-plane add path): template-able
+        chain plans fold into / become padded dynamic groups so repeat
+        adds are data updates. Static plans keep the single-query fast
+        path (pallas chain core, no query axis)."""
+        admit0 = None
+        if dynamic:
+            if plan.plan_id in self._folded or plan.plan_id in self._plans:
+                # re-add of a live id (e.g. an at-least-once control
+                # channel redelivering): replace, never double-register
+                self.remove_plan(plan.plan_id)
+            if self._try_fold(plan):
+                return  # data update into an existing group slot
+            plan, admit0 = self._wrap_dynamic(plan)
+        self._create_runtime(plan, admit0)
+
+    def _create_runtime(self, plan: CompiledPlan, admit0=None) -> None:
         from ..compiler import pallas_ops
 
         pallas_ops.warmup()  # probe TPU kernels outside any trace
         init_acc = jax.jit(plan.init_acc)
+        traces = {"n": 0}
 
         def step_wire(states, acc, wire):
+            traces["n"] += 1  # python body runs only while TRACING
             return plan.step_acc(states, acc, wire.expand())
 
-        self._plans[plan.plan_id] = _PlanRuntime(
+        rt = _PlanRuntime(
             plan=plan,
             states=plan.init_state(),
             jitted=jax.jit(plan.step),
@@ -138,22 +163,201 @@ class Job:
             acc=init_acc(),
             wire_kinds={},
         )
+        rt.traces = traces
+        if admit0 is not None:
+            rt.states = admit0(rt.states)
+        self._plans[plan.plan_id] = rt
+
+    # -- dynamic chain groups (recompile-free runtime adds) -----------------
+    def _group_string_tables(self, plan, tpl) -> Dict:
+        out = {}
+        for key in tpl.filter_keys:
+            if key is None:
+                continue
+            sid, fname = key.split(".", 1)
+            out[key] = plan.schemas[sid].string_tables.get(fname)
+        return out
+
+    def _fold_into(self, host_id: str, plan: CompiledPlan, slot: int) -> None:
+        from ..compiler.nfa import chain_template_of
+
+        rt = self._plans[host_id]
+        group = rt.plan.artifacts[0]
+        tpl, params, within = chain_template_of(
+            plan.artifacts[0], plan.spec.column_types
+        )
+        states = dict(rt.states)
+        states[group.name] = group.admit(
+            states[group.name], slot, plan.plan_id,
+            plan.artifacts[0].output_schema, params, within,
+            self._group_string_tables(rt.plan, tpl),
+        )
+        rt.states = states
+        self._folded[plan.plan_id] = (host_id, slot)
+        self._folded_enabled[plan.plan_id] = True
+
+    def _try_fold(self, plan: CompiledPlan) -> bool:
+        from ..compiler.nfa import DynamicChainGroup, chain_template_of
+
+        if len(plan.artifacts) != 1:
+            return False
+        t = chain_template_of(plan.artifacts[0], plan.spec.column_types)
+        if t is None:
+            return False
+        tpl = t[0]
+        for host_id, rt in self._plans.items():
+            arts = rt.plan.artifacts
+            if not (
+                len(arts) == 1
+                and isinstance(arts[0], DynamicChainGroup)
+                and arts[0].template == tpl
+            ):
+                continue
+            slot = arts[0].free_slot()
+            if slot is None:
+                continue
+            self._fold_into(host_id, plan, slot)
+            return True
+        return False
+
+    def _wrap_dynamic(
+        self, plan: CompiledPlan, host_id: Optional[str] = None,
+        slot: int = 0,
+    ):
+        """Single template-able chain plans become a padded dynamic group
+        (so the NEXT structurally-identical add is a data update)."""
+        import dataclasses
+
+        from ..compiler.nfa import (
+            DYN_QUERY_SLOTS,
+            DynamicChainGroup,
+            chain_template_of,
+        )
+
+        if len(plan.artifacts) != 1:
+            return plan, None
+        t = chain_template_of(plan.artifacts[0], plan.spec.column_types)
+        if t is None:
+            return plan, None
+        tpl, params, within = t
+        art = plan.artifacts[0]
+        host_id = host_id or f"@dyn:{plan.plan_id}"
+        if host_id in self._plans:  # paranoid: id collision
+            return plan, None
+        group = DynamicChainGroup(
+            name=art.name,
+            template=tpl,
+            stream_code_of=tuple(
+                plan.spec.stream_codes[sid] for sid in tpl.stream_ids
+            ),
+            column_types=dict(plan.spec.column_types),
+            members=[None] * DYN_QUERY_SLOTS,
+            pool=art.pool,
+        )
+        new_plan = dataclasses.replace(
+            plan, plan_id=host_id, artifacts=[group]
+        )
+        tables = self._group_string_tables(plan, tpl)
+
+        def admit0(states):
+            states = dict(states)
+            states[group.name] = group.admit(
+                states[group.name], slot, plan.plan_id,
+                art.output_schema, params, within, tables,
+            )
+            return states
+
+        self._folded[plan.plan_id] = (host_id, slot)
+        self._folded_enabled[plan.plan_id] = True
+        return new_plan, admit0
+
+    def _replay_dynamic(
+        self,
+        dynamic_cql: Dict[str, str],
+        folded: Dict[str, Tuple[str, int]],
+        enabled: Dict[str, bool],
+    ) -> None:
+        """Checkpoint-restore replay: re-add dynamically-added queries so
+        runtimes, groups, and SLOT assignments match the snapshot exactly
+        (state restore then overlays params and partial-match pools)."""
+        by_host: Dict[str, List[Tuple[int, str]]] = {}
+        for pid, (host_id, slot) in folded.items():
+            by_host.setdefault(host_id, []).append((slot, pid))
+        for host_id, members in sorted(by_host.items()):
+            members.sort()
+            first = True
+            for slot, pid in members:
+                cql = dynamic_cql.get(pid)
+                if cql is None:
+                    _LOG.warning(
+                        "dynamic plan %r has no recorded CQL; it cannot "
+                        "be restored", pid,
+                    )
+                    continue
+                plan = self._plan_compiler(cql, pid)
+                if first:
+                    wrapped, admit0 = self._wrap_dynamic(
+                        plan, host_id=host_id, slot=slot
+                    )
+                    self._create_runtime(wrapped, admit0)
+                    first = False
+                else:
+                    self._fold_into(host_id, plan, slot)
+        for pid, cql in dynamic_cql.items():
+            if pid not in folded and pid not in self._plans:
+                self.add_plan(self._plan_compiler(cql, pid))
+        for pid, on in enabled.items():
+            if not on:
+                self.set_plan_enabled(pid, False)
+        self._dynamic_cql.update(dynamic_cql)
 
     def remove_plan(self, plan_id: str) -> None:
+        folded = self._folded.pop(plan_id, None)
+        self._folded_enabled.pop(plan_id, None)
+        self._dynamic_cql.pop(plan_id, None)
+        if folded is not None:
+            host_id, slot = folded
+            rt = self._plans.get(host_id)
+            if rt is None:
+                return
+            self._drain_plan(rt)  # don't lose already-produced matches
+            group = rt.plan.artifacts[0]
+            states = dict(rt.states)
+            states[group.name] = group.evict(states[group.name], slot)
+            rt.states = states
+            if all(m is None for m in group.members):
+                self._plans.pop(host_id, None)
+                self._drain_hints.pop(host_id, None)
+            return
         rt = self._plans.get(plan_id)
         if rt is not None:
-            self._drain_plan(rt)  # don't lose already-produced matches
+            self._drain_plan(rt)
         self._plans.pop(plan_id, None)
         self._drain_hints.pop(plan_id, None)
 
     def set_plan_enabled(self, plan_id: str, enabled: bool) -> None:
+        folded = self._folded.get(plan_id)
+        if folded is not None:
+            self._folded_enabled[plan_id] = enabled
+            host_id, slot = folded
+            rt = self._plans.get(host_id)
+            if rt is not None:
+                group = rt.plan.artifacts[0]
+                states = dict(rt.states)
+                states[group.name] = group.set_enabled(
+                    states[group.name], slot, enabled
+                )
+                rt.states = states
+            return
         rt = self._plans.get(plan_id)
         if rt is not None:
             rt.enabled = enabled
 
     @property
     def plan_ids(self) -> List[str]:
-        return list(self._plans)
+        return [
+            pid for pid in self._plans if not pid.startswith("@dyn:")
+        ] + list(self._folded)
 
     def _apply_control(self, ev) -> None:
         from ..control.events import (
@@ -170,10 +374,16 @@ class Job:
                     "compiler (create it through the dynamic cql() path)"
                 )
             for plan_id, cql in ev.added_plans.items():
-                self.add_plan(self._plan_compiler(cql, plan_id))
+                self.add_plan(
+                    self._plan_compiler(cql, plan_id), dynamic=True
+                )
+                self._dynamic_cql[plan_id] = cql
             for plan_id, cql in ev.updated_plans.items():
                 self.remove_plan(plan_id)
-                self.add_plan(self._plan_compiler(cql, plan_id))
+                self.add_plan(
+                    self._plan_compiler(cql, plan_id), dynamic=True
+                )
+                self._dynamic_cql[plan_id] = cql
             for plan_id in ev.deleted_plan_ids:
                 self.remove_plan(plan_id)
         elif isinstance(ev, OperationControlEvent):
@@ -585,8 +795,15 @@ class Job:
             # list() snapshots below: the run-loop thread mutates these
             # dicts concurrently with off-thread metrics readers
             "plans": {
-                pid: {"enabled": rt.enabled}
-                for pid, rt in list(self._plans.items())
+                **{
+                    pid: {"enabled": rt.enabled}
+                    for pid, rt in list(self._plans.items())
+                    if not pid.startswith("@dyn:")
+                },
+                **{
+                    pid: {"enabled": on}
+                    for pid, on in list(self._folded_enabled.items())
+                },
             },
             "emitted": dict(self.emitted_counts),
             "pending_batches": sum(

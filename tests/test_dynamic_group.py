@@ -1,0 +1,268 @@
+"""Dynamic chain groups: runtime query add/remove as a DATA update.
+
+VERDICT round-1 #8 / SURVEY.md §7 hard part 4: adding a structurally-
+identical pattern query through the control plane must NOT stall the
+stream on an XLA recompile — the group pre-pads query slots and an add
+writes filter literals / within values into device state.
+
+Reference analog: AbstractSiddhiOperator.onEventReceived add path
+(:416-424), which pays a full SiddhiQL compile per add.
+"""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.control.events import (
+    MetadataControlEvent,
+    OperationControlEvent,
+)
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.app.service import ControlQueueSource
+from flink_siddhi_tpu.runtime.sources import CallbackSource
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+SCHEMA = StreamSchema(
+    [
+        ("id", AttributeType.INT),
+        ("price", AttributeType.DOUBLE),
+        ("timestamp", AttributeType.LONG),
+    ]
+)
+
+
+class Rec:
+    def __init__(self, id, price, timestamp):
+        self.id, self.price, self.timestamp = id, price, timestamp
+
+
+def make_job(src):
+    return Job(
+        [], [src], batch_size=64, time_mode="processing",
+        plan_compiler=lambda cql, pid: compile_plan(
+            cql, {"S": SCHEMA}, plan_id=pid
+        ),
+    )
+
+
+def chain_cql(pid, a, b):
+    return (
+        f"from every s1 = S[id == {a}] -> s2 = S[id == {b}] "
+        f"select s1.timestamp as t1, s2.timestamp as t2 "
+        f"insert into out_{pid}"
+    )
+
+
+def test_second_add_is_a_data_update_no_retrace():
+    src = CallbackSource("S", SCHEMA)
+    job = make_job(src)
+    job.add_plan(
+        compile_plan(chain_cql("q1", 1, 2), {"S": SCHEMA}, plan_id="q1"),
+        dynamic=True,
+    )
+    for i in range(8):
+        src.emit(Rec(i % 4, float(i), 1000 + i), 1000 + i)
+    job.run_cycle()
+    (rt,) = job._plans.values()
+    traces_after_first = rt.traces["n"]
+    assert traces_after_first >= 1
+    assert job.results("out_q1") == [(1001, 1002), (1005, 1006)]
+
+    # second, structurally-identical add: folds into a spare slot
+    job.add_plan(
+        compile_plan(chain_cql("q2", 2, 3), {"S": SCHEMA}, plan_id="q2"),
+        dynamic=True,
+    )
+    assert len(job._plans) == 1  # no new runtime
+    assert set(job.plan_ids) == {"q1", "q2"}
+    for i in range(8, 16):
+        src.emit(Rec(i % 4, float(i), 1000 + i), 1000 + i)
+    job.run_cycle()
+    # THE criterion: stepping with both queries live retraced nothing
+    assert rt.traces["n"] == traces_after_first
+    assert job.results("out_q2") == [(1010, 1011), (1014, 1015)]
+    assert len(job.results("out_q1")) == 4
+
+    # disable / remove are slot updates on the same runtime
+    n1 = len(job.results("out_q1"))
+    job.set_plan_enabled("q1", False)
+    for i in range(16, 24):
+        src.emit(Rec(i % 4, float(i), 1000 + i), 1000 + i)
+    job.run_cycle()
+    assert len(job.results("out_q1")) == n1
+    assert rt.traces["n"] == traces_after_first
+    job.remove_plan("q2")
+    assert job.plan_ids == ["q1"]
+    job.remove_plan("q1")
+    assert job.plan_ids == [] and not job._plans
+
+
+def test_dynamic_adds_via_control_events():
+    src = CallbackSource("S", SCHEMA)
+    control = ControlQueueSource()
+    job = Job(
+        [], [src], batch_size=64, time_mode="processing",
+        control_sources=[control],
+        plan_compiler=lambda cql, pid: compile_plan(
+            cql, {"S": SCHEMA}, plan_id=pid
+        ),
+    )
+    b = MetadataControlEvent.builder()
+    pid_a = b.add_execution_plan(chain_cql("a", 1, 2))
+    control.push(b.build())
+    for i in range(8):
+        src.emit(Rec(i % 4, float(i), 1000 + i), 1000 + i)
+    job.run_cycle()
+    (rt,) = job._plans.values()
+    t0 = rt.traces["n"]
+    b2 = MetadataControlEvent.builder()
+    b2.add_execution_plan(chain_cql("b", 3, 1))
+    control.push(b2.build())
+    for i in range(8, 16):
+        src.emit(Rec(i % 4, float(i), 1000 + i), 1000 + i)
+    job.run_cycle()
+    assert rt.traces["n"] == t0
+    assert len(job.results("out_b")) > 0
+    # pause via OperationControlEvent routes to the slot
+    control.push(OperationControlEvent.disable_query(pid_a))
+    na = len(job.results("out_a"))
+    for i in range(16, 24):
+        src.emit(Rec(i % 4, float(i), 1000 + i), 1000 + i)
+    job.run_cycle()
+    job.run_cycle()
+    assert len(job.results("out_a")) == na
+
+
+def test_mixed_types_and_within_fold():
+    # different within values and float literals are still DATA
+    src = CallbackSource("S", SCHEMA)
+    job = make_job(src)
+    cql1 = (
+        "from every s1 = S[price == 5.0] -> s2 = S[price == 7.0] "
+        "within 5 sec "
+        "select s1.timestamp as t1, s2.timestamp as t2 insert into oa"
+    )
+    cql2 = (
+        "from every s1 = S[price == 1.0] -> s2 = S[price == 2.0] "
+        "within 1 sec "
+        "select s1.timestamp as t1, s2.timestamp as t2 insert into ob"
+    )
+    job.add_plan(
+        compile_plan(cql1, {"S": SCHEMA}, plan_id="a"), dynamic=True
+    )
+    src.emit(Rec(0, 5.0, 1000), 1000)
+    src.emit(Rec(0, 7.0, 2000), 2000)
+    job.run_cycle()
+    (rt,) = job._plans.values()
+    t0 = rt.traces["n"]
+    job.add_plan(
+        compile_plan(cql2, {"S": SCHEMA}, plan_id="b"), dynamic=True
+    )
+    src.emit(Rec(0, 1.0, 3000), 3000)
+    src.emit(Rec(0, 2.0, 5000), 5000)  # outside b's 1s within
+    src.emit(Rec(0, 1.0, 6000), 6000)
+    src.emit(Rec(0, 2.0, 6500), 6500)  # inside
+    job.run_cycle()
+    assert rt.traces["n"] == t0
+    assert job.results("oa") == [(1000, 2000)]
+    assert job.results("ob") == [(6000, 6500)]
+
+
+def test_non_template_dynamic_add_still_works():
+    # a window query can't fold; it gets its own runtime as before
+    src = CallbackSource("S", SCHEMA)
+    job = make_job(src)
+    job.add_plan(
+        compile_plan(chain_cql("q1", 1, 2), {"S": SCHEMA}, plan_id="q1"),
+        dynamic=True,
+    )
+    job.add_plan(
+        compile_plan(
+            "from S select id, sum(price) as total group by id "
+            "insert into totals",
+            {"S": SCHEMA}, plan_id="w1",
+        ),
+        dynamic=True,
+    )
+    assert len(job._plans) == 2
+    assert set(job.plan_ids) == {"q1", "w1"}
+    for i in range(8):
+        src.emit(Rec(i % 4, float(i), 1000 + i), 1000 + i)
+    job.run_cycle()
+    assert len(job.results("totals")) == 8
+
+
+def test_checkpoint_restore_replays_dynamic_group(tmp_path):
+    src = CallbackSource("S", SCHEMA)
+    control = ControlQueueSource()
+    job = Job(
+        [], [src], batch_size=64, time_mode="processing",
+        control_sources=[control],
+        plan_compiler=lambda cql, pid: compile_plan(
+            cql, {"S": SCHEMA}, plan_id=pid
+        ),
+    )
+    b = MetadataControlEvent.builder()
+    pid_a = b.add_execution_plan(chain_cql("a", 1, 2))
+    pid_b = b.add_execution_plan(chain_cql("b", 2, 3))
+    control.push(b.build())
+    # a dangling s1 (id==1) partial carries across the checkpoint
+    src.emit(Rec(1, 0.0, 1000), 1000)
+    src.emit(Rec(9, 0.0, 1001), 1001)
+    job.run_cycle()
+    path = tmp_path / "ckpt.bin"
+    job.save_checkpoint(str(path))
+
+    src2 = CallbackSource("S", SCHEMA)
+    job2 = make_job(src2)
+    job2.restore(str(path))
+    assert set(job2.plan_ids) == {pid_a, pid_b}
+    # the carried partial completes after restore
+    src2.emit(Rec(2, 0.0, 2000), 2000)
+    job2.run_cycle()
+    assert job2.results("out_a") == [(1000, 2000)]
+
+
+def test_duplicate_dynamic_add_replaces_not_duplicates():
+    # at-least-once control channels may redeliver an add: the re-add
+    # replaces the query, never double-registers a second slot
+    src = CallbackSource("S", SCHEMA)
+    job = make_job(src)
+    for _ in range(2):
+        job.add_plan(
+            compile_plan(
+                chain_cql("q1", 1, 2), {"S": SCHEMA}, plan_id="q1"
+            ),
+            dynamic=True,
+        )
+    assert job.plan_ids == ["q1"]
+    for i in range(8):
+        src.emit(Rec(i % 4, float(i), 1000 + i), 1000 + i)
+    job.run_cycle()
+    # each match exactly once (a zombie slot would double-emit)
+    assert job.results("out_q1") == [(1001, 1002), (1005, 1006)]
+
+
+def test_non_integral_literal_on_int_column_not_folded():
+    # `id == 5.5` on an int column can never match statically; folding
+    # would truncate the param to 5 and match different events
+    src = CallbackSource("S", SCHEMA)
+    job = make_job(src)
+    job.add_plan(
+        compile_plan(chain_cql("q1", 1, 2), {"S": SCHEMA}, plan_id="q1"),
+        dynamic=True,
+    )
+    cql = (
+        "from every s1 = S[id == 5.5] -> s2 = S[id == 2] "
+        "select s1.timestamp as t1, s2.timestamp as t2 insert into oz"
+    )
+    job.add_plan(
+        compile_plan(cql, {"S": SCHEMA}, plan_id="qz"), dynamic=True
+    )
+    # not folded into the group: own runtime, exact static semantics
+    assert "qz" in job._plans
+    src.emit(Rec(5, 0.0, 1000), 1000)
+    src.emit(Rec(2, 0.0, 1001), 1001)
+    job.run_cycle()
+    assert job.results("oz") == []
