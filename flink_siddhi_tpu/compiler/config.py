@@ -1,0 +1,42 @@
+"""Per-plan engine capacities.
+
+Every data-dependent structure in the engine is bounded (fixed-capacity
+device arrays with counted overflow — SURVEY.md §7 hard parts 1-2).
+These bounds were module constants in round 1; they are now a per-plan
+configuration passed to ``compile_plan(..., config=...)``, the analog of
+the config surface the reference delegates to Flink's ExecutionConfig
+(SiddhiOperatorContext.java:43-48).
+
+Raising a capacity changes state shapes, so two plans with different
+configs never share executables — set them at compile time, not per
+batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    # chain matcher: carried partial matches per query
+    pattern_pool: int = 1024
+    # slot NFA: concurrent partial-match slots per query
+    pattern_slots: int = 64
+    # max events concurrently inside a #window.time / join time window
+    time_window_capacity: int = 512
+    # max distinct timeBatch windows touched per micro-batch
+    time_batch_slots: int = 64
+    # join ring slots per side (time/unbounded windows)
+    join_window_capacity: int = 128
+    # join output buffer capacity = factor * tape capacity
+    join_out_factor: int = 4
+    # rows per event table
+    table_capacity: int = 1024
+    # device output accumulator budget per plan
+    acc_budget_bytes: int = 256 * 1024 * 1024
+    # pre-padded query slots per dynamic chain group
+    dyn_query_slots: int = 8
+
+
+DEFAULT_CONFIG = EngineConfig()

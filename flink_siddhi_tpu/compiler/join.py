@@ -143,10 +143,11 @@ class JoinArtifact:
     # rows decode None for projections over the missing side
     proj_tags: Tuple[frozenset, ...] = ()
     output_mode: str = "buffered"
+    out_factor: int = JOIN_OUT_FACTOR
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
         """Widest per-cycle emission block (drain-cadence contract)."""
-        return JOIN_OUT_FACTOR * tape_capacity
+        return self.out_factor * tape_capacity
 
     @property
     def _nullable(self) -> bool:
@@ -251,7 +252,7 @@ class JoinArtifact:
             )
 
         # concatenate all segments and compact into the output buffer
-        cap = JOIN_OUT_FACTOR * E
+        cap = self.out_factor * E
         n_out = len(self.proj_fns) + (1 if self._nullable else 0)
         flags = jnp.concatenate([s[0] for s in segs])
         ts_all = jnp.concatenate([s[1] for s in segs])
@@ -361,7 +362,11 @@ def compile_join_query(
     schemas,
     stream_codes: Dict[str, int],
     extensions,
+    config=None,
 ):
+    from .config import DEFAULT_CONFIG
+
+    config = config or DEFAULT_CONFIG
     inp = q.input
     assert isinstance(inp, ast.JoinInput)
     li, ri = inp.left, inp.right
@@ -381,12 +386,13 @@ def compile_join_query(
                 raise SiddhiQLError("stream filter must be boolean")
             fns.append(ce.fn)
         w = _window_of(si)
+        ring = config.join_window_capacity
         if w is None:
-            mode, n, tms = "length", JOIN_WINDOW_CAPACITY, None
+            mode, n, tms = "length", ring, None
         elif w[0] == "length":
             mode, n, tms = "length", w[1], None
         elif w[0] == "time":
-            mode, n, tms = "time", JOIN_WINDOW_CAPACITY, w[1]
+            mode, n, tms = "time", ring, w[1]
         else:
             raise SiddhiQLError(
                 f"window #{w[0]} is not supported on a join input "
@@ -465,6 +471,7 @@ def compile_join_query(
         within=inp.within,
         proj_fns=proj_fns,
         proj_tags=tuple(proj_tags),
+        out_factor=config.join_out_factor,
     )
     art.encoded_columns = ()
     return art

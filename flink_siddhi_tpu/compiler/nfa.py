@@ -1154,19 +1154,10 @@ class StackedChainArtifact:
 
     def decode_packed(self, n: int, block: np.ndarray):
         """Split a fetched packed block into per-member (schema, rows)."""
-        out = []
-        qid = block[1, :n]
-        for qi, m in enumerate(self.members):
-            sel = np.nonzero(qid == qi)[0]
-            if sel.size == 0:
-                continue
-            schema = m.output_schema
-            sub = block[:, :n][:, sel]
-            rows = schema.decode_packed_block(
-                int(sel.size), sub, data_row=2
-            )
-            out.append((schema, rows))
-        return out
+        return _decode_qid_block(
+            n, block,
+            ((qi, m.output_schema) for qi, m in enumerate(self.members)),
+        )
 
     def flush(self, state: Dict) -> Tuple[Dict, Tuple]:
         """Timed-absence maturation at end of stream (per member query)."""
@@ -1501,21 +1492,33 @@ class DynamicChainGroup:
 
     def decode_packed(self, n: int, block: np.ndarray):
         """Split the packed block by query slot -> member streams."""
-        out = []
-        qid = block[1, :n]
-        for s, m in enumerate(self.members):
-            if m is None:
-                continue
-            sel = np.nonzero(qid == s)[0]
-            if sel.size == 0:
-                continue
-            schema = m[1]
-            sub = block[:, :n][:, sel]
-            rows = schema.decode_packed_block(
+        return _decode_qid_block(
+            n, block,
+            (
+                (s, m[1])
+                for s, m in enumerate(self.members)
+                if m is not None
+            ),
+        )
+
+
+def _decode_qid_block(n: int, block, slot_schemas):
+    """Split a packed (ts, qid, cols...) block by the qid row into
+    per-slot (schema, rows) lists. ``slot_schemas``: iterable of
+    (slot, OutputSchema)."""
+    out = []
+    qid = block[1, :n]
+    for slot, schema in slot_schemas:
+        sel = np.nonzero(qid == slot)[0]
+        if sel.size == 0:
+            continue
+        sub = block[:, :n][:, sel]
+        out.append(
+            (schema, schema.decode_packed_block(
                 int(sel.size), sub, data_row=2
-            )
-            out.append((schema, rows))
-        return out
+            ))
+        )
+    return out
 
 
 def group_chain_artifacts(artifacts: List) -> List:
@@ -2042,12 +2045,17 @@ def compile_pattern_query(
     schemas,
     stream_codes: Dict[str, int],
     extensions,
+    config=None,
 ):
+    from .config import DEFAULT_CONFIG
+
+    config = config or DEFAULT_CONFIG
     spec = _build_spec(q, schemas, stream_codes, extensions)
     out_schema = OutputSchema(spec.output_stream, spec.out_fields)
     if _is_chain(spec) and not spec.has_cross:
         return ChainPatternArtifact(
-            name=name, spec=spec, output_schema=out_schema
+            name=name, spec=spec, output_schema=out_schema,
+            pool=config.pattern_pool,
         )
     if any(el.negated for el in spec.elements):
         raise SiddhiQLError(
@@ -2066,4 +2074,7 @@ def compile_pattern_query(
     # cross-element filters and and/or groups route to the slot engine
     # even for plain chains: per-slot evaluation needs each partial's
     # captures / member-matched bits
-    return SlotNFAArtifact(name=name, spec=spec, output_schema=out_schema)
+    return SlotNFAArtifact(
+        name=name, spec=spec, output_schema=out_schema,
+        slots=config.pattern_slots,
+    )

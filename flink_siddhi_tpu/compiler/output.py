@@ -126,6 +126,4 @@ def emission_order(ts, n: int):
     alongside the packed block (slot-NFA mbits, join missing-side
     markers) MUST reorder them with this same helper, or the side rows
     desync from their data rows."""
-    import numpy as _np
-
-    return _np.argsort(_np.asarray(ts)[:n], kind="stable")
+    return np.argsort(np.asarray(ts)[:n], kind="stable")

@@ -155,6 +155,50 @@ def available() -> bool:
     return jax.default_backend() == "tpu"
 
 
+_SHARD_OK = None
+
+
+def warmup_shard() -> bool:
+    """Probe the kernel under a shard_map lowering (a configuration the
+    plain warmup() never exercises). MUST be called from host code. A
+    passing probe lets the sharded step keep the fused kernel instead of
+    blanket-falling back to XLA cummins."""
+    global _SHARD_OK
+    if _SHARD_OK is None:
+        if not warmup():
+            _SHARD_OK = False
+            return False
+        try:
+            from jax.sharding import PartitionSpec as P
+
+            mesh = jax.make_mesh((1,), ("@pallas_probe",))
+            rng = np.random.default_rng(1)
+            probe = rng.integers(
+                0, 2 ** 29, (1, _SUB, 4 * _BLOCK)
+            ).astype(np.int32)
+            # check_vma=False matches the engine's sharded step: the
+            # kernel's out_shape carries no vma annotation, and the
+            # per-shard body uses no collectives the checker would guard
+            f = jax.jit(
+                jax.shard_map(
+                    lambda x: _RUN(x[0])[None],
+                    mesh=mesh,
+                    in_specs=P("@pallas_probe"),
+                    out_specs=P("@pallas_probe"),
+                    check_vma=False,
+                )
+            )
+            out = np.asarray(f(jnp.asarray(probe)))[0]
+            ref = np.minimum.accumulate(
+                probe[0, :, ::-1], axis=1
+            )[:, ::-1]
+            _SHARD_OK = bool(np.array_equal(out, ref))
+        except Exception as e:
+            _LOG.info("pallas under shard_map unavailable: %s", e)
+            _SHARD_OK = False
+    return _SHARD_OK
+
+
 def multi_reverse_cummin(rows):
     """Reverse cummin along the last axis for up to 8 int32 channels of
     equal length E (E a multiple of 1024), fused in one Pallas pass.

@@ -22,6 +22,7 @@ from ..query import ast, parse_plan
 from ..query.lexer import SiddhiQLError
 from ..query.planner import StreamPartition, infer_stream_partitions
 from ..schema.stream_schema import StreamSchema
+from .config import DEFAULT_CONFIG, EngineConfig
 from ..extensions.registry import ExtensionRegistry, builtin_registry
 from ..runtime.tape import TapeSpec
 from .expr import ExprResolver
@@ -37,6 +38,7 @@ class CompiledPlan:
     partitions: Dict[str, StreamPartition]
     source_ast: ast.ExecutionPlan
     table_schemas: Dict[str, StreamSchema] = field(default_factory=dict)
+    config: EngineConfig = DEFAULT_CONFIG
 
     def init_state(self) -> Dict:
         from .table import init_table_state
@@ -44,7 +46,9 @@ class CompiledPlan:
         states = {a.name: a.init_state() for a in self.artifacts}
         if self.table_schemas:
             states["@tables"] = {
-                tid: init_table_state(tid, sch)
+                tid: init_table_state(
+                    tid, sch, self.config.table_capacity
+                )
                 for tid, sch in self.table_schemas.items()
             }
         return states
@@ -107,7 +111,6 @@ class CompiledPlan:
     # exactly TWO fetches (counts vector, then the used buffer slice),
     # amortized over hundreds of micro-batches.
 
-    ACC_BUDGET_BYTES = 256 * 1024 * 1024
 
     def acc_layout(self) -> List[Tuple[int, int]]:
         """(first_row, n_rows) per artifact in the packed buffer."""
@@ -128,7 +131,7 @@ class CompiledPlan:
 
     def acc_capacity(self) -> int:
         total_rows = sum(r for _, r in self.acc_layout()) or 1
-        cap = self.ACC_BUDGET_BYTES // (total_rows * 4)
+        cap = self.config.acc_budget_bytes // (total_rows * 4)
         return int(max(1 << 16, min(1 << 23, cap)))
 
     def init_acc(self) -> Dict:
@@ -287,6 +290,7 @@ def compile_plan(
     schemas: Dict[str, StreamSchema],
     extensions: Optional[ExtensionRegistry] = None,
     plan_id: str = "plan",
+    config: Optional[EngineConfig] = None,
 ) -> CompiledPlan:
     """Parse + validate + compile a full execution plan.
 
@@ -295,6 +299,8 @@ def compile_plan(
     """
     if extensions is None:
         extensions = builtin_registry()
+    if config is None:
+        config = DEFAULT_CONFIG
     parsed = parse_plan(plan_text)
 
     # plan-internal DDL shares the environment's string dictionary (taken
@@ -363,7 +369,8 @@ def compile_plan(
             raise SiddhiQLError(f"duplicate query name {qname!r}")
         used_names.add(qname)
         art = _compile_query(
-            q, qname, all_schemas, stream_codes, extensions, table_schemas
+            q, qname, all_schemas, stream_codes, extensions,
+            table_schemas, config,
         )
         encoded.extend(getattr(art, "encoded_columns", ()))
         artifacts.append(art)
@@ -388,6 +395,7 @@ def compile_plan(
         partitions=partitions,
         source_ast=parsed,
         table_schemas=table_schemas,
+        config=config,
     )
 
 
@@ -504,6 +512,7 @@ def _compile_query(
     stream_codes: Dict[str, int],
     extensions: ExtensionRegistry,
     table_schemas: Optional[Dict[str, StreamSchema]] = None,
+    config: EngineConfig = DEFAULT_CONFIG,
 ):
     table_schemas = table_schemas or {}
     q = _rewrite_partitioned(q, schemas)
@@ -518,7 +527,8 @@ def _compile_query(
                 "defined table"
             )
         return compile_table_write(
-            q, name, schemas, table_schemas, stream_codes, extensions
+            q, name, schemas, table_schemas, stream_codes, extensions,
+            config,
         )
     inp = q.input
     if isinstance(inp, ast.JoinInput) and (
@@ -528,7 +538,8 @@ def _compile_query(
         from .table import compile_table_join
 
         return compile_table_join(
-            q, name, schemas, table_schemas, stream_codes, extensions
+            q, name, schemas, table_schemas, stream_codes, extensions,
+            config,
         )
     if isinstance(inp, ast.StreamInput):
         if inp.stream_id in table_schemas:
@@ -543,7 +554,7 @@ def _compile_query(
             from .window import compile_window_query
 
             return compile_window_query(
-                q, name, schemas, stream_codes, extensions
+                q, name, schemas, stream_codes, extensions, config
             )
         ref = inp.ref_name
         resolver = ExprResolver(
@@ -566,12 +577,12 @@ def _compile_query(
         from .nfa import compile_pattern_query
 
         return compile_pattern_query(
-            q, name, schemas, stream_codes, extensions
+            q, name, schemas, stream_codes, extensions, config
         )
     if isinstance(inp, ast.JoinInput):
         from .join import compile_join_query
 
         return compile_join_query(
-            q, name, schemas, stream_codes, extensions
+            q, name, schemas, stream_codes, extensions, config
         )
     raise SiddhiQLError(f"unsupported input clause {type(inp).__name__}")

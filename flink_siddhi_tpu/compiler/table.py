@@ -37,14 +37,16 @@ def table_key(table_id: str, field: str) -> str:
     return f"@tbl:{table_id}.{field}"
 
 
-def init_table_state(table_id: str, schema: StreamSchema) -> Dict:
+def init_table_state(
+    table_id: str, schema: StreamSchema, capacity: int = TABLE_CAPACITY
+) -> Dict:
     st = {
-        "valid": jnp.zeros(TABLE_CAPACITY, bool),
+        "valid": jnp.zeros(capacity, bool),
         "ptr": jnp.asarray(0, jnp.int32),
     }
     for fname, ftype in zip(schema.field_names, schema.field_types):
         st[table_key(table_id, fname)] = jnp.zeros(
-            TABLE_CAPACITY, ftype.device_dtype
+            capacity, ftype.device_dtype
         )
     return st
 
@@ -447,6 +449,7 @@ def compile_table_write(
     table_schemas: Dict[str, StreamSchema],
     stream_codes: Dict[str, int],
     extensions,
+    config=None,
 ):
     tid = q.output_stream
     tschema = table_schemas[tid]
@@ -467,7 +470,7 @@ def compile_table_write(
         from .window import compile_window_query
 
         inner = compile_window_query(
-            q, f"{name}@win", schemas, stream_codes, extensions
+            q, f"{name}@win", schemas, stream_codes, extensions, config
         )
         for f in inner.output_schema.fields:
             if f.name not in tschema:
@@ -543,6 +546,7 @@ def compile_table_join(
     table_schemas: Dict[str, StreamSchema],
     stream_codes: Dict[str, int],
     extensions,
+    config=None,
 ):
     inp = q.input
     assert isinstance(inp, ast.JoinInput)

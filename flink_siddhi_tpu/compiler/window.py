@@ -781,6 +781,7 @@ class BatchWindowArtifact:
     proj_fns: List
     having_fn: Optional[Callable]
     output_mode: str = "buffered"
+    batch_slots: int = TIME_BATCH_SLOTS
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
         """Widest per-cycle emission block: every window-grid cell can
@@ -850,7 +851,7 @@ class BatchWindowArtifact:
     def _grid_shape(self, E: int) -> int:
         if self.window_mode == "lengthBatch":
             return E // self.length + 2
-        return TIME_BATCH_SLOTS + 1
+        return self.batch_slots + 1
 
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
@@ -1184,7 +1185,11 @@ def compile_window_query(
     schemas,
     stream_codes: Dict[str, int],
     extensions,
+    config=None,
 ):
+    from .config import DEFAULT_CONFIG
+
+    config = config or DEFAULT_CONFIG
     inp = q.input
     assert isinstance(inp, ast.StreamInput)
     ref = inp.ref_name
@@ -1274,13 +1279,13 @@ def compile_window_query(
             mode, cap, time_ms, ts_key = "length", window[1], None, None
         elif window[0] == "time":
             mode, cap, time_ms, ts_key = (
-                "time", TIME_WINDOW_CAPACITY, window[1], None,
+                "time", config.time_window_capacity, window[1], None,
             )
         else:  # externalTime
             ts_attr, dur = window[1]
             r = resolver.resolve(ts_attr)
             mode, cap, time_ms, ts_key = (
-                "time", TIME_WINDOW_CAPACITY, dur, r.key,
+                "time", config.time_window_capacity, dur, r.key,
             )
         if mode == "cumulative":
             code_key, encoder, encoded = _group_encoding(
@@ -1357,6 +1362,7 @@ def compile_window_query(
         last_types=[last_types_map[k] for k in last_keys],
         proj_fns=proj_fns,
         having_fn=having_fn,
+        batch_slots=config.time_batch_slots,
     )
     art.encoded_columns = encoded
     return art

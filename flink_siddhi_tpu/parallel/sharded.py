@@ -48,6 +48,15 @@ def _shapes(tree) -> List[Tuple]:
     return [np.shape(leaf) for leaf in jax.tree.leaves(tree)]
 
 
+def _shard_kernel_ok() -> bool:
+    """Whether the pallas kernel passed its shard_map lowering probe
+    (host-side, cached). When it did, the sharded step keeps the fused
+    TPU kernel instead of the XLA fallback (VERDICT round-1 #9)."""
+    from ..compiler import pallas_ops
+
+    return pallas_ops.warmup_shard()
+
+
 def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
     """jit(shard_map(plan.step)) over the ``shards`` mesh axis.
 
@@ -56,15 +65,18 @@ def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
     single-device compile path and the sharded path share all kernels.
     """
 
+    use_kernel = _shard_kernel_ok()
+
     def local(states, tape):
         from ..compiler import pallas_ops
 
         states = jax.tree.map(lambda x: x[0], states)
         tape = jax.tree.map(lambda x: x[0], tape)
-        # custom kernels under shard_map are a lowering configuration the
-        # warmup probe never validated; use the XLA path here
-        with pallas_ops.force_fallback():
+        if use_kernel:
             new_states, outputs = plan.step(states, tape)
+        else:
+            with pallas_ops.force_fallback():
+                new_states, outputs = plan.step(states, tape)
         expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         return expand(new_states), expand(outputs)
 
@@ -73,6 +85,9 @@ def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        # no collectives in the per-shard body; vma checking would also
+        # reject the pallas kernel's un-annotated out_shape
+        check_vma=False,
     )
     return jax.jit(smapped)
 
@@ -82,14 +97,19 @@ def make_sharded_step_acc(plan: CompiledPlan, mesh) -> callable:
     its own on-device accumulator — the hot loop never fetches (same
     contract as the single-device executor)."""
 
+    use_kernel = _shard_kernel_ok()
+
     def local(states, acc, tape):
         from ..compiler import pallas_ops
 
         states = jax.tree.map(lambda x: x[0], states)
         acc = jax.tree.map(lambda x: x[0], acc)
         tape = jax.tree.map(lambda x: x[0], tape)
-        with pallas_ops.force_fallback():
+        if use_kernel:
             new_states, new_acc = plan.step_acc(states, acc, tape)
+        else:
+            with pallas_ops.force_fallback():
+                new_states, new_acc = plan.step_acc(states, acc, tape)
         expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         return expand(new_states), expand(new_acc)
 
@@ -98,6 +118,7 @@ def make_sharded_step_acc(plan: CompiledPlan, mesh) -> callable:
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
     )
     return jax.jit(smapped, donate_argnums=(0, 1))
 

@@ -52,6 +52,16 @@ def _first_string_table(job):
 
 def snapshot_job(job) -> Dict[str, Any]:
     """Capture everything needed to resume ``job`` on a fresh process."""
+    missing_cql = set(getattr(job, "_folded", {})) - set(
+        getattr(job, "_dynamic_cql", {})
+    )
+    if missing_cql:
+        raise ValueError(
+            f"dynamically-added plans {sorted(missing_cql)} have no "
+            "recorded CQL, so the checkpoint could not be restored; add "
+            "them through control events or pass cql= to "
+            "add_plan(dynamic=True)"
+        )
     plans = {}
     strings = _first_string_table(job)
     for plan_id, rt in job._plans.items():

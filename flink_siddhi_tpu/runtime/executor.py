@@ -123,17 +123,26 @@ class Job:
     # Parity: AbstractSiddhiOperator.onEventReceived (:399-467) — add/update/
     # remove QueryRuntimeHandlers, enable/disable gating — applied here at
     # micro-batch boundaries.
-    def add_plan(self, plan: CompiledPlan, dynamic: bool = False) -> None:
+    def add_plan(
+        self,
+        plan: CompiledPlan,
+        dynamic: bool = False,
+        cql: Optional[str] = None,
+    ) -> None:
         """``dynamic=True`` (the control-plane add path): template-able
         chain plans fold into / become padded dynamic groups so repeat
         adds are data updates. Static plans keep the single-query fast
-        path (pallas chain core, no query axis)."""
+        path (pallas chain core, no query axis). Pass ``cql`` so the add
+        is checkpointable (snapshot replays dynamic queries from their
+        CQL; the control-event path records it automatically)."""
         admit0 = None
         if dynamic:
             if plan.plan_id in self._folded or plan.plan_id in self._plans:
                 # re-add of a live id (e.g. an at-least-once control
                 # channel redelivering): replace, never double-register
                 self.remove_plan(plan.plan_id)
+            if cql is not None:
+                self._dynamic_cql[plan.plan_id] = cql
             if self._try_fold(plan):
                 return  # data update into an existing group slot
             plan, admit0 = self._wrap_dynamic(plan)
@@ -178,14 +187,12 @@ class Job:
             out[key] = plan.schemas[sid].string_tables.get(fname)
         return out
 
-    def _fold_into(self, host_id: str, plan: CompiledPlan, slot: int) -> None:
-        from ..compiler.nfa import chain_template_of
-
+    def _fold_into(
+        self, host_id: str, plan: CompiledPlan, slot: int, t
+    ) -> None:
         rt = self._plans[host_id]
         group = rt.plan.artifacts[0]
-        tpl, params, within = chain_template_of(
-            plan.artifacts[0], plan.spec.column_types
-        )
+        tpl, params, within = t
         states = dict(rt.states)
         states[group.name] = group.admit(
             states[group.name], slot, plan.plan_id,
@@ -216,7 +223,7 @@ class Job:
             slot = arts[0].free_slot()
             if slot is None:
                 continue
-            self._fold_into(host_id, plan, slot)
+            self._fold_into(host_id, plan, slot, t)
             return True
         return False
 
@@ -228,11 +235,7 @@ class Job:
         (so the NEXT structurally-identical add is a data update)."""
         import dataclasses
 
-        from ..compiler.nfa import (
-            DYN_QUERY_SLOTS,
-            DynamicChainGroup,
-            chain_template_of,
-        )
+        from ..compiler.nfa import DynamicChainGroup, chain_template_of
 
         if len(plan.artifacts) != 1:
             return plan, None
@@ -251,8 +254,9 @@ class Job:
                 plan.spec.stream_codes[sid] for sid in tpl.stream_ids
             ),
             column_types=dict(plan.spec.column_types),
-            members=[None] * DYN_QUERY_SLOTS,
+            members=[None] * plan.config.dyn_query_slots,
             pool=art.pool,
+            capacity=plan.config.dyn_query_slots,
         )
         new_plan = dataclasses.replace(
             plan, plan_id=host_id, artifacts=[group]
@@ -302,7 +306,14 @@ class Job:
                     self._create_runtime(wrapped, admit0)
                     first = False
                 else:
-                    self._fold_into(host_id, plan, slot)
+                    from ..compiler.nfa import chain_template_of
+
+                    self._fold_into(
+                        host_id, plan, slot,
+                        chain_template_of(
+                            plan.artifacts[0], plan.spec.column_types
+                        ),
+                    )
         for pid, cql in dynamic_cql.items():
             if pid not in folded and pid not in self._plans:
                 self.add_plan(self._plan_compiler(cql, pid))

@@ -266,3 +266,34 @@ def test_non_integral_literal_on_int_column_not_folded():
     src.emit(Rec(2, 0.0, 1001), 1001)
     job.run_cycle()
     assert job.results("oz") == []
+
+
+def test_direct_dynamic_add_checkpoint_needs_cql(tmp_path):
+    src = CallbackSource("S", SCHEMA)
+    job = make_job(src)
+    cql = chain_cql("q1", 1, 2)
+    job.add_plan(
+        compile_plan(cql, {"S": SCHEMA}, plan_id="q1"), dynamic=True
+    )
+    src.emit(Rec(1, 0.0, 1000), 1000)
+    job.run_cycle()
+    # without a recorded CQL the snapshot would be unrestorable: refuse
+    with pytest.raises(ValueError, match="no\\s+recorded CQL"):
+        job.save_checkpoint(str(tmp_path / "x.bin"))
+    # with cql= the add is checkpointable
+    src2 = CallbackSource("S", SCHEMA)
+    job2 = make_job(src2)
+    job2.add_plan(
+        compile_plan(cql, {"S": SCHEMA}, plan_id="q1"),
+        dynamic=True, cql=cql,
+    )
+    src2.emit(Rec(1, 0.0, 1000), 1000)
+    job2.run_cycle()
+    p = tmp_path / "ok.bin"
+    job2.save_checkpoint(str(p))
+    src3 = CallbackSource("S", SCHEMA)
+    job3 = make_job(src3)
+    job3.restore(str(p))
+    src3.emit(Rec(2, 0.0, 2000), 2000)
+    job3.run_cycle()
+    assert job3.results("out_q1") == [(1000, 2000)]
