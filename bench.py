@@ -127,7 +127,15 @@ def build_job(config, n_events, batch):
     n_ids = 1000 if config == "window_groupby" else 50
     batches = make_batches(n_events, batch, schema, "inputStream", n_ids)
     src = BatchSource("inputStream", schema, iter(batches))
-    plan = compile_plan(cql, {"inputStream": schema}, plan_id="bench")
+    from flink_siddhi_tpu.compiler.config import EngineConfig
+
+    # late materialization: projection-only columns (price, and the
+    # timestamps' source column) stay host-side — the wire carries only
+    # the predicate column + ts deltas (~2 B/event on the headline)
+    ecfg = EngineConfig(lazy_projection=True)
+    plan = compile_plan(
+        cql, {"inputStream": schema}, plan_id="bench", config=ecfg
+    )
     return Job(
         [plan], [src], batch_size=batch, time_mode="processing",
         retain_results=False,

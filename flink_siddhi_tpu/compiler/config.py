@@ -37,6 +37,15 @@ class EngineConfig:
     acc_budget_bytes: int = 256 * 1024 * 1024
     # pre-padded query slots per dynamic chain group
     dyn_query_slots: int = 8
+    # late materialization for single-chain plans: projection-only
+    # columns never ship to the device — the matcher emits event
+    # ordinals and decode resolves them against host-retained batches.
+    # Single-device jobs only (ShardedJob rejects lazy plans); carried
+    # partial matches older than the host ring's byte budget (or a
+    # checkpoint/restore) decode their lazy columns as None.
+    lazy_projection: bool = False
+    # host retention budget for lazy-projected columns (the ordinal ring)
+    lazy_ring_budget_bytes: int = 256 * 1024 * 1024
 
 
 DEFAULT_CONFIG = EngineConfig()

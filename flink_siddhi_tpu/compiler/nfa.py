@@ -271,6 +271,9 @@ class _PatternSpec:
     # one member of an or-group fires, so projections over the OTHER
     # member must decode as None (Siddhi: null), not a zeroed capture
     proj_or_deps: Tuple[Tuple[int, ...], ...] = ()
+    # per projection: every (elem, col) capture pair its expression reads
+    # (late-materialization eligibility analysis)
+    proj_ref_pairs: Tuple[Tuple[Tuple[int, str], ...], ...] = ()
 
     @property
     def n_elements(self) -> int:
@@ -428,14 +431,24 @@ def _build_spec(
                 deps.add(elem)
         return tuple(sorted(deps))
 
+    def _item_pairs(expr) -> Tuple[Tuple[int, str], ...]:
+        prs = set()
+        for a in ast.iter_attrs(expr):
+            e = cap_resolver.element_of(a)
+            if e is not None:
+                prs.add((e, a.name))
+        return tuple(sorted(prs))
+
     proj_fns, out_fields, proj_srcs = [], [], []
     proj_or_deps: List[Tuple[int, ...]] = []
+    proj_ref_pairs: List[Tuple[Tuple[int, str], ...]] = []
     for item in q.selector.items:
         if ast.contains_aggregate(item.expr):
             raise SiddhiQLError(
                 "aggregations over pattern matches are not supported"
             )
         proj_or_deps.append(_or_deps(item.expr))
+        proj_ref_pairs.append(_item_pairs(item.expr))
         ce = compile_expr(item.expr, cap_resolver, extensions)
         proj_fns.append(ce.fn)
         out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
@@ -486,6 +499,7 @@ def _build_spec(
         groups=tuple(groups),
         group_ops=tuple(group_ops),
         proj_or_deps=tuple(proj_or_deps),
+        proj_ref_pairs=tuple(proj_ref_pairs),
     )
 
 
@@ -845,6 +859,12 @@ class ChainPatternArtifact:
     # bitcast row per projection — the accumulator append layout
     output_mode: str = "packed"
     pool: int = DEFAULT_PARTIAL_POOL
+    # late materialization: these capture pairs are PROJECTION-ONLY, so
+    # their columns never ship to the device — the matcher captures the
+    # event's global ordinal instead, and decode looks the value up in
+    # the host's retained batches (a tunneled/remote device is
+    # ingest-bandwidth-bound; see runtime/executor._LazyRing)
+    lazy_pairs: Tuple[Tuple[int, str], ...] = ()
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
         """Widest per-cycle emission block (drain-cadence contract)."""
@@ -853,6 +873,24 @@ class ChainPatternArtifact:
     def _tfor_ms(self) -> Optional[int]:
         last = self.spec.elements[-1]
         return last.absent_for if last.negated else None
+
+    def _cap_dtype(self, pair) -> np.dtype:
+        if pair in self.lazy_pairs:
+            return np.dtype(np.int32)  # global event ordinal
+        return np.dtype(self.spec.cap_dtype[pair])
+
+    def _cfg(self) -> "_ChainCfg":
+        import dataclasses
+
+        cfg = _ChainCfg.of(self.spec)
+        if self.lazy_pairs:
+            cfg = dataclasses.replace(
+                cfg,
+                cap_dtypes=tuple(
+                    self._cap_dtype(p).name for p in cfg.pairs
+                ),
+            )
+        return cfg
 
     def init_state(self) -> Dict:
         P = self.pool
@@ -868,9 +906,11 @@ class ChainPatternArtifact:
         if self._tfor_ms() is not None:
             # timed-absence waiting partials carry their deadline base
             state["emit_ts"] = jnp.zeros(P, dtype=jnp.int32)
+        if self.lazy_pairs:
+            state["seen"] = jnp.asarray(0, dtype=jnp.int32)
         for pair in _cap_pairs(self.spec):
             state[_skey("cap", *pair)] = jnp.zeros(
-                P, dtype=self.spec.cap_dtype[pair]
+                P, dtype=self._cap_dtype(pair)
             )
         return state
 
@@ -882,17 +922,35 @@ class ChainPatternArtifact:
         pairs = _cap_pairs(spec)
 
         preds = jnp.stack(_element_preds(spec, tape, state["enabled"]))
-        cap_srcs = {
-            pair: tape.cols[spec.cap_src_key[pair]] for pair in pairs
-        }
+        if self.lazy_pairs:
+            # capture the event's GLOBAL ordinal for projection-only
+            # columns; the column itself never shipped to the device
+            ordinals = state["seen"] + jnp.arange(E, dtype=jnp.int32)
+            cap_srcs = {
+                pair: (
+                    ordinals
+                    if pair in self.lazy_pairs
+                    else tape.cols[spec.cap_src_key[pair]]
+                )
+                for pair in pairs
+            }
+            seen_next = state["seen"] + tape.valid.sum().astype(jnp.int32)
+            state = {k: v for k, v in state.items() if k != "seen"}
+        else:
+            cap_srcs = {
+                pair: tape.cols[spec.cap_src_key[pair]] for pair in pairs
+            }
+            seen_next = None
         within_val = jnp.int32(
             spec.within if spec.within is not None else 0
         )
         state, complete, v_emit_ts, caps = _chain_core(
-            _ChainCfg.of(spec), P, state, preds, cap_srcs, within_val,
+            self._cfg(), P, state, preds, cap_srcs, within_val,
             tape.ts, tape.valid, use_pallas=True,
             tfor_val=jnp.int32(self._tfor_ms() or 0),
         )
+        if seen_next is not None:
+            state["seen"] = seen_next
         # emit matches: O(V) cumsum-scatter compaction into the first
         # n_matches rows; all output rows (ts + projections) compact
         # through ONE scatter. The packed (1+C, V) int32 block is exactly
@@ -920,6 +978,56 @@ class ChainPatternArtifact:
             .set(emit_rows, mode="drop")
         )
         return state, (n_matches, packed)
+
+    @property
+    def wants_lookup(self) -> bool:
+        return bool(self.lazy_pairs)
+
+    def decode_packed(self, n: int, block: "np.ndarray", lookup=None):
+        """With lazy pairs, projection rows carrying ordinals resolve
+        against the host's retained batches; evicted ordinals decode as
+        None (bounded-memory policy, like every other engine cap)."""
+        schema = self.output_schema
+        if not self.lazy_pairs:
+            return [(schema, schema.decode_packed_block(n, block))]
+        from .output import emission_order
+
+        order = emission_order(block[0], n)
+        ts_list = (
+            np.asarray(block[0, :n])[order].astype(np.int64).tolist()
+        )
+        col_lists = []
+        for c, f in enumerate(schema.fields):
+            raw = np.asarray(block[1 + c, :n])[order]
+            src = self.spec.proj_srcs[c]
+            if src is not None and src in self.lazy_pairs:
+                vals = (
+                    lookup(self.spec.cap_src_key[src], raw)
+                    if lookup is not None
+                    else [None] * n
+                )
+                if f.table is not None:
+                    vals = [
+                        None if v is None else f.table.value(int(v))
+                        for v in vals
+                    ]
+                else:
+                    vals = [
+                        None if v is None
+                        else (v.item() if hasattr(v, "item") else v)
+                        for v in vals
+                    ]
+                col_lists.append(vals)
+            else:
+                if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
+                    raw = raw.view(np.float32)
+                col_lists.append(f.decode_column(raw))
+        rows = (
+            list(zip(ts_list, map(tuple, zip(*col_lists))))
+            if col_lists
+            else [(t, ()) for t in ts_list]
+        )
+        return [(schema, rows)]
 
     def flush(self, state: Dict) -> Tuple[Dict, Tuple]:
         """End-of-stream: with a terminal timed absence, stream end means
@@ -1243,6 +1351,10 @@ def chain_template_of(
     than the statically-compiled query, which promotes to a common type)."""
     if not isinstance(artifact, ChainPatternArtifact):
         return None
+    if artifact.lazy_pairs:
+        # a lazy-projected plan's tape lacks the projection columns the
+        # parametric group would capture from; it keeps its own runtime
+        return None
     spec = artifact.spec
     if spec.kind != "pattern" or spec.has_cross:
         return None
@@ -1500,6 +1612,43 @@ class DynamicChainGroup:
                 if m is not None
             ),
         )
+
+
+def apply_lazy_projection(artifact: "ChainPatternArtifact"):
+    """Late materialization for a chain plan: capture pairs that are
+    PROJECTION-ONLY (their column feeds no predicate, and every select
+    item reading them is a plain reference) switch to ordinal capture,
+    and their columns drop off the device tape entirely. Returns the set
+    of tape columns the device still needs, or None when nothing is
+    lazy-eligible."""
+    spec = artifact.spec
+    pred_cols = set()
+    for el in spec.elements:
+        if el.filter is None:
+            continue
+        for a in ast.iter_attrs(el.filter):
+            pred_cols.add(f"{el.stream_id}.{a.name}")
+    pairs = _cap_pairs(spec)
+    lazy = []
+    for pair in pairs:
+        key = spec.cap_src_key[pair]
+        if key in pred_cols:
+            continue
+        plain = True
+        for i, prs in enumerate(spec.proj_ref_pairs):
+            if pair in prs and spec.proj_srcs[i] != pair:
+                plain = False  # computed expression needs the value
+                break
+        if plain:
+            lazy.append(pair)
+    if not lazy:
+        return None
+    artifact.lazy_pairs = tuple(sorted(lazy))
+    needed = set(pred_cols)
+    for pair in pairs:
+        if pair not in artifact.lazy_pairs:
+            needed.add(spec.cap_src_key[pair])
+    return needed
 
 
 def _decode_qid_block(n: int, block, slot_schemas):

@@ -231,12 +231,13 @@ class CompiledPlan:
             "buf": buf,
         }
 
-    def drain_decode(self, counts: np.ndarray, data: np.ndarray
-                     ) -> Dict[str, List]:
+    def drain_decode(self, counts: np.ndarray, data: np.ndarray,
+                     lookup=None) -> Dict[str, List]:
         """Host side of a drain: unpack the fetched buffer slice into
         per-artifact lists of (output_schema, decoded rows). ``data`` is
         ``buf[:, :max(counts)]`` already on host. Stacked multi-query
-        artifacts route their rows to each member's own stream."""
+        artifacts route their rows to each member's own stream;
+        ``lookup`` resolves lazy-projected ordinals."""
         out: Dict[str, List] = {}
         for ai, (a, (row0, n_rows)) in enumerate(
             zip(self.artifacts, self.acc_layout())
@@ -247,7 +248,10 @@ class CompiledPlan:
                 continue
             block = data[row0:row0 + n_rows, :n]
             if hasattr(a, "decode_packed"):
-                out[a.name] = a.decode_packed(n, block)
+                if getattr(a, "wants_lookup", False):
+                    out[a.name] = a.decode_packed(n, block, lookup=lookup)
+                else:
+                    out[a.name] = a.decode_packed(n, block)
                 continue
             out[a.name] = [(
                 a.output_schema,
@@ -382,8 +386,24 @@ def compile_plan(
 
     artifacts = group_chain_artifacts(artifacts)
 
+    # late materialization (opt-in): a single chain plan whose
+    # projection-only columns stay host-side — biggest ingest-bandwidth
+    # lever on remote/tunneled devices (wire drops to the predicate
+    # columns + timestamps)
+    device_columns = None
+    if config.lazy_projection and len(artifacts) == 1:
+        from .nfa import ChainPatternArtifact, apply_lazy_projection
+
+        if isinstance(artifacts[0], ChainPatternArtifact):
+            needed = apply_lazy_projection(artifacts[0])
+            if needed is not None:
+                device_columns = tuple(
+                    k for k in columns if k in needed
+                )
+
     spec = TapeSpec(
-        stream_codes, tuple(columns), column_types, tuple(encoded)
+        stream_codes, tuple(columns), column_types, tuple(encoded),
+        device_columns=device_columns,
     )
 
     partitions = infer_stream_partitions(parsed.queries)

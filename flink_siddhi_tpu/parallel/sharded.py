@@ -152,6 +152,12 @@ class ShardedJob(Job):
         # dynamic-group folding is a single-device optimization; sharded
         # adds keep one runtime per plan (dynamic flag accepted for API
         # parity)
+        if any(getattr(a, "lazy_pairs", ()) for a in plan.artifacts):
+            raise ValueError(
+                "lazy projection is single-device (the ordinal ring "
+                "lives on one host); compile this plan with "
+                "EngineConfig(lazy_projection=False) for sharded jobs"
+            )
         stacked = _tree_stack([plan.init_state()] * self.n_shards)
         stacked = jax.device_put(stacked, self._state_sharding)
         init_acc = jax.jit(

@@ -21,6 +21,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..compiler.plan import CompiledPlan
@@ -30,6 +31,7 @@ from .tape import bucket_size, build_wire_tape
 
 MAX_WM = np.iinfo(np.int64).max
 MIN_WM = -(2 ** 62)  # pre-first-event watermark sentinel
+_LAZY_ORD_WRAP = 1 << 30  # reset lazy ordinal space before int32 wrap
 _LOG = logging.getLogger(__name__)
 
 
@@ -50,6 +52,52 @@ class _PlanRuntime:
     tape_capacity: int = 0
     flush_warm: object = None  # background flush-precompile future
     inflight: int = 0  # dispatched cycles since the last device sync
+
+
+class _LazyRing:
+    """Host-retained projection-only columns (late materialization).
+
+    Lazy-projected plans emit event ORDINALS; this ring maps them back
+    to values at decode time. Entries are evicted oldest-first past a
+    byte budget — an ordinal older than the horizon decodes as None
+    (bounded-memory policy, counted in ``missed``), mirroring every
+    other engine cap."""
+
+    def __init__(self, budget_bytes: int = 256 << 20) -> None:
+        self.starts: List[int] = []
+        self.lens: List[int] = []
+        self.cols: List[Dict[str, np.ndarray]] = []
+        self.bytes = 0
+        self.budget = budget_bytes
+        self.missed = 0
+
+    def push(self, start: int, cols: Dict[str, np.ndarray]) -> None:
+        n = len(next(iter(cols.values()))) if cols else 0
+        self.starts.append(start)
+        self.lens.append(n)
+        self.cols.append(cols)
+        self.bytes += sum(c.nbytes for c in cols.values())
+        while self.bytes > self.budget and len(self.starts) > 1:
+            old = self.cols.pop(0)
+            self.starts.pop(0)
+            self.lens.pop(0)
+            self.bytes -= sum(c.nbytes for c in old.values())
+
+    def lookup(self, key: str, ords) -> List:
+        ords = np.asarray(ords, dtype=np.int64)
+        idx = np.searchsorted(self.starts, ords, side="right") - 1
+        out: List = [None] * len(ords)
+        for j, (o, i) in enumerate(zip(ords.tolist(), idx.tolist())):
+            if i < 0:
+                self.missed += 1
+                continue
+            off = o - self.starts[i]
+            entry = self.cols[i]
+            if off >= self.lens[i] or key not in entry:
+                self.missed += 1
+                continue
+            out[j] = entry[key][off]
+        return out
 
 
 class Job:
@@ -175,6 +223,28 @@ class Job:
         rt.traces = traces
         if admit0 is not None:
             rt.states = admit0(rt.states)
+        lazy_keys = {
+            a.spec.cap_src_key[pair]
+            for a in plan.artifacts
+            for pair in getattr(a, "lazy_pairs", ())
+        }
+        rt.lazy_keys = lazy_keys
+        rt.lazy = (
+            _LazyRing(plan.config.lazy_ring_budget_bytes)
+            if lazy_keys
+            else None
+        )
+        # None = sync from the device 'seen' counter at the first step
+        # (a restored checkpoint resumes mid-ordinal-space)
+        rt.lazy_base = None
+        rt.lazy_state_name = next(
+            (
+                a.name
+                for a in plan.artifacts
+                if getattr(a, "lazy_pairs", ())
+            ),
+            None,
+        )
         self._plans[plan.plan_id] = rt
 
     # -- dynamic chain groups (recompile-free runtime adds) -----------------
@@ -425,7 +495,12 @@ class Job:
             rt.states, outputs = self._flush_fn(rt)(rt.states)
             if outputs:
                 self._decode_outputs(
-                    rt.plan, outputs, only=set(outputs)
+                    rt.plan, outputs, only=set(outputs),
+                    lookup=(
+                        rt.lazy.lookup
+                        if getattr(rt, "lazy", None) is not None
+                        else None
+                    ),
                 )
 
     @staticmethod
@@ -509,7 +584,14 @@ class Job:
         data = np.asarray(rt.acc["buf"][:, :fetch_n])[:, :max_n]  # fetch 2
         rt.acc = rt.jitted_init_acc()
         rt._overflow_seen = None  # counters reset with the accumulator
-        decoded = rt.plan.drain_decode(counts, data)
+        decoded = rt.plan.drain_decode(
+            counts, data,
+            lookup=(
+                rt.lazy.lookup
+                if getattr(rt, "lazy", None) is not None
+                else None
+            ),
+        )
         for a in rt.plan.artifacts:
             for schema, rows in decoded.get(a.name) or []:
                 self._emit_rows(schema, rows)
@@ -682,6 +764,51 @@ class Job:
             plan.spec, involved, self._epoch_ms, rt.wire_kinds,
             capacity=rt.tape_capacity,
         )
+        if getattr(rt, "lazy", None) is not None:
+            if rt.lazy_base is None:
+                # first step (or first after restore): adopt the device
+                # counter so host ring and device ordinals share a base
+                rt.lazy_base = int(
+                    np.asarray(
+                        rt.states[rt.lazy_state_name]["seen"]
+                    )
+                )
+            if rt.lazy_base + total > _LAZY_ORD_WRAP:
+                # int32 ordinal space: reset both sides well before the
+                # device counter could wrap (undrained in-flight matches
+                # from before the reset decode None — one warned event
+                # per ~1B processed)
+                self._drain_plan(rt)
+                states = dict(rt.states)
+                sub = dict(states[rt.lazy_state_name])
+                sub["seen"] = jnp.zeros((), jnp.int32)
+                states[rt.lazy_state_name] = sub
+                rt.states = states
+                rt.lazy_base = 0
+                rt.lazy = _LazyRing(rt.lazy.budget)
+                _LOG.warning(
+                    "%s: lazy ordinal space reset (wrap horizon)",
+                    plan.plan_id,
+                )
+            # retain the merged-order values of projection-only columns;
+            # the device will emit ordinals into this ring's space
+            lcols: Dict[str, np.ndarray] = {}
+            for key in rt.lazy_keys:
+                sid, fname = key.split(".", 1)
+                col = None
+                for bi, b in enumerate(involved):
+                    if b.stream_id != sid:
+                        continue
+                    sel = _prov[:, 0] == bi
+                    if col is None:
+                        col = np.zeros(
+                            total, dtype=b.columns[fname].dtype
+                        )
+                    col[sel] = b.columns[fname][_prov[sel, 1]]
+                if col is not None:
+                    lcols[key] = col
+            rt.lazy.push(rt.lazy_base, lcols)
+            rt.lazy_base += total
         # host interning may have discovered new group keys: re-bucket state
         # tables before the jit call (shape change -> one-off retrace)
         rt.states = plan.grow_state(rt.states)
@@ -725,7 +852,7 @@ class Job:
         self._drain_hints[plan.plan_id] = cap_cycles
 
     def _decode_outputs(
-        self, plan: CompiledPlan, outputs: Dict, only=None
+        self, plan: CompiledPlan, outputs: Dict, only=None, lookup=None
     ) -> None:
         for a in plan.artifacts:
             if only is not None and a.name not in only:
@@ -749,7 +876,13 @@ class Job:
                     continue
                 block = np.asarray(block)
                 if hasattr(a, "decode_packed"):
-                    for sch, rows in a.decode_packed(int(count), block):
+                    if getattr(a, "wants_lookup", False):
+                        decoded = a.decode_packed(
+                            int(count), block, lookup=lookup
+                        )
+                    else:
+                        decoded = a.decode_packed(int(count), block)
+                    for sch, rows in decoded:
                         self._emit_rows(sch, rows)
                     continue
                 rows = schema.decode_packed_block(int(count), block)

@@ -56,6 +56,17 @@ class TapeSpec:
     columns: Tuple[str, ...]  # "stream.field" keys
     column_types: Dict[str, AttributeType]
     encoded: Tuple[EncodedColumn, ...] = ()
+    # late materialization: when set, only these columns ship to the
+    # device (projection-only columns stay host-side; the engine emits
+    # event ordinals that decode against the host's retained batches)
+    device_columns: Optional[Tuple[str, ...]] = None
+
+    def built_columns(self) -> Tuple[str, ...]:
+        if self.device_columns is None:
+            return self.columns
+        return tuple(
+            k for k in self.columns if k in set(self.device_columns)
+        )
 
     def code_of(self, stream_id: str) -> int:
         return self.stream_codes[stream_id]
@@ -342,7 +353,7 @@ def build_tape(
     valid[:total] = True
 
     cols: Dict[str, np.ndarray] = {}
-    for key in spec.columns:
+    for key in spec.built_columns():
         stream_id, field = key.split(".", 1)
         dtype = spec.column_types[key].device_dtype
         col = np.zeros(cap, dtype=dtype)
