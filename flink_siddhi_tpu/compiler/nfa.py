@@ -85,6 +85,7 @@ class CaptureResolver:
         # elements never match an event, so they have nothing to select
         self._by_alias: Dict[str, Tuple[int, str, object]] = {}
         self._negated = {el.alias for el in elements if el.negated}
+        self._elements = tuple(elements)
         for i, el in enumerate(elements):
             self._by_alias[el.alias] = (i, el.stream_id, schemas[el.stream_id])
         self.referenced: List[Tuple[int, str, str]] = []  # (elem, col, which)
@@ -135,10 +136,23 @@ class CaptureResolver:
                 which = "first"
             elif attr.index == "last":
                 which = "last"
+            elif isinstance(attr.index, int) and attr.index > 0:
+                mx = self._elements[idx].max_count
+                if 0 <= mx <= attr.index:
+                    raise SiddhiQLError(
+                        f"{alias}[{attr.index}] can never exist: the "
+                        f"element absorbs at most {mx} event(s)"
+                    )
+                if attr.index >= 16:
+                    raise SiddhiQLError(
+                        f"indexed capture {alias}[{attr.index}] exceeds "
+                        "the supported index range (< 16)"
+                    )
+                which = f"idx{attr.index}"
             else:
                 raise SiddhiQLError(
-                    f"indexed capture {alias}[{attr.index}] is not supported; "
-                    "use [0] or [last]"
+                    f"indexed capture {alias}[{attr.index!r}] is not "
+                    "supported; use a non-negative index or [last]"
                 )
             if attr.name not in schema:
                 raise SiddhiQLError(
@@ -274,6 +288,9 @@ class _PatternSpec:
     # per projection: every (elem, col) capture pair its expression reads
     # (late-materialization eligibility analysis)
     proj_ref_pairs: Tuple[Tuple[Tuple[int, str], ...], ...] = ()
+    # per projection: (elem, col, k) for each s[k>=1] indexed reference —
+    # decodes None when the element absorbed fewer than k+1 events
+    proj_idx_refs: Tuple[Tuple[Tuple[int, str, int], ...], ...] = ()
 
     @property
     def n_elements(self) -> int:
@@ -453,7 +470,9 @@ def _build_spec(
         proj_fns.append(ce.fn)
         out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
         src = None
-        if isinstance(item.expr, ast.Attr):
+        if isinstance(item.expr, ast.Attr) and item.expr.index in (
+            None, 0, "last",
+        ):
             a = item.expr
             if a.qualifier is not None:
                 info = cap_resolver._by_alias.get(a.qualifier)

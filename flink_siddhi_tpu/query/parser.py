@@ -286,10 +286,36 @@ def _parse_join(ts: TokenStream, left: ast.StreamInput) -> ast.JoinInput:
     return ast.JoinInput(left, right, join_type, on, within)
 
 
-def _parse_pattern(ts: TokenStream) -> ast.PatternInput:
-    every = bool(ts.accept_keyword("every"))
-    elements: List[ast.PatternElement] = list(_parse_pattern_step(ts))
-    kind: Optional[str] = None
+def _paren_wraps_chain(ts: TokenStream) -> bool:
+    """Lookahead from a '(' at the cursor: does it wrap a connector
+    chain (``every (A -> B)`` — the canonical Siddhi grouping) rather
+    than a logical and/or step? Connectors at nesting depth 1 decide."""
+    depth = 0
+    i = 0
+    while True:
+        tok = ts.peek(i)
+        if tok.kind == "EOF":
+            return False
+        if tok.kind == "OP":
+            if tok.text in ("(", "["):
+                depth += 1
+            elif tok.text in (")", "]"):
+                depth -= 1
+                if depth == 0:
+                    return False
+            elif depth == 1 and tok.text in ("->", ","):
+                return True
+        i += 1
+
+
+def _parse_chain(
+    ts: TokenStream,
+    elements: Optional[List[ast.PatternElement]] = None,
+    kind: Optional[str] = None,
+) -> Tuple[List[ast.PatternElement], Optional[str]]:
+    """Parse (or continue) a connector chain of pattern steps."""
+    if elements is None:
+        elements = list(_parse_pattern_step(ts))
     while True:
         if ts.at_op("->"):
             connector = "pattern"
@@ -310,6 +336,21 @@ def _parse_pattern(ts: TokenStream) -> ast.PatternInput:
                 "'every' on a non-first pattern element is not supported"
             )
         elements.extend(_parse_pattern_step(ts))
+    return elements, kind
+
+
+def _parse_pattern(ts: TokenStream) -> ast.PatternInput:
+    every = bool(ts.accept_keyword("every"))
+    elements: Optional[List[ast.PatternElement]] = None
+    kind: Optional[str] = None
+    if every and ts.at_op("(") and _paren_wraps_chain(ts):
+        # `every (A -> B)`: for leading-every all-(1,1) chains the
+        # grouping is semantically transparent (every occurrence of the
+        # first element starts an instance), so the parens just scope
+        ts.advance()
+        elements, kind = _parse_chain(ts)
+        ts.expect_op(")")
+    elements, kind = _parse_chain(ts, elements, kind)
     within = None
     if ts.accept_keyword("within"):
         within = _parse_time_duration(ts)
