@@ -250,6 +250,9 @@ class _PatternSpec:
     elements: Tuple[ast.PatternElement, ...]
     kind: str  # 'pattern' | 'sequence'
     every: bool
+    # grouped `every (A -> B)`: restart only after a complete occurrence
+    # (single instance in flight), vs ungrouped every's start-at-every-A
+    every_grouped: bool
     within: Optional[int]
     pred_fns: List[Callable[[ColumnEnv], jnp.ndarray]]
     stream_code_of: List[int]
@@ -291,6 +294,9 @@ class _PatternSpec:
     # per projection: (elem, col, k) for each s[k>=1] indexed reference —
     # decodes None when the element absorbed fewer than k+1 events
     proj_idx_refs: Tuple[Tuple[Tuple[int, str, int], ...], ...] = ()
+    # per element: (elem, col, k) indexed refs its cross filter reads — the
+    # filter can only hold once the referenced element absorbed > k events
+    cross_idx_refs: Tuple[Tuple[Tuple[int, str, int], ...], ...] = ()
 
     @property
     def n_elements(self) -> int:
@@ -387,13 +393,29 @@ def _build_spec(
     pred_fns: List[Optional[Callable]] = []
     cross_fns: List[Optional[Callable]] = []
     cross_refs: List[Tuple[int, ...]] = []
+    cross_idx_refs: List[Tuple[Tuple[int, str, int], ...]] = []
     evt_keys: List[str] = []
+
+    def _indexed_refs(expr) -> Tuple[Tuple[int, str, int], ...]:
+        """(elem, col, k) for every s[k>=1] reference in the expression."""
+        out = set()
+        for a in ast.iter_attrs(expr):
+            if (
+                a.qualifier is not None
+                and a.qualifier in alias_idx
+                and isinstance(a.index, int)
+                and a.index >= 1
+            ):
+                out.add((alias_idx[a.qualifier], a.name, a.index))
+        return tuple(sorted(out))
+
     for i, el in enumerate(inp.elements):
         schema = schemas[el.stream_id]
         if el.filter is None:
             pred_fns.append(None)
             cross_fns.append(None)
             cross_refs.append(())
+            cross_idx_refs.append(())
             continue
         foreign = {
             a.qualifier
@@ -414,6 +436,7 @@ def _build_spec(
             pred_fns.append(ce.fn)
             cross_fns.append(None)
             cross_refs.append(())
+            cross_idx_refs.append(())
             continue
         if el.negated:
             raise SiddhiQLError(
@@ -429,6 +452,7 @@ def _build_spec(
         pred_fns.append(None)  # event-only mask = stream gate
         cross_fns.append(ce.fn)
         cross_refs.append(tuple(sorted(alias_idx[a] for a in foreign)))
+        cross_idx_refs.append(_indexed_refs(el.filter))
     if q.selector.is_star:
         raise SiddhiQLError(
             "select * is not valid for pattern queries; name the captures"
@@ -459,6 +483,7 @@ def _build_spec(
     proj_fns, out_fields, proj_srcs = [], [], []
     proj_or_deps: List[Tuple[int, ...]] = []
     proj_ref_pairs: List[Tuple[Tuple[int, str], ...]] = []
+    proj_idx_refs: List[Tuple[Tuple[int, str, int], ...]] = []
     for item in q.selector.items:
         if ast.contains_aggregate(item.expr):
             raise SiddhiQLError(
@@ -466,6 +491,7 @@ def _build_spec(
             )
         proj_or_deps.append(_or_deps(item.expr))
         proj_ref_pairs.append(_item_pairs(item.expr))
+        proj_idx_refs.append(_indexed_refs(item.expr))
         ce = compile_expr(item.expr, cap_resolver, extensions)
         proj_fns.append(ce.fn)
         out_fields.append(OutputField(item.output_name(), ce.atype, ce.table))
@@ -491,6 +517,14 @@ def _build_spec(
         raise SiddhiQLError("having is not valid on pattern queries")
 
     captures = list(cap_resolver.referenced)
+    for elem, _col, which in captures:
+        if which.startswith("idx") and any(
+            elem in mem and len(mem) > 1 for mem in groups
+        ):
+            raise SiddhiQLError(
+                f"indexed capture on {inp.elements[elem].alias!r} is not "
+                "supported: 'and'/'or' group members match exactly once"
+            )
     cap_dtype, cap_src = {}, {}
     for elem, col, _which in captures:
         el = inp.elements[elem]
@@ -502,6 +536,7 @@ def _build_spec(
         elements=inp.elements,
         kind=inp.kind,
         every=inp.every_,
+        every_grouped=inp.every_grouped,
         within=inp.within,
         pred_fns=pred_fns,
         stream_code_of=[stream_codes[el.stream_id] for el in inp.elements],
@@ -519,6 +554,8 @@ def _build_spec(
         group_ops=tuple(group_ops),
         proj_or_deps=tuple(proj_or_deps),
         proj_ref_pairs=tuple(proj_ref_pairs),
+        proj_idx_refs=tuple(proj_idx_refs),
+        cross_idx_refs=tuple(cross_idx_refs),
     )
 
 
@@ -533,6 +570,17 @@ def _cap_pairs(spec: _PatternSpec) -> List[Tuple[int, str]]:
 def _skey(prefix: str, elem: int, col: str) -> str:
     """Flat string key for state dicts (jit pytrees need uniform key types)."""
     return f"{prefix}:{elem}:{col}"
+
+
+def _idx_caps(spec: _PatternSpec) -> List[Tuple[int, str, int]]:
+    """Distinct (elem, col, k) indexed captures (``s[k>=1].col``), in a
+    deterministic order that doubles as the validity-bit layout on the
+    mbits wire row (bit K + position)."""
+    seen = set()
+    for elem, col, which in spec.captures:
+        if which.startswith("idx"):
+            seen.add((elem, col, int(which[3:])))
+    return sorted(seen)
 
 
 def _element_preds(spec: _PatternSpec, tape, enabled) -> List[jnp.ndarray]:
@@ -1748,8 +1796,9 @@ class SlotNFAArtifact:
     @property
     def _needs_mbits(self) -> bool:
         """Projections over 'or'-group members need the emitting slot's
-        matched bitmask on the wire so the unfired member decodes None."""
-        return any(self.spec.proj_or_deps)
+        matched bitmask on the wire so the unfired member decodes None;
+        indexed captures ride their validity bits on the same word."""
+        return any(self.spec.proj_or_deps) or bool(self._idx)
 
     @property
     def acc_rows(self) -> int:
@@ -1771,21 +1820,31 @@ class SlotNFAArtifact:
         # the mbits row must follow decode's row permutation
         mbits = np.asarray(block[1 + C, :n])[emission_order(block[0], n)]
         rows = schema.decode_packed_block(n, block[: 1 + C])
-        deps = self.spec.proj_or_deps
+        deps = self.spec.proj_or_deps or ((),) * C
+        idx_refs = self.spec.proj_idx_refs or ((),) * C
+        K = self.spec.n_elements
+        bit_of = {cap: K + j for j, cap in enumerate(self._idx)}
         out = []
         for i, (ts_v, row) in enumerate(rows):
             mb = int(mbits[i])
             row = tuple(
                 None
-                if d and any(not (mb >> e) & 1 for e in d)
+                if (d and any(not (mb >> e) & 1 for e in d))
+                or any(not (mb >> bit_of[r]) & 1 for r in ir)
                 else v
-                for v, d in zip(row, deps)
+                for v, d, ir in zip(row, deps, idx_refs)
             )
             out.append((ts_v, row))
         return [(schema, out)]
 
     def __post_init__(self):
         spec = self.spec
+        self._idx = _idx_caps(spec)
+        if spec.n_elements + len(self._idx) > 31:
+            raise SiddhiQLError(
+                "too many pattern elements + indexed captures for the "
+                "match-bitmask wire word (limit 31)"
+            )
         last = spec.elements[-1]
         if spec.kind == "pattern" and last.max_count < 0:
             raise SiddhiQLError(
@@ -1845,6 +1904,10 @@ class SlotNFAArtifact:
             dt = self.spec.cap_dtype[pair]
             state[_skey("first", *pair)] = jnp.zeros(S, dtype=dt)
             state[_skey("last", *pair)] = jnp.zeros(S, dtype=dt)
+        for elem, col, k in self._idx:
+            dt = self.spec.cap_dtype[(elem, col)]
+            state[_skey(f"idx{k}", elem, col)] = jnp.zeros(S, dtype=dt)
+            state[_skey(f"idxv{k}", elem, col)] = jnp.zeros(S, dtype=bool)
         return state
 
     # -- transition helpers (all vectorized over slots) ---------------------
@@ -1920,6 +1983,11 @@ class SlotNFAArtifact:
                             ok = ok & (
                                 (st["matched"] & ref_mask) == ref_mask
                             )
+                        # indexed refs additionally require the referenced
+                        # element to have absorbed > kk events
+                        if spec.cross_idx_refs:
+                            for e2, c2, k2 in spec.cross_idx_refs[k]:
+                                ok = ok & st[_skey(f"idxv{k2}", e2, c2)]
                         cross_ok[k] = ok
 
             # per-slot effective member predicates, then per-GROUP masks:
@@ -2042,6 +2110,22 @@ class SlotNFAArtifact:
                     took, caps_e[_skey("src", *pair)], l0
                 )
 
+            # indexed captures: the (k+1)-th event the element absorbs —
+            # fire via absorb leaves new_count == old count + 1; fire via
+            # advance/arm resets new_count to 1, so k >= 1 never writes
+            new_idx: Dict[Tuple[int, str, int], jnp.ndarray] = {}
+            new_idxv: Dict[Tuple[int, str, int], jnp.ndarray] = {}
+            for elem, col, k in self._idx:
+                wr = fire[elem] & (new_count == jnp.int32(k + 1))
+                new_idx[(elem, col, k)] = jnp.where(
+                    wr,
+                    caps_e[_skey("src", elem, col)],
+                    st[_skey(f"idx{k}", elem, col)],
+                )
+                new_idxv[(elem, col, k)] = (
+                    st[_skey(f"idxv{k}", elem, col)] | wr
+                )
+
             # emissions: scatter completed slots into the match buffer
             emit_ts = jnp.where(
                 emit_on_break, st["last"], ts_e
@@ -2052,16 +2136,22 @@ class SlotNFAArtifact:
             new_buf = dict(buf)
             new_buf["ts"] = buf["ts"].at[pos].set(emit_ts, mode="drop")
             if self._needs_mbits:
+                wire = new_matched
+                for j, cap in enumerate(self._idx):
+                    wire = wire | jnp.where(
+                        new_idxv[cap], jnp.int32(1 << (K + j)), 0
+                    )
                 new_buf["mbits"] = buf["mbits"].at[pos].set(
-                    new_matched, mode="drop"
+                    wire, mode="drop"
                 )
             for elem, col, which in spec.captures:
                 bkey = _skey(which, elem, col)
-                vals = (
-                    new_first[(elem, col)]
-                    if which == "first"
-                    else new_lastc[(elem, col)]
-                )
+                if which == "first":
+                    vals = new_first[(elem, col)]
+                elif which == "last":
+                    vals = new_lastc[(elem, col)]
+                else:
+                    vals = new_idx[(elem, col, int(which[3:]))]
                 new_buf[bkey] = buf[bkey].at[pos].set(vals, mode="drop")
             new_buf["n"] = jnp.minimum(
                 n0 + emit.sum().astype(jnp.int32), M
@@ -2089,6 +2179,15 @@ class SlotNFAArtifact:
             if spec.every:
                 any_done = st["done"]
                 want_start = m0 & valid_e
+                if spec.every_grouped:
+                    # grouped every: one instance in flight; restart only
+                    # once no partial is active (complete/killed/expired).
+                    # The completing event itself must NOT arm the next
+                    # occurrence (Siddhi: restart with subsequent events),
+                    # so a same-event emit also blocks arming.
+                    want_start = (
+                        want_start & ~active2.any() & ~emit.any()
+                    )
             else:
                 any_done = st["done"] | emit.any()
                 want_start = m0 & valid_e & ~started_now & ~any_done
@@ -2122,6 +2221,10 @@ class SlotNFAArtifact:
                         caps_e[_skey("src", *pair)],
                         new_lastc[pair],
                     )
+            for cap in self._idx:
+                # a re-armed slot starts a fresh element run: its indexed
+                # captures from the previous occupant are invalid
+                new_idxv[cap] = new_idxv[cap] & ~one_hot
             # a start-element event that fully satisfies a 1-element pattern
             # (K==1, max 1) completes immediately on the next event's break /
             # absorb logic; K==1 plain patterns use the chain engine anyway.
@@ -2142,6 +2245,13 @@ class SlotNFAArtifact:
             for pair in pairs:
                 new_st[_skey("first", *pair)] = new_first[pair]
                 new_st[_skey("last", *pair)] = new_lastc[pair]
+            for elem, col, k in self._idx:
+                new_st[_skey(f"idx{k}", elem, col)] = new_idx[
+                    (elem, col, k)
+                ]
+                new_st[_skey(f"idxv{k}", elem, col)] = new_idxv[
+                    (elem, col, k)
+                ]
             return (new_st, new_buf), None
 
         xcols = {_skey("src", *pair): cap_srcs[pair] for pair in pairs}
@@ -2220,7 +2330,8 @@ def compile_pattern_query(
     config = config or DEFAULT_CONFIG
     spec = _build_spec(q, schemas, stream_codes, extensions)
     out_schema = OutputSchema(spec.output_stream, spec.out_fields)
-    if _is_chain(spec) and not spec.has_cross:
+    # grouped every needs per-partial arming state -> slot engine
+    if _is_chain(spec) and not spec.has_cross and not spec.every_grouped:
         return ChainPatternArtifact(
             name=name, spec=spec, output_schema=out_schema,
             pool=config.pattern_pool,

@@ -206,6 +206,10 @@ class PatternInput:
     kind: str  # 'pattern' | 'sequence'
     every_: bool = False
     within: Optional[int] = None  # ms
+    # `every (A -> B)`: grouped-every restarts matching only after each
+    # COMPLETE occurrence (one instance in flight), while ungrouped
+    # `every A -> B` starts an instance at every first-element event
+    every_grouped: bool = False
 
 
 InputClause = Union[StreamInput, JoinInput, PatternInput]

@@ -343,19 +343,27 @@ def _parse_pattern(ts: TokenStream) -> ast.PatternInput:
     every = bool(ts.accept_keyword("every"))
     elements: Optional[List[ast.PatternElement]] = None
     kind: Optional[str] = None
+    grouped = False
     if every and ts.at_op("(") and _paren_wraps_chain(ts):
-        # `every (A -> B)`: for leading-every all-(1,1) chains the
-        # grouping is semantically transparent (every occurrence of the
-        # first element starts an instance), so the parens just scope
+        # `every (A -> B)`: grouped-every restarts matching only after a
+        # complete occurrence (Siddhi: one instance in flight), unlike
+        # `every A -> B` which starts an instance at every A
+        grouped = True
         ts.advance()
         elements, kind = _parse_chain(ts)
         ts.expect_op(")")
+        if ts.at_op("->") or ts.at_op(","):
+            ts.error(
+                "'every (...)' followed by further pattern steps is not "
+                "supported; the restart unit must be the whole pattern"
+            )
     elements, kind = _parse_chain(ts, elements, kind)
     within = None
     if ts.accept_keyword("within"):
         within = _parse_time_duration(ts)
     return ast.PatternInput(
-        tuple(elements), kind or "pattern", every, within
+        tuple(elements), kind or "pattern", every, within,
+        every_grouped=grouped,
     )
 
 

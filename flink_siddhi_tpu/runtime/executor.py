@@ -374,16 +374,43 @@ class Job:
                         plan, host_id=host_id, slot=slot
                     )
                     self._create_runtime(wrapped, admit0)
+                    if wrapped.plan_id != host_id:
+                        # wrap fell through (template underivable / id
+                        # collision): the host runtime does not exist, so
+                        # the remaining members cannot fold into it —
+                        # restore them as standalone runtimes instead of
+                        # letting _fold_into abort the whole replay
+                        _LOG.warning(
+                            "dynamic group %r could not be re-formed; "
+                            "restoring its members as standalone plans",
+                            host_id,
+                        )
+                        self._folded.pop(pid, None)
+                        self._folded_enabled.pop(pid, None)
+                        for s2, p2 in members:
+                            if s2 <= slot or p2 not in dynamic_cql:
+                                continue
+                            self.add_plan(
+                                self._plan_compiler(dynamic_cql[p2], p2)
+                            )
+                        break
                     first = False
                 else:
                     from ..compiler.nfa import chain_template_of
 
-                    self._fold_into(
-                        host_id, plan, slot,
-                        chain_template_of(
-                            plan.artifacts[0], plan.spec.column_types
-                        ),
+                    t = chain_template_of(
+                        plan.artifacts[0], plan.spec.column_types
                     )
+                    if t is None:
+                        _LOG.warning(
+                            "dynamic plan %r no longer folds into group "
+                            "%r; restoring it standalone", pid, host_id,
+                        )
+                        self._folded.pop(pid, None)
+                        self._folded_enabled.pop(pid, None)
+                        self.add_plan(plan)
+                        continue
+                    self._fold_into(host_id, plan, slot, t)
         for pid, cql in dynamic_cql.items():
             if pid not in folded and pid not in self._plans:
                 self.add_plan(self._plan_compiler(cql, pid))

@@ -297,3 +297,43 @@ def test_direct_dynamic_add_checkpoint_needs_cql(tmp_path):
     src3.emit(Rec(2, 0.0, 2000), 2000)
     job3.run_cycle()
     assert job3.results("out_q1") == [(1000, 2000)]
+
+
+def test_replay_skips_member_with_missing_cql():
+    # ADVICE round-2: a snapshot whose FIRST (lowest-slot) group member
+    # has no recorded CQL must not abort the whole replay — the next
+    # member becomes the group host and the rest still fold in
+    src = CallbackSource("S", SCHEMA)
+    control = ControlQueueSource()
+    job = Job(
+        [], [src], batch_size=64, time_mode="processing",
+        control_sources=[control],
+        plan_compiler=lambda cql, pid: compile_plan(
+            cql, {"S": SCHEMA}, plan_id=pid
+        ),
+    )
+    b = MetadataControlEvent.builder()
+    pids = [
+        b.add_execution_plan(chain_cql(n, a, a + 1))
+        for n, a in (("a", 1), ("b", 2), ("c", 3))
+    ]
+    control.push(b.build())
+    job.run_cycle()
+    assert len(job._folded) == 3
+
+    cqls = dict(job._dynamic_cql)
+    first_pid = min(job._folded, key=lambda p: job._folded[p][1])
+    del cqls[first_pid]
+    src2 = CallbackSource("S", SCHEMA)
+    job2 = make_job(src2)
+    job2._replay_dynamic(
+        cqls, dict(job._folded), {p: True for p in job._folded}
+    )
+    survivors = set(pids) - {first_pid}
+    assert set(job2.plan_ids) == survivors
+    # the surviving members still match end-to-end
+    for ts, rec in [(1000, Rec(2, 0.0, 1000)), (2000, Rec(3, 0.0, 2000))]:
+        src2.emit(rec, ts)
+    job2.run_cycle()
+    name_b = [n for n, p in zip("abc", pids) if p in survivors][0]
+    assert job2.results(f"out_{name_b}") == [(1000, 2000)]
