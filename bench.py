@@ -136,10 +136,17 @@ def build_job(config, n_events, batch):
     plan = compile_plan(
         cql, {"inputStream": schema}, plan_id="bench", config=ecfg
     )
-    return Job(
+    job = Job(
         [plan], [src], batch_size=batch, time_mode="processing",
         retain_results=False,
     )
+    # latency/throughput trade-off knobs (defaults tuned on TPU v5e-1)
+    job.max_inflight_cycles = int(os.environ.get("BENCH_INFLIGHT", 4))
+    job.drain_interval_ms = float(
+        os.environ.get("BENCH_DRAIN_MS", 250.0)
+    )
+    job.prewarm_drains()
+    return job
 
 
 def main():

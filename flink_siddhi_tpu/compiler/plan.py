@@ -81,6 +81,22 @@ class CompiledPlan:
                 out[a.name] = grow(states[a.name])
         return out
 
+    @property
+    def has_flush(self) -> bool:
+        """Whether end-of-stream flush can do ANY work. When False the
+        host runtime skips the flush program entirely — on a tunneled
+        device even an empty-output flush costs several fixed-latency
+        fetches."""
+        for a in self.artifacts:
+            if getattr(a, "flush_tables", None) is not None:
+                return True
+            if getattr(a, "flush", None) is None:
+                continue
+            noop = getattr(a, "flush_is_noop", None)
+            if noop is None or not noop:
+                return True
+        return False
+
     def flush(self, states: Dict) -> Tuple[Dict, Dict]:
         """End-of-stream flush (timeBatch final windows etc.). Artifacts
         writing to tables flush THROUGH the table state (windowed table
@@ -214,12 +230,17 @@ class CompiledPlan:
             n = jnp.minimum(n, jnp.int32(v))
             fits = ns[ai] + jnp.int32(v) <= cap
             off = jnp.where(fits, ns[ai], 0)
-            sl = slice(row0, row0 + block.shape[0])
-            slab = buf[sl]
-            updated = jax.lax.dynamic_update_slice(
-                slab, block, (jnp.int32(0), off)
+            # O(v) append: read the current v-wide region, select, write
+            # it back — never materializing the whole capacity-wide slab
+            # (donation makes the dynamic_update_slice in-place, so the
+            # per-step traffic is block-sized, not accumulator-sized)
+            cur = jax.lax.dynamic_slice(
+                buf, (row0, off), (block.shape[0], v)
             )
-            buf = buf.at[sl].set(jnp.where(fits, updated, slab))
+            newblk = jnp.where(fits, block, cur)
+            buf = jax.lax.dynamic_update_slice(
+                buf, newblk, (row0, off)
+            )
             new_n.append(jnp.where(fits, ns[ai] + n, ns[ai]))
             new_over.append(
                 over[ai] + jnp.where(fits, n_true - n, n_true)

@@ -1076,6 +1076,10 @@ class BatchWindowArtifact:
             ).astype(state[f"last{j}"].dtype)
         return new_state, (count, out_ts, out_cols)
 
+    @property
+    def flush_is_noop(self) -> bool:
+        return self.window_mode != "timeBatch"
+
     def flush(self, state: Dict) -> Tuple[Dict, Tuple]:
         """End-of-stream flush of the carried incomplete window (timeBatch
         semantics: the final timer fires; lengthBatch does not flush partial

@@ -236,7 +236,13 @@ class ShardedJob(Job):
             ),
         )
 
-    def _drain_plan(self, rt: _PlanRuntime, min_fill: float = 0.0) -> None:
+    def drain_outputs(self, wait: bool = True) -> None:
+        # sharded drains stay synchronous for now (the wait=False fast
+        # path is a single-device pipeline optimization)
+        for rt in self._plans.values():
+            self._drain_plan(rt)
+
+    def _drain_plan(self, rt: _PlanRuntime) -> None:
         if rt.acc is None or not rt.plan.artifacts:
             return
         meta = np.asarray(rt.acc["meta"])  # (shards, 2, A) — one fetch
@@ -247,14 +253,12 @@ class ShardedJob(Job):
         if total > already:  # log new drops once, not per check
             _LOG.warning(
                 "%s: %d emissions dropped across shards (accumulator "
-                "full; raise CompiledPlan.ACC_BUDGET_BYTES or drain "
+                "full; raise EngineConfig.acc_budget_bytes or drain "
                 "more often)", rt.plan.plan_id, total - already,
             )
         rt._overflow_seen = overflow
         max_n = int(counts.max()) if counts.size else 0
         if max_n == 0:
-            return
-        if min_fill > 0 and max_n < min_fill * rt.plan.acc_capacity():
             return
         # bucketed fetch width: stable slice shapes (see Job._drain_plan)
         fetch_n = min(bucket_size(max_n, minimum=1024),
@@ -288,6 +292,8 @@ class ShardedJob(Job):
     def flush(self) -> None:
         for rt in self._plans.values():
             self._drain_plan(rt)
+            if not rt.plan.has_flush:
+                continue
             host = jax.device_get(rt.states)
             new_shards = []
             for s in range(self.n_shards):

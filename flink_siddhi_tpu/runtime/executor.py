@@ -17,6 +17,7 @@ from __future__ import annotations
 import heapq
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -46,12 +47,24 @@ class _PlanRuntime:
     acc: Dict = None  # device-side output accumulator (None: fetch-per-cycle)
     wire_kinds: Dict = None  # sticky per-column wire widths (build_wire_tape)
     enabled: bool = True
+    # NOTE: backpressure is ticket-based (see ``tickets`` below); there is
+    # no per-cycle sawtooth sync anymore
     # sticky tape capacity: once a capacity is compiled, smaller batches
     # (e.g. the end-of-stream tail) pad up to it instead of bucketing down
     # — a mid-run capacity change costs a whole new XLA executable
     tape_capacity: int = 0
     flush_warm: object = None  # background flush-precompile future
-    inflight: int = 0  # dispatched cycles since the last device sync
+    # sliding-window backpressure: state leaves of dispatched cycles;
+    # the oldest is waited on once the window is full, so the device
+    # stays <= max_inflight_cycles behind without sawtooth stalls
+    tickets: deque = field(default_factory=deque)
+    # async drain pipeline: swapped-out accumulators whose meta/data
+    # fetches are in flight (see Job._drain_request/_drain_poll)
+    drain_q: deque = field(default_factory=deque)
+    # predicted drain width (bucketed): the data slice is dispatched at
+    # request time at this width so its compute is done before the fetch
+    # thread reads it — a misprediction pays one extra slice round trip
+    fetch_width: int = 1024
 
 
 class _LazyRing:
@@ -64,40 +77,47 @@ class _LazyRing:
     other engine cap."""
 
     def __init__(self, budget_bytes: int = 256 << 20) -> None:
+        import threading
+
         self.starts: List[int] = []
         self.lens: List[int] = []
         self.cols: List[Dict[str, np.ndarray]] = []
         self.bytes = 0
         self.budget = budget_bytes
         self.missed = 0
+        # push happens on the run-loop thread, lookup on the drain fetch
+        # thread (decode moved off the hot loop) — both are short
+        self._lock = threading.Lock()
 
     def push(self, start: int, cols: Dict[str, np.ndarray]) -> None:
-        n = len(next(iter(cols.values()))) if cols else 0
-        self.starts.append(start)
-        self.lens.append(n)
-        self.cols.append(cols)
-        self.bytes += sum(c.nbytes for c in cols.values())
-        while self.bytes > self.budget and len(self.starts) > 1:
-            old = self.cols.pop(0)
-            self.starts.pop(0)
-            self.lens.pop(0)
-            self.bytes -= sum(c.nbytes for c in old.values())
+        with self._lock:
+            n = len(next(iter(cols.values()))) if cols else 0
+            self.starts.append(start)
+            self.lens.append(n)
+            self.cols.append(cols)
+            self.bytes += sum(c.nbytes for c in cols.values())
+            while self.bytes > self.budget and len(self.starts) > 1:
+                old = self.cols.pop(0)
+                self.starts.pop(0)
+                self.lens.pop(0)
+                self.bytes -= sum(c.nbytes for c in old.values())
 
     def lookup(self, key: str, ords) -> List:
-        ords = np.asarray(ords, dtype=np.int64)
-        idx = np.searchsorted(self.starts, ords, side="right") - 1
-        out: List = [None] * len(ords)
-        for j, (o, i) in enumerate(zip(ords.tolist(), idx.tolist())):
-            if i < 0:
-                self.missed += 1
-                continue
-            off = o - self.starts[i]
-            entry = self.cols[i]
-            if off >= self.lens[i] or key not in entry:
-                self.missed += 1
-                continue
-            out[j] = entry[key][off]
-        return out
+        with self._lock:
+            ords = np.asarray(ords, dtype=np.int64)
+            idx = np.searchsorted(self.starts, ords, side="right") - 1
+            out: List = [None] * len(ords)
+            for j, (o, i) in enumerate(zip(ords.tolist(), idx.tolist())):
+                if i < 0:
+                    self.missed += 1
+                    continue
+                off = o - self.starts[i]
+                entry = self.cols[i]
+                if off >= self.lens[i] or key not in entry:
+                    self.missed += 1
+                    continue
+                out[j] = entry[key][off]
+            return out
 
 
 class Job:
@@ -519,6 +539,11 @@ class Job:
         incomplete window out)."""
         for rt in self._plans.values():
             self._drain_plan(rt)
+            if not rt.plan.has_flush:
+                # statically nothing to flush: skip the program — on a
+                # tunneled device even an empty flush costs several
+                # fixed-latency fetches
+                continue
             rt.states, outputs = self._flush_fn(rt)(rt.states)
             if outputs:
                 self._decode_outputs(
@@ -530,6 +555,21 @@ class Job:
                     ),
                 )
 
+    _noop_jit = None
+
+    @classmethod
+    def _make_ticket(cls, states):
+        """A tiny array whose completion implies the dispatched cycle
+        finished: a fresh (non-donated) jit output derived from the
+        smallest state leaf — safe to hold across cycles."""
+        if cls._noop_jit is None:
+            cls._noop_jit = jax.jit(
+                lambda x: jnp.asarray(x).ravel()[:1] * 0
+            )
+        leaves = jax.tree.leaves(states)
+        leaf = min(leaves, key=lambda x: getattr(x, "size", 1 << 30))
+        return cls._noop_jit(leaf)
+
     @staticmethod
     def _state_sig(states) -> Tuple:
         return tuple(
@@ -539,6 +579,7 @@ class Job:
 
     def _warm_flush(self, rt: _PlanRuntime) -> None:
         """Precompile the end-of-stream flush program in the background:
+        skipped entirely for plans whose flush is statically a no-op.
         its (cached) compile/deserialize costs seconds and would otherwise
         land synchronously inside the final flush() call. Re-armed by
         _step_plan whenever the state shapes change (group-table growth),
@@ -575,42 +616,118 @@ class Job:
                     pass  # fall back to the jit path
         return rt.jitted_flush
 
-    def drain_outputs(self, min_fill: float = 0.0) -> None:
-        """Fetch and decode all on-device accumulated emissions (two
-        device->host round-trips per plan). With ``min_fill`` > 0 this is a
-        cheap capacity check: one meta fetch, and the (bigger) data fetch +
-        decode only happens for plans past that fill fraction."""
-        for rt in self._plans.values():
-            self._drain_plan(rt, min_fill)
+    # max swapped-out accumulators whose fetches may be in flight per
+    # plan; past this the oldest is force-completed (each holds the acc
+    # buffer alive until its fetch runs, so the bound caps device HBM).
+    # Deep enough to ride tunnel-bandwidth spikes without stalling the
+    # run loop.
+    MAX_PENDING_DRAINS = 6
 
-    def _drain_plan(self, rt: _PlanRuntime, min_fill: float = 0.0) -> None:
+    def drain_outputs(self, wait: bool = True) -> None:
+        """Surface all on-device accumulated emissions to collectors and
+        sinks. ``wait=True`` (default, and the contract of results() /
+        snapshot()) completes synchronously; ``wait=False`` only STARTS
+        the fetches — the accumulator is swapped for a fresh one and its
+        meta/data transfers overlap with subsequent device cycles, to be
+        decoded by a later poll (run_cycle) or a waiting drain."""
+        for rt in self._plans.values():
+            self._drain_request(rt)
+            self._drain_poll(rt, block=wait)
+
+    def _drain_plan(self, rt: _PlanRuntime) -> None:
+        """Synchronous per-plan drain (checkpoint / removal paths)."""
+        self._drain_request(rt)
+        self._drain_poll(rt, block=True)
+
+    def prewarm_drains(
+        self, widths: Sequence[int] = (1024, 4096, 16384, 65536, 262144)
+    ) -> None:
+        """Compile the bucketed drain-slice programs up front. The first
+        eager slice at a new width costs ~0.7s on a tunneled device;
+        prewarming moves that out of the steady-state loop (benchmarks /
+        latency-sensitive pipelines call this once at startup)."""
+        for rt in self._plans.values():
+            if rt.acc is None or not rt.plan.artifacts:
+                continue
+            cap = rt.plan.acc_capacity()
+            for w in widths:
+                if w <= cap:
+                    rt.acc["buf"][:, :w]  # dispatch compiles; result dropped
+
+    def _drain_request(self, rt: _PlanRuntime) -> None:
+        """Swap the device accumulator for a fresh one and queue the
+        swapped-out copy for fetching. The entry stays in a cheap
+        "waiting for the device" stage until its meta array is_ready —
+        polled for free from the run loop — and only then goes to the
+        fetch thread, which therefore only ever pays transfer time,
+        never a block-on-unfinished-compute stall."""
         if rt.acc is None or not rt.plan.artifacts:
             return
-        meta = np.asarray(rt.acc["meta"])  # fetch 1 (also syncs the queue)
-        counts, overflow = meta[0], meta[1]
-        seen = getattr(rt, "_overflow_seen", None)
-        for ai, a in enumerate(rt.plan.artifacts):
-            already = 0 if seen is None else int(seen[ai])
-            if overflow[ai] > already:  # log new drops once, not per check
-                _LOG.warning(
-                    "%s: %d emissions dropped (accumulator full; raise "
-                    "CompiledPlan.ACC_BUDGET_BYTES or drain more often)",
-                    a.name, int(overflow[ai]) - already,
-                )
-        rt._overflow_seen = overflow
-        max_n = int(counts.max()) if counts.size else 0
-        if max_n == 0:
-            return
-        if min_fill > 0 and max_n < min_fill * rt.plan.acc_capacity():
-            return  # capacity check only: plenty of headroom, keep batching
-        # bucket the fetch width: a distinct slice shape per drain would
-        # compile a fresh eager slice program every time (~1s each on a
-        # tunneled device); bucketing keeps it to a handful of shapes
-        fetch_n = min(bucket_size(max_n, minimum=1024),
-                      rt.plan.acc_capacity())
-        data = np.asarray(rt.acc["buf"][:, :fetch_n])[:, :max_n]  # fetch 2
+        old = rt.acc
         rt.acc = rt.jitted_init_acc()
-        rt._overflow_seen = None  # counters reset with the accumulator
+        width = min(max(rt.fetch_width, 1024), rt.plan.acc_capacity())
+        # dispatch the predicted-width data slice NOW: by the time meta
+        # is ready the slice is computed too, so the fetch thread's
+        # asarray calls pay transfer time only — no compute stall
+        data_dev = old["buf"][:, :width]
+        rt.drain_q.append({"acc": old, "data": data_dev, "width": width})
+        self._advance_ready(rt)
+        if len(rt.drain_q) > self.MAX_PENDING_DRAINS:
+            self._drain_poll(rt, block=True, limit=1)
+
+    def _advance_ready(self, rt: _PlanRuntime) -> None:
+        """Promote waiting entries whose meta and predicted slice are
+        ready to fetch jobs (FIFO: stop at the first not-ready entry)."""
+        for entry in rt.drain_q:
+            if "fut" in entry:
+                continue
+            if not (
+                entry["acc"]["meta"].is_ready()
+                and entry["data"].is_ready()
+            ):
+                break
+            entry["fut"] = self._fetch_pool.submit(
+                self._fetch_acc, rt, entry.pop("acc"),
+                entry.pop("data"), entry.pop("width"),
+            )
+
+    @property
+    def _fetch_pool(self):
+        """One fetch thread per job: FIFO completion order. Fetch AND
+        decode run on this thread (host-side decode state like the lazy
+        ring must be locked — see _LazyRing); sinks still only ever run
+        on the run-loop thread (_drain_poll emits)."""
+        import concurrent.futures
+
+        pool = getattr(self, "_fetch_pool_", None)
+        if pool is None:
+            pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="fst-fetch"
+            )
+            self._fetch_pool_ = pool
+        return pool
+
+    @staticmethod
+    def _fetch_acc(rt: _PlanRuntime, acc: Dict, data_dev, width: int):
+        """Fetch-thread body: both meta and the predicted slice are
+        already computed, so the asarray calls cost transfer time only;
+        decode also happens here so the run loop only emits. Bucketed
+        widths keep the eager slice program count to a handful of shapes
+        (a distinct shape per drain would compile a fresh program every
+        time, ~1s each on a tunneled device)."""
+        meta = np.asarray(acc["meta"])
+        counts, overflow = meta[0], meta[1]
+        max_n = int(counts.max()) if counts.size else 0
+        rt.fetch_width = min(
+            bucket_size(max(max_n, 1), minimum=1024),
+            rt.plan.acc_capacity(),
+        )
+        if max_n == 0:
+            return counts, overflow, None
+        if max_n > width:  # misprediction: pay one extra slice fetch
+            data = np.asarray(acc["buf"][:, :rt.fetch_width])[:, :max_n]
+        else:
+            data = np.asarray(data_dev)[:, :max_n]
         decoded = rt.plan.drain_decode(
             counts, data,
             lookup=(
@@ -619,9 +736,45 @@ class Job:
                 else None
             ),
         )
-        for a in rt.plan.artifacts:
-            for schema, rows in decoded.get(a.name) or []:
-                self._emit_rows(schema, rows)
+        return counts, overflow, decoded
+
+    def _drain_poll(
+        self, rt: _PlanRuntime, block: bool = False, limit: int = 0
+    ) -> None:
+        """Complete finished fetches in FIFO order and emit the decoded
+        rows (decode already happened on the fetch thread) to
+        collectors/sinks. Without ``block`` this never stalls the host."""
+        self._advance_ready(rt)
+        done = 0
+        while rt.drain_q:
+            entry = rt.drain_q[0]
+            if "fut" not in entry:
+                if not block:
+                    return
+                # block path (results/flush/checkpoint): force the wait
+                jax.block_until_ready(entry["acc"]["meta"])
+                jax.block_until_ready(entry["data"])
+                self._advance_ready(rt)
+                entry = rt.drain_q[0]
+            fut = entry["fut"]
+            if not block and not fut.done():
+                return
+            counts, overflow, decoded = fut.result()
+            rt.drain_q.popleft()
+            for ai, a in enumerate(rt.plan.artifacts):
+                if overflow[ai] > 0:
+                    _LOG.warning(
+                        "%s: %d emissions dropped (accumulator full; "
+                        "raise EngineConfig.acc_budget_bytes or drain "
+                        "more often)", a.name, int(overflow[ai]),
+                    )
+            if decoded is not None:
+                for a in rt.plan.artifacts:
+                    for schema, rows in decoded.get(a.name) or []:
+                        self._emit_rows(schema, rows)
+            done += 1
+            if limit and done >= limit:
+                return
 
     def _emit_rows(self, schema, rows) -> None:
         """Shared append-to-collectors/sinks tail for all decode paths."""
@@ -683,25 +836,29 @@ class Job:
                 if rt.enabled:
                     self._step_plan(rt, ready)
             self._cycles_since_drain += 1
+        # advance any in-flight drain fetches (never blocks the host)
+        for rt in self._plans.values():
+            self._drain_poll(rt)
         now = time.monotonic()
         if (
             self.drain_interval_ms is not None
             and (now - self._last_full_drain) * 1000.0
             >= self.drain_interval_ms
         ):
-            # latency-bounding drain: surface accumulated matches to
-            # collectors/sinks even when the buffer is nearly empty —
-            # including on idle cycles (a stalled source must not delay
-            # visibility of matches already produced)
-            self.drain_outputs()
+            # latency-bounding drain: START surfacing accumulated matches
+            # (swap + async fetch riding behind queued device work) even
+            # on idle cycles — a stalled source must not delay visibility
+            # of matches already produced
+            self.drain_outputs(wait=False)
             self._cycles_since_drain = 0
             self._last_full_drain = time.monotonic()
         elif ready and self._cycles_since_drain >= min(
             self.drain_every_cycles,
             min(self._drain_hints.values(), default=self.drain_every_cycles),
         ):
-            # meta-only check; full drain only past half capacity
-            self.drain_outputs(min_fill=0.5)
+            # capacity-bounding swap: resets the accumulator before the
+            # no-overflow horizon, without a host sync
+            self.drain_outputs(wait=False)
             self._cycles_since_drain = 0
         return total
 
@@ -842,28 +999,34 @@ class Job:
         # NO device->host fetch here: emissions append to the on-device
         # accumulator and are drained in bulk (flush/results/periodic check)
         rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, tape)
-        # sawtooth backpressure: every K cycles wait for the device to
-        # catch up to NOW (the current states leaf is not yet donated, so
-        # this is safe); bounds in-flight work without holding references
-        # that would defeat buffer donation
-        rt.inflight = (rt.inflight or 0) + 1
-        if rt.inflight >= self.max_inflight_cycles:
-            jax.block_until_ready(jax.tree.leaves(rt.states)[0])
-            rt.inflight = 0
+        # sliding-window backpressure: a tiny non-donated "ticket" is
+        # derived from the new state each cycle; completed tickets retire
+        # via is_ready polling (free), and only when the device is a full
+        # window behind does the host genuinely block. Holding tickets
+        # (fresh jit outputs) never blocks state-buffer donation.
+        rt.tickets.append(self._make_ticket(rt.states))
+        while rt.tickets and rt.tickets[0].is_ready():
+            rt.tickets.popleft()
+        if len(rt.tickets) > self.max_inflight_cycles:
+            jax.block_until_ready(rt.tickets.popleft())
+            while rt.tickets and rt.tickets[0].is_ready():
+                rt.tickets.popleft()
         self._update_drain_hint(
             plan, tape.capacity, lambda name: rt.states.get(name)
         )
-        if rt.flush_warm is None or (
-            rt.flush_warm[0] != self._state_sig(rt.states)
+        if plan.has_flush and (
+            rt.flush_warm is None
+            or rt.flush_warm[0] != self._state_sig(rt.states)
         ):
             self._warm_flush(rt)
 
     def _update_drain_hint(self, plan, tape_capacity, state_of) -> None:
-        """Capacity-check cadence: each artifact declares its widest
-        per-cycle emission block (joins fan out, patterns carry pools,
-        batch windows flush whole grids) and needs that much headroom to
-        fit, so with checks every k cycles and a >=50%-full drain rule,
-        no overflow requires cap/2 + (k+1)*block <= cap."""
+        """Capacity-bounding swap cadence: each artifact declares its
+        widest per-cycle emission block (joins fan out, patterns carry
+        pools, batch windows flush whole grids). A swap resets the
+        accumulator to empty, so no overflow requires (k+1)*block <= cap;
+        the extra /2 keeps the historical safety margin for in-flight
+        cycles dispatched between the hint check and the swap."""
         block = max(
             (
                 a.emit_block_width(tape_capacity, state_of(a.name))

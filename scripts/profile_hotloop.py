@@ -39,13 +39,29 @@ def main():
     orig_tape = tp.build_wire_tape
     orig_drain = job._drain_plan
 
-    def timed_drain(rt, min_fill=0.0):
+    orig_req, orig_poll = job._drain_request, job._drain_poll
+
+    def timed_drain(rt):
         t = time.perf_counter()
-        r = orig_drain(rt, min_fill)
+        r = orig_drain(rt)
+        timers["drain"] += time.perf_counter() - t
+        return r
+
+    def timed_req(rt):
+        t = time.perf_counter()
+        r = orig_req(rt)
+        timers["drain"] += time.perf_counter() - t
+        return r
+
+    def timed_poll(rt, block=False, limit=0):
+        t = time.perf_counter()
+        r = orig_poll(rt, block=block, limit=limit)
         timers["drain"] += time.perf_counter() - t
         return r
 
     job._drain_plan = timed_drain
+    job._drain_request = timed_req
+    job._drain_poll = timed_poll
 
     def timed_pull():
         t = time.perf_counter(); r = orig_pull(); timers["pull"] += time.perf_counter() - t; return r
@@ -63,9 +79,9 @@ def main():
     rt = list(job._plans.values())[0]
     orig_decode = rt.plan.drain_decode
 
-    def timed_decode(counts, data):
+    def timed_decode(counts, data, **kw):
         t = time.perf_counter()
-        r = orig_decode(counts, data)
+        r = orig_decode(counts, data, **kw)
         timers["decode"] += time.perf_counter() - t
         return r
 
