@@ -47,7 +47,7 @@ AbstractSiddhiOperator.java:127-132).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -583,6 +583,32 @@ def _idx_caps(spec: _PatternSpec) -> List[Tuple[int, str, int]]:
     return sorted(seen)
 
 
+_COMPACT_MIN_E = 4096  # below this, compaction overhead beats the gain
+
+
+def _compact_width(E: int) -> int:
+    """Relevant-event buffer width for chain relevance compaction."""
+    return max(2048, E // 8)
+
+
+def _compact_index(rel, R: int):
+    """Scatter-compact the True positions of ``rel`` (bool[E]) into an
+    ascending index buffer of width R. Returns (idx, cnt, cvalid);
+    positions beyond R are dropped (callers lax.cond on cnt <= R).
+    Shared by the single-chain and stacked-chain compaction paths."""
+    E = int(rel.shape[0])
+    cnt = rel.sum().astype(jnp.int32)
+    cpos = jnp.cumsum(rel.astype(jnp.int32)) - 1
+    dest = jnp.where(rel & (cpos < R), cpos, R)
+    idx = (
+        jnp.zeros(R, dtype=jnp.int32)
+        .at[dest]
+        .set(jnp.arange(E, dtype=jnp.int32), mode="drop")
+    )
+    cvalid = jnp.arange(R) < jnp.minimum(cnt, R)
+    return idx, cnt, cvalid
+
+
 def _element_preds(spec: _PatternSpec, tape, enabled) -> List[jnp.ndarray]:
     """bool[E] match mask per element, fused over the whole batch."""
     env: ColumnEnv = dict(tape.cols)
@@ -1063,18 +1089,10 @@ class ChainPatternArtifact:
         # step ~4x on selective workloads. A lax.cond falls back to the
         # full-width core in the (rare) batch where more than E//8 events
         # are relevant.
-        if E >= 4096:
-            R = max(2048, E // 8)
+        if E >= _COMPACT_MIN_E:
+            R = _compact_width(E)
             rel = preds.any(axis=0) & tape.valid
-            cnt = rel.sum().astype(jnp.int32)
-            cpos = jnp.cumsum(rel.astype(jnp.int32)) - 1
-            dest = jnp.where(rel & (cpos < R), cpos, R)
-            idx = (
-                jnp.zeros(R, dtype=jnp.int32)
-                .at[dest]
-                .set(jnp.arange(E, dtype=jnp.int32), mode="drop")
-            )
-            cvalid = jnp.arange(R) < jnp.minimum(cnt, R)
+            idx, cnt, cvalid = _compact_index(rel, R)
             state, n_matches, packed = jax.lax.cond(
                 cnt <= R,
                 lambda: run(
@@ -1092,6 +1110,178 @@ class ChainPatternArtifact:
         if seen_next is not None:
             state["seen"] = seen_next
         return state, (n_matches, packed)
+
+    # -- segment parallelism (sequence parallelism for CEP) ---------------
+    # The unkeyed-every chain is the one pattern class with no key axis to
+    # shard on; its batch math is already order-parallel, so the stream
+    # itself time-segments across shards: each shard matches its slice,
+    # and partials that survive a segment hop shard-to-shard through the
+    # later segments (lax.ppermute pipeline). Exact results — unlike the
+    # reference, whose random channels make unkeyed matches subtask-local
+    # (DynamicPartitioner.java:53-55).
+
+    @property
+    def supports_segment(self) -> bool:
+        return (
+            self.spec.every
+            and not self.spec.every_grouped
+            and self._tfor_ms() is None
+            and not self.lazy_pairs
+        )
+
+    def _pool_keys(self) -> List[str]:
+        keys = ["active", "step", "start"]
+        for pair in _cap_pairs(self.spec):
+            keys.append(_skey("cap", *pair))
+        return keys
+
+    @staticmethod
+    def _merge_pools(a: Dict, b: Dict, P: int) -> Tuple[Dict, Any]:
+        """Compact two P-row pools into one (oldest first); returns the
+        merged pool and the count of dropped overflow rows."""
+        cat = {
+            k: jnp.concatenate([a[k], b[k]]) for k in a
+        }
+        alive = cat["active"]
+        pos = jnp.cumsum(alive.astype(jnp.int32)) - 1
+        dest = jnp.where(alive & (pos < P), pos, P)
+        out = {
+            k: jnp.zeros(P, dtype=v.dtype).at[dest].set(v, mode="drop")
+            for k, v in cat.items()
+        }
+        dropped = jnp.maximum(
+            alive.sum().astype(jnp.int32) - P, 0
+        )
+        return out, dropped
+
+    def step_segmented(
+        self, state: Dict, tape, axis_name: str
+    ) -> Tuple[Dict, Tuple]:
+        """Sharded step: this shard holds one time-contiguous SEGMENT of
+        the batch. Local fresh starts (plus, on shard 0, the carried
+        pool) advance through the local segment; surviving partials hop
+        rightward shard-by-shard, advancing through each later segment
+        and emitting completions on the shard where they complete. The
+        final survivors land back on shard 0 as the next batch's carried
+        pool."""
+        spec = self.spec
+        E = tape.capacity
+        P = self.pool
+        cfg = self._cfg()
+        C = len(spec.proj_fns)
+        S = jax.lax.axis_size(axis_name)
+        sidx = jax.lax.axis_index(axis_name)
+
+        preds = jnp.stack(_element_preds(spec, tape, state["enabled"]))
+        pairs = _cap_pairs(spec)
+        cap_srcs = {
+            pair: tape.cols[spec.cap_src_key[pair]] for pair in pairs
+        }
+        within_val = jnp.int32(spec.within or 0)
+
+        # only shard 0's carried pool is live (handoff convention)
+        st_in = dict(state)
+        st_in["active"] = state["active"] & (sidx == 0)
+
+        runs = []  # (complete, emit_ts, caps) per run, to pack once
+
+        def run_core(st, preds_m):
+            # within-pruning horizon = the LOCAL segment max (the core's
+            # default): a partial whose deadline reaches into later
+            # segments must survive to hop there — the advance's own
+            # within check still rejects late completions
+            new_st, complete, v_emit_ts, caps = _chain_core(
+                cfg, P, st, preds_m, cap_srcs, within_val,
+                tape.ts, tape.valid, use_pallas=False,
+                tfor_val=jnp.int32(0),
+            )
+            runs.append((complete, v_emit_ts, caps))
+            return new_st
+
+        new_state = run_core(st_in, preds)
+
+        # hop pipeline: residues travel right; starts are disabled (each
+        # event already started an instance on its own segment's run)
+        preds_hop = preds.at[cfg.positive[0]].set(False)
+        trav = {k: new_state[k] for k in self._pool_keys()}
+        term = {k: jnp.zeros_like(v) for k, v in trav.items()}
+        dropped_total = jnp.int32(0)
+        perm = [(s, s + 1) for s in range(S - 1)]
+        is_last = sidx == S - 1
+        # the last shard's own local residue has no later segments to
+        # traverse: bank it now (its hop send would have no receiver)
+        bank0 = dict(trav)
+        bank0["active"] = trav["active"] & is_last
+        term, dropped = self._merge_pools(term, bank0, P)
+        dropped_total = dropped_total + dropped
+        trav["active"] = trav["active"] & ~is_last
+        for _hop in range(max(S - 1, 0)):
+            trav = jax.tree.map(
+                lambda x: jax.lax.ppermute(x, axis_name, perm), trav
+            )
+            hop_st = dict(new_state)
+            hop_st.update(trav)
+            hop_st["done"] = jnp.asarray(False)
+            adv = run_core(hop_st, preds_hop)
+            surv = {k: adv[k] for k in self._pool_keys()}
+            # the last shard banks survivors (they traversed every later
+            # segment); inner shards pass them on. Inactive rows' values
+            # are never read, so gating `active` suffices.
+            bank = dict(surv)
+            bank["active"] = surv["active"] & is_last
+            term, dropped = self._merge_pools(term, bank, P)
+            dropped_total = dropped_total + dropped
+            trav = dict(surv)
+            trav["active"] = surv["active"] & ~is_last
+
+        # survivors return to shard 0 as the next batch's pool
+        if S > 1:
+            term = jax.tree.map(
+                lambda x: jax.lax.ppermute(
+                    x, axis_name, [(S - 1, 0)]
+                ),
+                term,
+            )
+        else:
+            term = {k: new_state[k] for k in self._pool_keys()}
+        for k, v in term.items():
+            new_state[k] = v
+        new_state["overflow"] = (
+            state["overflow"] + dropped_total
+        )
+        new_state["done"] = jnp.asarray(False)
+
+        # pack all runs' completions into ONE emission block
+        complete = jnp.concatenate([r[0] for r in runs])
+        emit_ts = jnp.concatenate([r[1] for r in runs])
+        caps_cat = {
+            pair: jnp.concatenate([r[2][pair] for r in runs])
+            for pair in pairs
+        }
+        W = int(complete.shape[0])
+        n_matches = complete.sum().astype(jnp.int32)
+        pos = jnp.cumsum(complete.astype(jnp.int32)) - 1
+        dest = jnp.where(complete, pos, W)
+        emit_env = _emit_env(
+            spec,
+            {
+                (elem, col, which): caps_cat[(elem, col)]
+                for elem, col, which in spec.captures
+            },
+        )
+        emit_rows = jnp.stack(
+            [_as_i32(emit_ts)]
+            + [
+                _as_i32(jnp.broadcast_to(jnp.asarray(p(emit_env)), (W,)))
+                for p in spec.proj_fns
+            ]
+        )
+        packed = (
+            jnp.zeros((1 + C, W), dtype=jnp.int32)
+            .at[:, dest]
+            .set(emit_rows, mode="drop")
+        )
+        return new_state, (n_matches, packed)
 
     @property
     def wants_lookup(self) -> bool:
@@ -1268,11 +1458,15 @@ class StackedChainArtifact:
             )
         return state
 
+    # query-axis chunk width for the memory-bounded full path: the
+    # vmapped core materializes O(chunk * (P+E) * pairs) intermediates,
+    # so chunking caps peak HBM at ~chunk/Q of the naive all-Q vmap
+    CHUNK_Q = 8
+
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         cfg = self._cfg
         E = tape.capacity
         P = self.pool
-        V = P + E
         Q = len(self.members)
 
         preds = jnp.stack(
@@ -1298,85 +1492,190 @@ class StackedChainArtifact:
         tfor_vec = jnp.asarray(
             [m._tfor_ms() or 0 for m in self.members], dtype=jnp.int32
         )
+        # within/absence horizons always see the full batch (the
+        # compacted path's ts only covers each query's relevant events)
+        bm_full = jnp.max(jnp.where(tape.valid, tape.ts, -_BIG))
 
-        new_state, complete, emit_ts, caps = jax.vmap(
-            lambda st, pr, cs, wv, tv: _chain_core(
-                cfg, P, st, pr, cs, wv, tape.ts, tape.valid,
-                tfor_val=tv,
+        def core_v(st, pr, cs, wv, tv, ts, valid):
+            return _chain_core(
+                cfg, P, st, pr, cs, wv, ts, valid,
+                tfor_val=tv, batch_max=bm_full,
             )
-        )(state, preds, cap_srcs, within_vec, tfor_vec)
 
-        # projections: when every member's column c is the same plain
-        # capture reference (the overwhelmingly common select shape), the
-        # stacked output rows ARE the stacked capture buffers — zero
-        # per-query ops. Otherwise fall back to a per-member eval loop.
-        qid_row = jnp.broadcast_to(
-            jnp.arange(Q, dtype=jnp.int32)[:, None], (Q, V)
-        )
-        n_cols = len(self.members[0].spec.proj_fns)  # noqa: F841  (doc)
-        col_srcs = []
-        uniform = True
-        for c in range(n_cols):
-            srcs = {m.spec.proj_srcs[c] for m in self.members}
-            if len(srcs) == 1 and None not in srcs:
-                col_srcs.append(next(iter(srcs)))
+        def emit_pack(new_state, complete, emit_ts, caps):
+            """Pack per-query completions into the fixed-width emission
+            block; works for any per-query width V_ (compacted or full),
+            so both lax.cond branches return identical shapes."""
+            V_ = int(complete.shape[1])
+            qid_row = jnp.broadcast_to(
+                jnp.arange(Q, dtype=jnp.int32)[:, None], (Q, V_)
+            )
+            # projections: when every member's column c is the same plain
+            # capture reference (the overwhelmingly common select shape),
+            # the stacked output rows ARE the stacked capture buffers —
+            # zero per-query ops. Otherwise per-member eval.
+            col_srcs = []
+            uniform = True
+            for c in range(len(self.members[0].spec.proj_fns)):
+                srcs = {m.spec.proj_srcs[c] for m in self.members}
+                if len(srcs) == 1 and None not in srcs:
+                    col_srcs.append(next(iter(srcs)))
+                else:
+                    uniform = False
+                    break
+            if uniform:
+                stacked_rows = [_as_i32(emit_ts), qid_row] + [
+                    _as_i32(caps[pair]) for pair in col_srcs
+                ]
+                flat_rows = jnp.stack(
+                    [r.reshape(Q * V_) for r in stacked_rows]
+                )
+                R = len(stacked_rows)
             else:
-                uniform = False
-                break
-        if uniform:
-            stacked_rows = [_as_i32(emit_ts), qid_row] + [
-                _as_i32(caps[pair]) for pair in col_srcs
-            ]
-            flat_rows = jnp.stack(
-                [r.reshape(Q * V) for r in stacked_rows]
-            )
-            R = len(stacked_rows)
-        else:
-            rows_per_q = []
-            for qi, m in enumerate(self.members):
-                env = _emit_env(
-                    m.spec,
-                    {
-                        (e, c, w): caps[(e, c)][qi]
-                        for e, c, w in m.spec.captures
-                    },
-                )
-                rows_per_q.append(
-                    jnp.stack(
-                        [
-                            _as_i32(emit_ts[qi]),
-                            jnp.full(V, qi, dtype=jnp.int32),
-                        ]
-                        + [
-                            _as_i32(
-                                jnp.broadcast_to(
-                                    jnp.asarray(p(env)), (V,)
-                                )
-                            )
-                            for p in m.spec.proj_fns
-                        ]
+                rows_per_q = []
+                for qi, m in enumerate(self.members):
+                    env = _emit_env(
+                        m.spec,
+                        {
+                            (e, c, w): caps[(e, c)][qi]
+                            for e, c, w in m.spec.captures
+                        },
                     )
+                    rows_per_q.append(
+                        jnp.stack(
+                            [
+                                _as_i32(emit_ts[qi]),
+                                jnp.full(V_, qi, dtype=jnp.int32),
+                            ]
+                            + [
+                                _as_i32(
+                                    jnp.broadcast_to(
+                                        jnp.asarray(p(env)), (V_,)
+                                    )
+                                )
+                                for p in m.spec.proj_fns
+                            ]
+                        )
+                    )
+                R = rows_per_q[0].shape[0]
+                flat_rows = (
+                    jnp.stack(rows_per_q)
+                    .transpose(1, 0, 2)
+                    .reshape(R, Q * V_)
                 )
-            R = rows_per_q[0].shape[0]
-            flat_rows = (
-                jnp.stack(rows_per_q)
-                .transpose(1, 0, 2)
-                .reshape(R, Q * V)
+            cflat = complete.reshape(Q * V_)
+            n_total = cflat.sum().astype(jnp.int32)
+            out_w = min(
+                Q * (P + E),
+                min(Q, self.out_cap_factor) * E + Q * P,
             )
-        cflat = complete.reshape(Q * V)
-        n_total = cflat.sum().astype(jnp.int32)
-        out_w = min(Q * V, min(Q, self.out_cap_factor) * E + Q * P)
-        pos = jnp.cumsum(cflat.astype(jnp.int32)) - 1
-        dest = jnp.where(cflat & (pos < out_w), pos, out_w)
-        packed = (
-            jnp.zeros((R, out_w), dtype=jnp.int32)
-            .at[:, dest]
-            .set(flat_rows, mode="drop")
-        )
-        n_emitted = jnp.minimum(n_total, jnp.int32(out_w))
-        # matches beyond the emission buffer are genuinely dropped; the
-        # third element feeds the accumulator's drained overflow counter
-        return new_state, (n_emitted, packed, n_total - n_emitted)
+            pos = jnp.cumsum(cflat.astype(jnp.int32)) - 1
+            dest = jnp.where(cflat & (pos < out_w), pos, out_w)
+            packed = (
+                jnp.zeros((R, out_w), dtype=jnp.int32)
+                .at[:, dest]
+                .set(flat_rows, mode="drop")
+            )
+            n_emitted = jnp.minimum(n_total, jnp.int32(out_w))
+            # matches beyond the emission buffer are genuinely dropped;
+            # the third element feeds the drained overflow counter
+            return new_state, (n_emitted, packed, n_total - n_emitted)
+
+        def run_full():
+            """Memory-bounded full-width path: chunk the query axis
+            under lax.map so peak HBM is O(CHUNK_Q * V) instead of
+            O(Q * V)."""
+            ch = min(self.CHUNK_Q, Q)
+            if Q <= ch:
+                out = jax.vmap(
+                    lambda st, pr, cs, wv, tv: core_v(
+                        st, pr, cs, wv, tv, tape.ts, tape.valid
+                    )
+                )(state, preds, cap_srcs, within_vec, tfor_vec)
+                return emit_pack(*out)
+            nc = -(-Q // ch)
+            pad = nc * ch - Q
+
+            def pad_q(x):
+                if pad == 0:
+                    return x
+                return jnp.concatenate(
+                    [x, jnp.zeros((pad,) + x.shape[1:], x.dtype)]
+                )
+
+            def chunked(tree):
+                return jax.tree.map(
+                    lambda x: pad_q(x).reshape(
+                        (nc, ch) + x.shape[1:]
+                    ),
+                    tree,
+                )
+
+            outs = jax.lax.map(
+                lambda args: jax.vmap(
+                    lambda st, pr, cs, wv, tv: core_v(
+                        st, pr, cs, wv, tv, tape.ts, tape.valid
+                    )
+                )(*args),
+                (
+                    chunked(state),
+                    chunked(preds),
+                    chunked(cap_srcs),
+                    chunked(within_vec),
+                    chunked(tfor_vec),
+                ),
+            )
+            unchunk = jax.tree.map(
+                lambda x: x.reshape((nc * ch,) + x.shape[2:])[:Q], outs
+            )
+            return emit_pack(*unchunk)
+
+        # Per-query relevance compaction ('->' chains ignore events that
+        # match none of the query's elements): each query advances over
+        # its own R = E//8 compacted window, cutting the V-sized
+        # pointer-chase gathers AND the per-query intermediates ~8x. One
+        # shared lax.cond falls back to the chunked full path in the
+        # (rare) batch where any query has more than R relevant events.
+        if E >= _COMPACT_MIN_E:
+            Rw = _compact_width(E)
+
+            def compact_one(pr):
+                rel = pr.any(axis=0) & tape.valid
+                idx, cnt, _cv = _compact_index(rel, Rw)
+                return idx, cnt
+
+            idxs, cnts = jax.vmap(compact_one)(preds)  # (Q, Rw), (Q,)
+            cvalid = (
+                jnp.arange(Rw)[None, :]
+                < jnp.minimum(cnts, Rw)[:, None]
+            )  # (Q, Rw)
+
+            def run_compact():
+                ts_c = tape.ts[idxs]  # (Q, Rw)
+                preds_c = (
+                    jnp.take_along_axis(
+                        preds, idxs[:, None, :], axis=2
+                    )
+                    & cvalid[:, None, :]
+                )
+                srcs_c = {
+                    pair: jnp.take_along_axis(arr, idxs, axis=1)
+                    for pair, arr in cap_srcs.items()
+                }
+                out = jax.vmap(
+                    lambda st, pr, cs, wv, tv, ts, vd: core_v(
+                        st, pr, cs, wv, tv, ts, vd
+                    )
+                )(
+                    state, preds_c, srcs_c, within_vec, tfor_vec,
+                    ts_c, cvalid,
+                )
+                return emit_pack(*out)
+
+            return jax.lax.cond(
+                jnp.max(cnts) <= Rw, run_compact, run_full
+            )
+        return run_full()
 
     def decode_packed(self, n: int, block: np.ndarray):
         """Split a fetched packed block into per-member (schema, rows)."""
@@ -1792,12 +2091,14 @@ def _decode_qid_block(n: int, block, slot_schemas):
     return out
 
 
-def group_chain_artifacts(artifacts: List) -> List:
+def group_chain_artifacts(artifacts: List, exclude=frozenset()) -> List:
     """Replace runs of structurally-identical ChainPatternArtifacts with
-    one StackedChainArtifact (multi-query parallelism)."""
+    one StackedChainArtifact (multi-query parallelism). Artifacts in
+    ``exclude`` (e.g. chained-query producers, read by name) stay
+    standalone."""
     groups: Dict = {}
     for a in artifacts:
-        if isinstance(a, ChainPatternArtifact):
+        if isinstance(a, ChainPatternArtifact) and a.name not in exclude:
             key = (
                 _ChainCfg.of(a.spec),
                 a.pool,

@@ -29,6 +29,22 @@ from .expr import ExprResolver
 from .select import compile_select
 
 
+@dataclass(frozen=True)
+class ChainedInput:
+    """A query whose input stream is ANOTHER query's output (query
+    chaining, ``insert into mid`` -> ``from mid#window...``): the
+    consumer reads a synthetic tape built from the producer's emissions
+    inside the same device step — the reference's multi-query
+    composition style (package-info.java:19-51), with batch-granular
+    propagation instead of per-event."""
+
+    producer: str  # producing artifact's name
+    stream_id: str  # the intermediate stream
+    code: int  # stream code on the synthetic tape
+    fields: Tuple  # producer OutputSchema fields (name/type order)
+    mode: str  # producer output_mode: buffered | aligned | packed
+
+
 @dataclass
 class CompiledPlan:
     plan_id: str
@@ -39,6 +55,11 @@ class CompiledPlan:
     source_ast: ast.ExecutionPlan
     table_schemas: Dict[str, StreamSchema] = field(default_factory=dict)
     config: EngineConfig = DEFAULT_CONFIG
+    # consumer artifact name -> its chained (internal) input descriptor
+    chained: Dict[str, ChainedInput] = field(default_factory=dict)
+    # artifacts that run time-SEGMENTED across shards (their input
+    # streams route with kind 'segment'; see planner._segmentable_chain)
+    segment_artifacts: frozenset = frozenset()
 
     def init_state(self) -> Dict:
         from .table import init_table_state
@@ -53,18 +74,39 @@ class CompiledPlan:
             }
         return states
 
-    def step(self, states: Dict, tape) -> Tuple[Dict, Dict]:
+    def step(
+        self, states: Dict, tape, axis_name: Optional[str] = None
+    ) -> Tuple[Dict, Dict]:
         """Advance every query one micro-batch. Pure; jit-able. Tables are
         threaded through the artifacts in query order, so later queries see
-        earlier queries' table writes (batch-granular sequencing)."""
+        earlier queries' table writes (batch-granular sequencing); chained
+        consumers read a synthetic tape built from their producer's
+        emissions this same step. Under a sharded mesh (``axis_name``
+        set), segment-parallel artifacts hand partial matches across
+        shards with collectives."""
         new_states = {}
         outputs = {}
         tables = states.get("@tables", {})
         for a in self.artifacts:
+            ci = self.chained.get(a.name)
+            a_tape = (
+                tape
+                if ci is None
+                else _synthetic_tape(outputs[ci.producer], ci)
+            )
             if getattr(a, "uses_tables", False):
-                s, tables, out = a.step_tables(states[a.name], tables, tape)
+                s, tables, out = a.step_tables(
+                    states[a.name], tables, a_tape
+                )
+            elif (
+                axis_name is not None
+                and a.name in self.segment_artifacts
+            ):
+                s, out = a.step_segmented(
+                    states[a.name], a_tape, axis_name
+                )
             else:
-                s, out = a.step(states[a.name], tape)
+                s, out = a.step(states[a.name], a_tape)
             new_states[a.name] = s
             outputs[a.name] = out
         if "@tables" in states:
@@ -170,10 +212,10 @@ class CompiledPlan:
             return jax.lax.bitcast_convert_type(arr, jnp.int32)
         return arr.astype(jnp.int32)
 
-    def step_acc(self, states: Dict, acc: Dict, tape
-                 ) -> Tuple[Dict, Dict]:
+    def step_acc(self, states: Dict, acc: Dict, tape,
+                 axis_name: Optional[str] = None) -> Tuple[Dict, Dict]:
         """step() + on-device append of every emission into ``acc``."""
-        new_states, outputs = self.step(states, tape)
+        new_states, outputs = self.step(states, tape, axis_name)
         buf = acc["buf"]
         cap = buf.shape[1]
         ns, over = acc["meta"][0], acc["meta"][1]
@@ -310,6 +352,47 @@ class CompiledPlan:
         return by_stream
 
 
+def _synthetic_tape(out, ci: ChainedInput):
+    """Producer emissions -> the consumer's input Tape, inside the same
+    jitted step. All three artifact output modes convert losslessly:
+    buffered (n, ts, cols), aligned (mask, ts, cols), packed (n, block
+    with bitcast i32 rows)."""
+    from ..runtime.tape import Tape
+
+    if ci.mode == "aligned":
+        mask, ts, cols = out
+        valid = jnp.asarray(mask)
+        width = int(valid.shape[0])
+        col_vals = [jnp.asarray(c) for c in cols]
+    elif ci.mode == "buffered":
+        n, ts, cols = out
+        width = int(ts.shape[0])
+        valid = jnp.arange(width, dtype=jnp.int32) < n
+        col_vals = [jnp.asarray(c) for c in cols]
+    else:  # packed: ts row + one bitcast i32 row per output column
+        n, block = out[0], out[1]
+        width = int(block.shape[1])
+        ts = block[0]
+        valid = jnp.arange(width, dtype=jnp.int32) < n
+        col_vals = []
+        for i, f in enumerate(ci.fields):
+            row = block[1 + i]
+            dt = np.dtype(f.atype.device_dtype)
+            if dt == np.dtype(np.float32):
+                row = jax.lax.bitcast_convert_type(row, jnp.float32)
+            else:
+                row = row.astype(dt)
+            col_vals.append(row)
+    stream = jnp.where(
+        valid, jnp.int32(ci.code), jnp.int32(-1)
+    )
+    cols_map = {
+        f"{ci.stream_id}.{f.name}": v
+        for f, v in zip(ci.fields, col_vals)
+    }
+    return Tape(jnp.asarray(ts), stream, valid, cols_map)
+
+
 def compile_plan(
     plan_text: str,
     schemas: Dict[str, StreamSchema],
@@ -360,20 +443,51 @@ def compile_plan(
         raise SiddhiQLError("execution plan contains no queries")
 
     # fail fast on undefined inputs (UndefinedStreamException parity,
-    # SiddhiCEP.java:134-140)
+    # SiddhiCEP.java:134-140). A stream produced by an EARLIER query's
+    # `insert into` is a valid chained input (query composition): the
+    # consumer reads the producer's emissions inside the same step.
+    producer_of: Dict[str, int] = {}
+    multi_producer = set()
+    for qi, q in enumerate(parsed.queries):
+        if q.output_stream in producer_of:
+            multi_producer.add(q.output_stream)
+        else:
+            producer_of[q.output_stream] = qi
+
     input_ids: List[str] = []
-    for q in parsed.queries:
+    internal_ids: List[str] = []
+    for qi, q in enumerate(parsed.queries):
         for sid in q.input_stream_ids():
             if sid in table_schemas:
                 continue  # table join side, not a stream input
-            if sid not in all_schemas:
-                raise SiddhiQLError(
-                    f"input stream {sid!r} is not defined or registered"
-                )
-            if sid not in input_ids:
-                input_ids.append(sid)
+            if sid in all_schemas:
+                if sid not in input_ids:
+                    input_ids.append(sid)
+                continue
+            pq = producer_of.get(sid)
+            if pq is not None and pq < qi:
+                if sid in multi_producer:
+                    raise SiddhiQLError(
+                        f"chained stream {sid!r} has multiple producer "
+                        "queries; define it as a stream and union instead"
+                    )
+                if not isinstance(q.input, ast.StreamInput):
+                    raise SiddhiQLError(
+                        f"chained stream {sid!r} can only feed a plain "
+                        "windowed/filtered query (joins and patterns over "
+                        "intermediate streams are not supported yet)"
+                    )
+                if sid not in internal_ids:
+                    internal_ids.append(sid)
+                continue
+            raise SiddhiQLError(
+                f"input stream {sid!r} is not defined or registered"
+            )
 
     stream_codes = {sid: i for i, sid in enumerate(input_ids)}
+    internal_codes = {
+        sid: len(input_ids) + j for j, sid in enumerate(internal_ids)
+    }
     # materialize every field of every input stream (simple and correct;
     # column pruning to referenced fields is a later optimization)
     columns = []
@@ -388,24 +502,70 @@ def compile_plan(
     artifacts = []
     used_names = set()
     encoded = []
+    chained: Dict[str, ChainedInput] = {}
+    merged_codes = {**stream_codes, **internal_codes}
     for qi, q in enumerate(parsed.queries):
         qname = q.name or f"query_{qi}"
         if qname in used_names:
             raise SiddhiQLError(f"duplicate query name {qname!r}")
         used_names.add(qname)
         art = _compile_query(
-            q, qname, all_schemas, stream_codes, extensions,
+            q, qname, all_schemas, merged_codes, extensions,
             table_schemas, config,
         )
+        inp = q.input
+        if (
+            isinstance(inp, ast.StreamInput)
+            and inp.stream_id in internal_codes
+        ):
+            for enc in getattr(art, "encoded_columns", ()):
+                if any(
+                    k.split(".", 1)[0] == inp.stream_id
+                    for k in enc.in_keys
+                ):
+                    raise SiddhiQLError(
+                        f"group by over chained stream {inp.stream_id!r} "
+                        "is not supported yet (group keys are interned "
+                        "host-side but intermediate values exist only on "
+                        "device); group in the upstream query instead"
+                    )
+            producer = artifacts[producer_of[inp.stream_id]]
+            if getattr(producer, "_nullable", False):
+                raise SiddhiQLError(
+                    f"chained stream {inp.stream_id!r} comes from an "
+                    "outer join whose unmatched rows carry nulls; only "
+                    "inner-join / stream producers can be chained"
+                )
+            chained[qname] = ChainedInput(
+                producer=producer.name,
+                stream_id=inp.stream_id,
+                code=internal_codes[inp.stream_id],
+                fields=tuple(producer.output_schema.fields),
+                mode=producer.output_mode,
+            )
         encoded.extend(getattr(art, "encoded_columns", ()))
         artifacts.append(art)
+        # an intermediate stream becomes visible as a schema for the
+        # queries AFTER its producer (validation already ordered this)
+        if (
+            q.output_stream in internal_codes
+            and q.output_stream not in all_schemas
+        ):
+            all_schemas[q.output_stream] = StreamSchema(
+                [(f.name, f.atype) for f in art.output_schema.fields],
+                shared_strings=shared_strings,
+            )
 
     # multi-query parallelism: structurally-identical chain patterns are
     # stacked onto a device query axis and advanced by one vmapped program
-    # (SURVEY.md §2.7-(5))
+    # (SURVEY.md §2.7-(5)). Chained producers must keep their own
+    # artifact (consumers read their outputs by name).
     from .nfa import group_chain_artifacts
 
-    artifacts = group_chain_artifacts(artifacts)
+    artifacts = group_chain_artifacts(
+        artifacts,
+        exclude=frozenset(ci.producer for ci in chained.values()),
+    )
 
     # late materialization (opt-in): a single chain plan whose
     # projection-only columns stay host-side — biggest ingest-bandwidth
@@ -428,6 +588,50 @@ def compile_plan(
     )
 
     partitions = infer_stream_partitions(parsed.queries)
+    # segment partitioning holds only when the consuming artifact can do
+    # the cross-shard handoff (a stacked group, slot NFA, non-every, or
+    # lazy chain cannot); otherwise fall back to owner-pinning
+    def _pattern_streams(a) -> set:
+        spec_a = getattr(a, "spec", None)
+        if spec_a is not None and hasattr(spec_a, "elements"):
+            return {el.stream_id for el in spec_a.elements}
+        members = getattr(a, "members", None)
+        if members:
+            return {
+                el.stream_id for m in members for el in m.spec.elements
+            }
+        return set()
+
+    segment_names = set()
+    seg_capable: set = set()
+    seg_incapable: set = set()
+    for a in artifacts:
+        sids = _pattern_streams(a)
+        if not sids:
+            continue
+        if getattr(a, "supports_segment", False) and hasattr(
+            a, "step_segmented"
+        ):
+            seg_capable |= sids
+        else:
+            seg_incapable |= sids
+    for sid, part in list(partitions.items()):
+        if part.kind != "segment":
+            continue
+        if sid in seg_incapable or sid not in seg_capable:
+            partitions[sid] = StreamPartition("broadcast")
+    for a in artifacts:
+        sids = _pattern_streams(a)
+        if (
+            sids
+            and getattr(a, "supports_segment", False)
+            and hasattr(a, "step_segmented")
+            and all(
+                partitions.get(sid) == StreamPartition("segment")
+                for sid in sids
+            )
+        ):
+            segment_names.add(a.name)
     return CompiledPlan(
         plan_id=plan_id,
         spec=spec,
@@ -437,6 +641,8 @@ def compile_plan(
         source_ast=parsed,
         table_schemas=table_schemas,
         config=config,
+        chained=chained,
+        segment_artifacts=frozenset(segment_names),
     )
 
 

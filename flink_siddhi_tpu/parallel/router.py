@@ -82,6 +82,18 @@ class Router:
         if part.kind == "broadcast":
             # single-owner pinning: the whole stream to shard 0
             return [batch] + [None] * (S - 1)
+        if part.kind == "replicate":
+            # true fan-out (DynamicPartitioner.java:46-52): every shard
+            # sees every event — the replicated side of a non-equi
+            # time-window join keeps a full window copy per shard
+            return [batch] * S
+        if part.kind == "segment":
+            # standalone split (route_all coordinates boundaries across
+            # streams; a single stream splits on its own quantiles)
+            if not n:
+                return [None] * S
+            bounds = self._segment_bounds([batch.timestamps])
+            return self._split_segments(batch, bounds)
         if part.kind == "groupby" and part.keys:
             cols = [batch.columns[k] for k in part.keys]
             assign = (hash_columns(cols, n) % np.uint64(S)).astype(np.int64)
@@ -98,13 +110,60 @@ class Router:
     def route_all(
         self, batches: Sequence[EventBatch]
     ) -> List[List[EventBatch]]:
-        """Route a set of per-stream batches -> per-shard batch lists."""
+        """Route a set of per-stream batches -> per-shard batch lists.
+
+        ``segment`` streams split on SHARED time boundaries (equal-count
+        quantiles of the union of their timestamps) so segment s of every
+        involved stream covers the same time slice — the contract the
+        segment-parallel chain matcher's shard-to-shard handoff needs."""
         shards: List[List[EventBatch]] = [[] for _ in range(self.n_shards)]
+        seg = [
+            b
+            for b in batches
+            if self.partition_of(b.stream_id).kind == "segment"
+        ]
+        bounds = None
+        if seg and self.n_shards > 1:
+            bounds = self._segment_bounds([b.timestamps for b in seg])
         for b in batches:
+            if (
+                bounds is not None
+                and self.partition_of(b.stream_id).kind == "segment"
+            ):
+                for s, piece in enumerate(
+                    self._split_segments(b, bounds)
+                ):
+                    if piece is not None:
+                        shards[s].append(piece)
+                continue
             for s, piece in enumerate(self.route(b)):
                 if piece is not None and len(piece):
                     shards[s].append(piece)
         return shards
+
+    def _segment_bounds(self, ts_arrays: List[np.ndarray]) -> np.ndarray:
+        """Equal-count quantile boundary timestamps over the union of the
+        given (sorted within themselves) timestamp arrays."""
+        all_ts = np.concatenate(ts_arrays)
+        all_ts.sort(kind="stable")
+        S = self.n_shards
+        return all_ts[
+            [min(len(all_ts) - 1, (len(all_ts) * k) // S)
+             for k in range(1, S)]
+        ]
+
+    def _split_segments(
+        self, batch: EventBatch, bounds: np.ndarray
+    ) -> List[Optional[EventBatch]]:
+        """Cut one time-sorted batch at the boundary timestamps
+        (left-closed: an event equal to a boundary goes right)."""
+        cuts = np.searchsorted(batch.timestamps, bounds, side="left")
+        out: List[Optional[EventBatch]] = []
+        prev = 0
+        for cut in list(cuts) + [len(batch)]:
+            out.append(batch.slice(prev, cut) if cut > prev else None)
+            prev = cut
+        return out
 
     # -- checkpoint support -------------------------------------------------
     def state_dict(self) -> dict:

@@ -73,10 +73,10 @@ def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
         states = jax.tree.map(lambda x: x[0], states)
         tape = jax.tree.map(lambda x: x[0], tape)
         if use_kernel:
-            new_states, outputs = plan.step(states, tape)
+            new_states, outputs = plan.step(states, tape, SHARD_AXIS)
         else:
             with pallas_ops.force_fallback():
-                new_states, outputs = plan.step(states, tape)
+                new_states, outputs = plan.step(states, tape, SHARD_AXIS)
         expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         return expand(new_states), expand(outputs)
 
@@ -106,10 +106,14 @@ def make_sharded_step_acc(plan: CompiledPlan, mesh) -> callable:
         acc = jax.tree.map(lambda x: x[0], acc)
         tape = jax.tree.map(lambda x: x[0], tape)
         if use_kernel:
-            new_states, new_acc = plan.step_acc(states, acc, tape)
+            new_states, new_acc = plan.step_acc(
+                states, acc, tape, SHARD_AXIS
+            )
         else:
             with pallas_ops.force_fallback():
-                new_states, new_acc = plan.step_acc(states, acc, tape)
+                new_states, new_acc = plan.step_acc(
+                    states, acc, tape, SHARD_AXIS
+                )
         expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         return expand(new_states), expand(new_acc)
 

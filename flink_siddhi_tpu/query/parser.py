@@ -26,6 +26,7 @@ __all__ = ["parse_plan", "parse_query", "SiddhiQLError"]
 
 
 _TIME_UNITS_MS = {
+    "millisec": 1,  # Siddhi's short form
     "millisecond": 1,
     "milliseconds": 1,
     "ms": 1,

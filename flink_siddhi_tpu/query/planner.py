@@ -34,6 +34,41 @@ class StreamPartition:
         return set(self.keys) == set(other.keys)
 
 
+def _segmentable_chain(inp: "ast.PatternInput") -> bool:
+    """Whether an every-pattern can run time-segmented across shards:
+    a plain (1,1) '->' chain — no quantifiers, no and/or groups, no
+    cross-element filter references, no terminal timed absence, not
+    grouped-every (single instance in flight can't parallelize)."""
+    if inp.kind != "pattern" or not inp.every_ or inp.every_grouped:
+        return False
+    aliases = {el.alias for el in inp.elements}
+    for el in inp.elements:
+        if el.min_count != 1 or el.max_count != 1:
+            return False
+        if getattr(el, "group_link", None):
+            return False
+        if el.negated and el.absent_for is not None:
+            return False
+        if el.filter is not None:
+            for a in ast.iter_attrs(el.filter):
+                if (
+                    a.qualifier is not None
+                    and a.qualifier in aliases
+                    and a.qualifier != el.alias
+                ):
+                    return False  # cross-element ref -> slot engine
+    return True
+
+
+def _time_windowed(si: ast.StreamInput) -> bool:
+    """Whether the join side declares a #window.time — the only window
+    whose membership is shard-independent (see JoinInput partitioning)."""
+    for w in si.windows:
+        if w.name.split(".")[-1] == "time":
+            return True
+    return False
+
+
 def _equi_join_keys(
     on: Optional[ast.Expr], left: ast.StreamInput, right: ast.StreamInput
 ) -> Tuple[Optional[str], Optional[str]]:
@@ -61,16 +96,39 @@ def infer_stream_partitions(
     incompatible requirements on the same stream (parity with
     SiddhiExecutionPlanner.retrievePartition, :174-192)."""
     partitions: Dict[str, StreamPartition] = {}
+    # (left, right) of replicate-scheme joins: the scheme is only exact
+    # as a PAIR (spread left, replicate right); if either side's
+    # requirement merges away, both degrade to owner-pinning together
+    replicate_pairs: List[Tuple[str, str]] = []
 
     def put(stream_id: str, part: StreamPartition) -> None:
+        """Merge partitioning requirements across queries sharing a
+        stream. 'shuffle' (stateless consumer) is satisfied by any
+        exactly-once distribution — EXCEPT 'replicate', which sends
+        every shard a full copy and would duplicate the stateless
+        query's output. Any other mixed requirement degrades to
+        'broadcast' (single-owner pinning: exact for every consumer,
+        just unscaled), except two different group-by key sets, which
+        stay a hard error (no single routing satisfies both)."""
         existing = partitions.get(stream_id)
-        if existing is None or existing.kind == "shuffle":
-            partitions[stream_id] = part
-        elif part.kind != "shuffle" and not existing.compatible(part):
+        if existing is None or existing.compatible(part):
+            partitions.setdefault(stream_id, part)
+            return
+        kinds = {existing.kind, part.kind}
+        if "shuffle" in kinds:
+            stronger = existing if part.kind == "shuffle" else part
+            partitions[stream_id] = (
+                StreamPartition("broadcast")
+                if stronger.kind == "replicate"
+                else stronger
+            )
+            return
+        if kinds == {"groupby"}:
             raise SiddhiQLError(
                 f"stream {stream_id!r} has incompatible partitioning "
                 f"requirements: {existing} vs {part}"
             )
+        partitions[stream_id] = StreamPartition("broadcast")
 
     for q in queries:
         inp = q.input
@@ -89,7 +147,24 @@ def infer_stream_partitions(
             if lk and rk:
                 put(inp.left.stream_id, StreamPartition("groupby", (lk,)))
                 put(inp.right.stream_id, StreamPartition("groupby", (rk,)))
+            elif _time_windowed(inp.left) and _time_windowed(inp.right):
+                # non-equi join over TIME windows: replicate one side to
+                # every shard and spread the other — each pair forms
+                # exactly once (an l-arrival sees the full replicated
+                # r-window; an r-arrival copy pairs only with the l rows
+                # its shard owns). Time-window membership is
+                # shard-independent, so results are exact. Reference
+                # analog: broadcast partitioning,
+                # DynamicPartitioner.java:46-52.
+                replicate_pairs.append(
+                    (inp.left.stream_id, inp.right.stream_id)
+                )
+                put(inp.left.stream_id, StreamPartition("shuffle"))
+                put(inp.right.stream_id, StreamPartition("replicate"))
             else:
+                # length windows are GLOBAL last-n state: spreading a
+                # side would turn them into per-shard last-n. Pin the
+                # single join instance to one owner shard.
                 put(inp.left.stream_id, StreamPartition("broadcast"))
                 put(inp.right.stream_id, StreamPartition("broadcast"))
         elif isinstance(inp, ast.PatternInput):
@@ -108,6 +183,15 @@ def infer_stream_partitions(
                             "the partition clause"
                         )
                     put(sid, StreamPartition("groupby", (attr,)))
+            elif _segmentable_chain(inp):
+                # unkeyed `every` chain: time-SEGMENT the stream across
+                # shards — each shard matches its contiguous slice in
+                # parallel and partial matches hop shard-to-shard through
+                # later segments (sequence parallelism for CEP; exact
+                # results, unlike the reference's subtask-local matches
+                # under random channels, DynamicPartitioner.java:53-55)
+                for sid in q.input_stream_ids():
+                    put(sid, StreamPartition("segment"))
             else:
                 # pattern state is a single NFA instance over the whole
                 # stream: all events of all involved streams must reach
@@ -117,6 +201,22 @@ def infer_stream_partitions(
                     put(sid, StreamPartition("broadcast"))
         else:
             raise TypeError(type(inp))
+    # replicate-scheme joins are exact only as an intact (shuffle,
+    # replicate) pair; a merge on either side degrades both to pinning
+    for l_sid, r_sid in replicate_pairs:
+        lp = partitions.get(l_sid)
+        rp = partitions.get(r_sid)
+        if (
+            lp is not None
+            and rp is not None
+            and lp.kind == "shuffle"
+            and rp.kind == "replicate"
+        ):
+            continue
+        if rp is not None and rp.kind == "replicate":
+            partitions[r_sid] = StreamPartition("broadcast")
+        if lp is not None and lp.kind == "shuffle":
+            partitions[l_sid] = StreamPartition("broadcast")
     return partitions
 
 
