@@ -1205,6 +1205,10 @@ class ChainPatternArtifact:
         preds_hop = preds.at[cfg.positive[0]].set(False)
         trav = {k: new_state[k] for k in self._pool_keys()}
         term = {k: jnp.zeros_like(v) for k, v in trav.items()}
+        # overflow: start from the local run's counter (it already
+        # includes this batch's local pool drops) and add each hop run's
+        # increment plus the terminal-merge drops
+        overflow_acc = new_state["overflow"]
         dropped_total = jnp.int32(0)
         perm = [(s, s + 1) for s in range(S - 1)]
         is_last = sidx == S - 1
@@ -1223,6 +1227,9 @@ class ChainPatternArtifact:
             hop_st.update(trav)
             hop_st["done"] = jnp.asarray(False)
             adv = run_core(hop_st, preds_hop)
+            overflow_acc = overflow_acc + (
+                adv["overflow"] - hop_st["overflow"]
+            )
             surv = {k: adv[k] for k in self._pool_keys()}
             # the last shard banks survivors (they traversed every later
             # segment); inner shards pass them on. Inactive rows' values
@@ -1246,9 +1253,7 @@ class ChainPatternArtifact:
             term = {k: new_state[k] for k in self._pool_keys()}
         for k, v in term.items():
             new_state[k] = v
-        new_state["overflow"] = (
-            state["overflow"] + dropped_total
-        )
+        new_state["overflow"] = overflow_acc + dropped_total
         new_state["done"] = jnp.asarray(False)
 
         # pack all runs' completions into ONE emission block

@@ -60,6 +60,34 @@ class CompiledPlan:
     # artifacts that run time-SEGMENTED across shards (their input
     # streams route with kind 'segment'; see planner._segmentable_chain)
     segment_artifacts: frozenset = frozenset()
+    # original CQL + extension registry: lets callers recompile with a
+    # different EngineConfig (e.g. ShardedJob auto-disabling lazy
+    # projection, which changes the wire format itself)
+    source_text: str = ""
+    extensions: object = None
+
+    def recompiled(self, **config_overrides) -> "CompiledPlan":
+        """Recompile this plan from its original CQL with EngineConfig
+        overrides (state shapes may change; use before a runtime is
+        created, never mid-run)."""
+        import dataclasses as _dc
+
+        if not self.source_text:
+            raise ValueError(
+                "plan has no recorded source text; recompile manually"
+            )
+        return compile_plan(
+            self.source_text,
+            # external schemas only: DDL/internal streams re-derive
+            {
+                sid: sch
+                for sid, sch in self.schemas.items()
+                if sid in self.spec.stream_codes
+            },
+            extensions=self.extensions,
+            plan_id=self.plan_id,
+            config=_dc.replace(self.config, **config_overrides),
+        )
 
     def init_state(self) -> Dict:
         from .table import init_table_state
@@ -643,6 +671,8 @@ def compile_plan(
         config=config,
         chained=chained,
         segment_artifacts=frozenset(segment_names),
+        source_text=plan_text,
+        extensions=extensions,
     )
 
 

@@ -157,11 +157,30 @@ class ShardedJob(Job):
         # adds keep one runtime per plan (dynamic flag accepted for API
         # parity)
         if any(getattr(a, "lazy_pairs", ()) for a in plan.artifacts):
-            raise ValueError(
-                "lazy projection is single-device (the ordinal ring "
-                "lives on one host); compile this plan with "
-                "EngineConfig(lazy_projection=False) for sharded jobs"
+            # lazy projection is single-device (the ordinal ring lives on
+            # one host): auto-recompile without it instead of refusing
+            _LOG.warning(
+                "%s: lazy projection is single-device; recompiling the "
+                "plan with lazy_projection=False for the sharded mesh",
+                plan.plan_id,
             )
+            plan = plan.recompiled(lazy_projection=False)
+        parts = plan.partitions
+        if plan.chained:
+            # chained consumers keep per-shard state and the producer's
+            # partitioning never propagates through the intermediate
+            # stream: pin the whole plan to one owner shard (exact,
+            # unscaled) rather than emit per-shard partial aggregates
+            _LOG.warning(
+                "%s: chained queries run owner-pinned on a sharded mesh "
+                "(exact results; intermediate streams are shard-local)",
+                plan.plan_id,
+            )
+            from ..query.planner import StreamPartition
+
+            parts = {
+                sid: StreamPartition("broadcast") for sid in parts
+            }
         stacked = _tree_stack([plan.init_state()] * self.n_shards)
         stacked = jax.device_put(stacked, self._state_sharding)
         init_acc = jax.jit(
@@ -178,7 +197,7 @@ class ShardedJob(Job):
             jitted_init_acc=init_acc,
             acc=init_acc(),
         )
-        self._routers[plan.plan_id] = Router(self.n_shards, plan.partitions)
+        self._routers[plan.plan_id] = Router(self.n_shards, parts)
 
     def remove_plan(self, plan_id: str) -> None:
         super().remove_plan(plan_id)

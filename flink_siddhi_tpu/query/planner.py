@@ -202,7 +202,8 @@ def infer_stream_partitions(
         else:
             raise TypeError(type(inp))
     # replicate-scheme joins are exact only as an intact (shuffle,
-    # replicate) pair; a merge on either side degrades both to pinning
+    # replicate) pair; a merge on EITHER side degrades BOTH to pinning —
+    # a spread left with a pinned right would silently drop pairs
     for l_sid, r_sid in replicate_pairs:
         lp = partitions.get(l_sid)
         rp = partitions.get(r_sid)
@@ -213,10 +214,8 @@ def infer_stream_partitions(
             and rp.kind == "replicate"
         ):
             continue
-        if rp is not None and rp.kind == "replicate":
-            partitions[r_sid] = StreamPartition("broadcast")
-        if lp is not None and lp.kind == "shuffle":
-            partitions[l_sid] = StreamPartition("broadcast")
+        partitions[l_sid] = StreamPartition("broadcast")
+        partitions[r_sid] = StreamPartition("broadcast")
     return partitions
 
 

@@ -665,6 +665,16 @@ class Job:
             return
         old = rt.acc
         rt.acc = rt.jitted_init_acc()
+        if not self._has_consumers(rt):
+            # no-consumer fast path: nobody observes the rows (no sinks,
+            # retention off), so only the counts cross the wire — the
+            # data transfer AND the host decode are skipped entirely.
+            # The swap itself still happens (overflow accounting).
+            rt.drain_q.append({"acc": old, "data": None, "width": 0})
+            self._advance_ready(rt)
+            if len(rt.drain_q) > self.MAX_PENDING_DRAINS:
+                self._drain_poll(rt, block=True, limit=1)
+            return
         width = min(max(rt.fetch_width, 1024), rt.plan.acc_capacity())
         # dispatch the predicted-width data slice NOW: by the time meta
         # is ready the slice is computed too, so the fetch thread's
@@ -675,16 +685,24 @@ class Job:
         if len(rt.drain_q) > self.MAX_PENDING_DRAINS:
             self._drain_poll(rt, block=True, limit=1)
 
+    def _has_consumers(self, rt: _PlanRuntime) -> bool:
+        """Whether any host-side consumer observes this plan's rows."""
+        if self.retain_results:
+            return True
+        return any(
+            self._sinks.get(sid)
+            for sid in rt.plan.output_streams()
+        )
+
     def _advance_ready(self, rt: _PlanRuntime) -> None:
         """Promote waiting entries whose meta and predicted slice are
         ready to fetch jobs (FIFO: stop at the first not-ready entry)."""
         for entry in rt.drain_q:
             if "fut" in entry:
                 continue
-            if not (
-                entry["acc"]["meta"].is_ready()
-                and entry["data"].is_ready()
-            ):
+            if not entry["acc"]["meta"].is_ready():
+                break
+            if entry["data"] is not None and not entry["data"].is_ready():
                 break
             entry["fut"] = self._fetch_pool.submit(
                 self._fetch_acc, rt, entry.pop("acc"),
@@ -718,6 +736,8 @@ class Job:
         meta = np.asarray(acc["meta"])
         counts, overflow = meta[0], meta[1]
         max_n = int(counts.max()) if counts.size else 0
+        if data_dev is None:  # no-consumer fast path: counts only
+            return counts, overflow, None
         rt.fetch_width = min(
             bucket_size(max(max_n, 1), minimum=1024),
             rt.plan.acc_capacity(),
@@ -753,7 +773,8 @@ class Job:
                     return
                 # block path (results/flush/checkpoint): force the wait
                 jax.block_until_ready(entry["acc"]["meta"])
-                jax.block_until_ready(entry["data"])
+                if entry["data"] is not None:
+                    jax.block_until_ready(entry["data"])
                 self._advance_ready(rt)
                 entry = rt.drain_q[0]
             fut = entry["fut"]
@@ -772,6 +793,17 @@ class Job:
                 for a in rt.plan.artifacts:
                     for schema, rows in decoded.get(a.name) or []:
                         self._emit_rows(schema, rows)
+            else:
+                # counts-only drain (no consumers / empty): keep the
+                # emitted counters truthful. Stacked groups attribute to
+                # their representative stream.
+                for ai, a in enumerate(rt.plan.artifacts):
+                    c = int(counts[ai]) if ai < counts.size else 0
+                    sch = getattr(a, "output_schema", None)
+                    if c and sch is not None:
+                        self.emitted_counts[sch.stream_id] = (
+                            self.emitted_counts.get(sch.stream_id, 0) + c
+                        )
             done += 1
             if limit and done >= limit:
                 return

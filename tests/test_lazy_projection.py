@@ -107,19 +107,6 @@ def test_computed_projection_is_not_lazy():
     assert "S.price" in (plan.spec.device_columns or ())
 
 
-def test_sharded_rejects_lazy_plans():
-    from flink_siddhi_tpu.parallel import ShardedJob
-
-    plan = compile_plan(
-        CQL, {"S": SCHEMA}, config=EngineConfig(lazy_projection=True)
-    )
-    with pytest.raises(ValueError, match="single-device"):
-        ShardedJob(
-            [plan], [BatchSource("S", SCHEMA, iter(make_batches()))],
-            n_shards=2, batch_size=64, time_mode="processing",
-        )
-
-
 def test_ring_eviction_decodes_none():
     from flink_siddhi_tpu.runtime.executor import _LazyRing
 
@@ -179,4 +166,35 @@ def test_lazy_plan_not_folded_dynamically():
     job.run()
     assert all(
         r[2] is not None for r in job.results("matches")
+    )
+
+
+def test_sharded_job_auto_disables_lazy():
+    # VERDICT round-2 item 8: a lazy-compiled plan must not make
+    # ShardedJob refuse — it recompiles without lazy projection and
+    # still matches the single-device results
+    from flink_siddhi_tpu.parallel import ShardedJob
+
+    plan = compile_plan(
+        CQL, {"S": SCHEMA}, config=EngineConfig(lazy_projection=True)
+    )
+    assert any(getattr(a, "lazy_pairs", ()) for a in plan.artifacts)
+    job = ShardedJob(
+        [plan],
+        [BatchSource("S", SCHEMA, iter(make_batches(n=512)))],
+        n_shards=8, batch_size=64, time_mode="processing",
+    )
+    rt = next(iter(job._plans.values()))
+    assert not any(
+        getattr(a, "lazy_pairs", ()) for a in rt.plan.artifacts
+    )
+    job.run()
+    single = Job(
+        [compile_plan(CQL, {"S": SCHEMA})],
+        [BatchSource("S", SCHEMA, iter(make_batches(n=512)))],
+        batch_size=64, time_mode="processing",
+    )
+    single.run()
+    assert sorted(job.results("matches")) == sorted(
+        single.results("matches")
     )

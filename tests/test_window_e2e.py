@@ -344,3 +344,52 @@ def test_window_passthrough_projection():
         "insert into out",
     )
     assert out == [(e.id, e.price) for e in events]
+
+
+def test_no_consumer_fast_path_counts_only():
+    # drain fast path: with retention off and no sinks, rows are counted
+    # but never fetched/decoded; adding a sink re-enables full decode
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import CallbackSource
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+         ("timestamp", AttributeType.LONG)]
+    )
+    cql = (
+        "from S#window.length(4) select id, sum(price) as total "
+        "group by id insert into out"
+    )
+
+    class Rec:
+        def __init__(self, id, price, timestamp):
+            self.id, self.price, self.timestamp = id, price, timestamp
+
+    def run(with_sink):
+        src = CallbackSource("S", schema)
+        job = Job(
+            [compile_plan(cql, {"S": schema})], [src],
+            batch_size=16, time_mode="processing", retain_results=False,
+        )
+        rows = []
+        if with_sink:
+            job.add_sink("out", lambda ts, row: rows.append(row))
+        for i in range(32):
+            src.emit(Rec(i % 3, float(i), 1000 + i), 1000 + i)
+        for _ in range(4):
+            job.run_cycle()
+        job.flush()
+        # poll any pending drains to completion
+        for rt in job._plans.values():
+            job._drain_poll(rt, block=True)
+        return job, rows
+
+    job_ns, rows_ns = run(with_sink=False)
+    assert rows_ns == []
+    assert job_ns.emitted_counts.get("out", 0) == 32  # counted, not decoded
+    job_s, rows_s = run(with_sink=True)
+    assert len(rows_s) == 32
+    assert job_s.emitted_counts.get("out", 0) == 32
