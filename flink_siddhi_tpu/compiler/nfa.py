@@ -297,6 +297,9 @@ class _PatternSpec:
     # per element: (elem, col, k) indexed refs its cross filter reads — the
     # filter can only hold once the referenced element absorbed > k events
     cross_idx_refs: Tuple[Tuple[Tuple[int, str, int], ...], ...] = ()
+    # mid-chain `-> every X`: elements where every matching event FORKS a
+    # continuing instance while the matched prefix stays armed
+    every_marks: Tuple[bool, ...] = ()
 
     @property
     def n_elements(self) -> int:
@@ -556,6 +559,9 @@ def _build_spec(
         proj_ref_pairs=tuple(proj_ref_pairs),
         proj_idx_refs=tuple(proj_idx_refs),
         cross_idx_refs=tuple(cross_idx_refs),
+        every_marks=tuple(
+            getattr(el, "every_marked", False) for el in inp.elements
+        ),
     )
 
 
@@ -937,6 +943,7 @@ def _is_chain(spec: _PatternSpec) -> bool:
             for el in spec.elements
         )
         and all(len(g) == 1 for g in spec.groups)
+        and not any(spec.every_marks)  # forking needs the slot engine
     )
 
 
@@ -2206,6 +2213,16 @@ class SlotNFAArtifact:
                 "too many pattern elements + indexed captures for the "
                 "match-bitmask wire word (limit 31)"
             )
+        # mid-chain `-> every X` fork points, by GROUP index
+        marks = spec.every_marks or (False,) * spec.n_elements
+        if any(marks) and spec.kind != "pattern":
+            raise SiddhiQLError(
+                "mid-chain 'every' is only valid in '->' patterns"
+            )
+        if marks and marks[0]:
+            raise SiddhiQLError(
+                "use leading 'every' for the first pattern element"
+            )
         last = spec.elements[-1]
         if spec.kind == "pattern" and last.max_count < 0:
             raise SiddhiQLError(
@@ -2223,6 +2240,11 @@ class SlotNFAArtifact:
         self._g_of = {
             e: g for g, mem in enumerate(self._groups) for e in mem
         }
+        self._marked_groups = tuple(
+            g
+            for g, mem in enumerate(self._groups)
+            if len(mem) == 1 and marks[mem[0]]
+        )
         mins, maxs = [], []
         for mem, op in zip(self._groups, self._gops):
             if len(mem) == 1:
@@ -2518,8 +2540,87 @@ class SlotNFAArtifact:
                 n0 + emit.sum().astype(jnp.int32), M
             )
 
-            freed = emit | killed
+            # mid-chain `-> every X` forks: an advance into a marked
+            # group must not CONSUME the matched prefix — the advanced
+            # instance moves to a fresh slot (or emits directly when the
+            # marked element completes the pattern) and the prefix slot
+            # reverts, staying armed for the next X event
+            fork = jnp.zeros(S, dtype=bool)
+            for g in self._marked_groups:
+                fork = fork | (advance & (adv_t == g))
+
+            freed = (emit & ~fork) | killed
             active2 = active & ~freed
+            fork_overflow = jnp.int32(0)
+
+            if self._marked_groups:
+                fork_alloc = fork & ~moved_to_last
+                free = ~active2
+                free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1
+                alloc_rank = (
+                    jnp.cumsum(fork_alloc.astype(jnp.int32)) - 1
+                )
+                # rank -> free slot index (unfilled ranks stay S: drop)
+                r2s = (
+                    jnp.full(S, S, dtype=jnp.int32)
+                    .at[jnp.where(free, free_rank, S)]
+                    .set(jnp.arange(S, dtype=jnp.int32), mode="drop")
+                )
+                target = jnp.where(
+                    fork_alloc,
+                    r2s[jnp.clip(alloc_rank, 0, S - 1)],
+                    S,
+                )
+                placed = fork_alloc & (target < S)
+                fork_overflow = (
+                    (fork_alloc & ~placed).sum().astype(jnp.int32)
+                )
+                # scatter the ADVANCED state into the fork targets,
+                # then revert the originals to their pre-advance state
+                active2 = active2.at[target].set(True, mode="drop")
+                new_step = new_step.at[target].set(
+                    new_step, mode="drop"
+                )
+                new_step = jnp.where(fork, step, new_step)
+                new_count = new_count.at[target].set(
+                    new_count, mode="drop"
+                )
+                new_count = jnp.where(fork, count, new_count)
+                new_start = st["start"].at[target].set(
+                    st["start"], mode="drop"
+                )
+                new_last = new_last.at[target].set(
+                    new_last, mode="drop"
+                )
+                new_last = jnp.where(fork, st["last"], new_last)
+                new_matched = new_matched.at[target].set(
+                    new_matched, mode="drop"
+                )
+                new_matched = jnp.where(
+                    fork, st["matched"], new_matched
+                )
+                for pair in pairs:
+                    new_first[pair] = new_first[pair].at[target].set(
+                        new_first[pair], mode="drop"
+                    )
+                    new_first[pair] = jnp.where(
+                        fork, st[_skey("first", *pair)], new_first[pair]
+                    )
+                    new_lastc[pair] = new_lastc[pair].at[target].set(
+                        new_lastc[pair], mode="drop"
+                    )
+                    new_lastc[pair] = jnp.where(
+                        fork, st[_skey("last", *pair)], new_lastc[pair]
+                    )
+                for cap in self._idx:
+                    new_idx[cap] = new_idx[cap].at[target].set(
+                        new_idx[cap], mode="drop"
+                    )
+                    new_idxv[cap] = new_idxv[cap].at[target].set(
+                        new_idxv[cap], mode="drop"
+                    )
+            else:
+                new_start = st["start"]
 
             # arm a new slot on a first-element match; for non-every,
             # "started" only holds while the armed partial is still alive
@@ -2561,7 +2662,7 @@ class SlotNFAArtifact:
             active3 = active2 | one_hot
             new_step = jnp.where(one_hot, 0, new_step)
             new_count = jnp.where(one_hot, 1, new_count)
-            new_start = jnp.where(one_hot, ts_e, st["start"])
+            new_start = jnp.where(one_hot, ts_e, new_start)
             new_last = jnp.where(one_hot, ts_e, new_last)
             arm_bits = jnp.int32(0)
             for e in GM[0]:
@@ -2601,7 +2702,8 @@ class SlotNFAArtifact:
                 done=any_done,
                 started=started_now | want_start,
                 overflow=st["overflow"]
-                + (want_start & ~has_free).astype(jnp.int32),
+                + (want_start & ~has_free).astype(jnp.int32)
+                + fork_overflow,
             )
             for pair in pairs:
                 new_st[_skey("first", *pair)] = new_first[pair]

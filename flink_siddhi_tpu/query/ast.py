@@ -194,6 +194,10 @@ class PatternElement:
     # logical groups (`e1 = A and e2 = B`, `e1 = A or e2 = B`): 'and'/'or'
     # links this element into the SAME step as the previous element
     group_link: Optional[str] = None
+    # mid-chain re-arming (`A -> every B [-> C]`): once the prefix has
+    # matched, EVERY event matching this element spawns a fresh instance
+    # continuing from here, while the prefix stays armed
+    every_marked: bool = False
 
 
 @dataclass(frozen=True)

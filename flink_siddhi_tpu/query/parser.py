@@ -329,13 +329,30 @@ def _parse_chain(
         elif kind != connector:
             ts.error("cannot mix '->' (pattern) and ',' (sequence) connectors")
         ts.advance()
-        if ts.at_keyword("every"):
-            # Siddhi allows `A -> every B` (mid-chain re-arming); this
-            # engine does not compile it yet — fail loudly rather than
-            # silently dropping the semantics.
-            ts.error(
-                "'every' on a non-first pattern element is not supported"
+        if ts.accept_keyword("every"):
+            # `A -> every B`: mid-chain re-arming — every B after the
+            # matched prefix spawns its own continuing instance
+            import dataclasses
+
+            if kind == "sequence":
+                ts.error(
+                    "mid-chain 'every' is only valid in '->' patterns"
+                )
+            step = _parse_pattern_step(ts)
+            if len(step) != 1:
+                ts.error(
+                    "mid-chain 'every' cannot mark an and/or group"
+                )
+            el = step[0]
+            if el.min_count != 1 or el.max_count != 1 or el.negated:
+                ts.error(
+                    "mid-chain 'every' element must be a plain (1,1) "
+                    "positive element"
+                )
+            elements.append(
+                dataclasses.replace(el, every_marked=True)
             )
+            continue
         elements.extend(_parse_pattern_step(ts))
     return elements, kind
 

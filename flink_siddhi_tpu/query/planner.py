@@ -47,6 +47,8 @@ def _segmentable_chain(inp: "ast.PatternInput") -> bool:
             return False
         if getattr(el, "group_link", None):
             return False
+        if getattr(el, "every_marked", False):
+            return False  # forking runs on the (unsegmented) slot engine
         if el.negated and el.absent_for is not None:
             return False
         if el.filter is not None:

@@ -520,6 +520,12 @@ class Job:
             raise TypeError(f"unknown control event {type(ev)!r}")
 
     def add_sink(self, output_stream: str, fn: Callable) -> None:
+        """Attach a sink. Drains already in flight are completed first:
+        with no prior consumers they were swapped counts-only, so the
+        boundary is deterministic — rows accumulated BEFORE the sink
+        attached are counted but not delivered, rows after are."""
+        for rt in self._plans.values():
+            self._drain_poll(rt, block=True)
         self._sinks.setdefault(output_stream, []).append(fn)
 
     # -- run loop ------------------------------------------------------------

@@ -545,3 +545,88 @@ def test_grouped_every_completing_event_does_not_rearm():
         evs,
     )
     assert [(m["t1"], m["t2"]) for m in grouped] == [(1000, 2000)]
+
+
+def test_midchain_every_last_element():
+    # `A -> every B`: one A (non-every leading), then EVERY later B
+    # completes a match — the matched prefix is never consumed
+    # (siddhi-core mid-chain every, package-info.java:36-38)
+    evs = [ev(1, 1000), ev(2, 2000), ev(2, 3000), ev(5, 3500), ev(2, 4000)]
+    out = run_pattern(
+        "from s1 = inputStream1[id == 1] -> every s2 = inputStream1[id == 2] "
+        "select s1.timestamp as t1, s2.timestamp as t2 "
+        "insert into outputStream",
+        evs,
+    )
+    assert [(m["t1"], m["t2"]) for m in out] == [
+        (1000, 2000), (1000, 3000), (1000, 4000),
+    ]
+
+
+def test_midchain_every_middle_element():
+    # `A -> every B -> C`: every B forks a pending instance; C completes
+    # ALL pending forks
+    evs = [
+        ev(1, 1000), ev(2, 2000), ev(2, 3000), ev(3, 4000), ev(2, 5000),
+        ev(3, 6000),
+    ]
+    out = run_pattern(
+        "from s1 = inputStream1[id == 1] -> every s2 = inputStream1[id == 2] "
+        "-> s3 = inputStream1[id == 3] "
+        "select s2.timestamp as t2, s3.timestamp as t3 "
+        "insert into outputStream",
+        evs,
+    )
+    assert sorted((m["t2"], m["t3"]) for m in out) == [
+        (2000, 4000), (3000, 4000), (5000, 6000),
+    ]
+
+
+def test_midchain_every_with_leading_every():
+    # `every A -> every B`: every A starts an instance AND each instance
+    # pairs with every later B
+    evs = [ev(1, 1000), ev(1, 2000), ev(2, 3000), ev(2, 4000)]
+    out = run_pattern(
+        "from every s1 = inputStream1[id == 1] -> "
+        "every s2 = inputStream1[id == 2] "
+        "select s1.timestamp as t1, s2.timestamp as t2 "
+        "insert into outputStream",
+        evs,
+    )
+    assert sorted((m["t1"], m["t2"]) for m in out) == [
+        (1000, 3000), (1000, 4000), (2000, 3000), (2000, 4000),
+    ]
+
+
+def test_midchain_every_within_expiry():
+    # the prefix and its forks share the pattern's start time: within
+    # kills both once the deadline passes
+    evs = [ev(1, 1000), ev(2, 2000), ev(2, 50000)]
+    out = run_pattern(
+        "from s1 = inputStream1[id == 1] -> every s2 = inputStream1[id == 2] "
+        "within 10 sec "
+        "select s1.timestamp as t1, s2.timestamp as t2 "
+        "insert into outputStream",
+        evs,
+    )
+    assert [(m["t1"], m["t2"]) for m in out] == [(1000, 2000)]
+
+
+def test_midchain_every_parse_errors():
+    import pytest as _pytest
+
+    from flink_siddhi_tpu import CEPEnvironment, SiddhiCEP
+    from flink_siddhi_tpu.query.lexer import SiddhiQLError
+
+    env = CEPEnvironment()
+    base = SiddhiCEP.define("inputStream1", [ev(1, 1000)], FIELDS, env=env)
+    for bad in (
+        # sequences cannot re-arm mid-chain
+        "from s1 = inputStream1[id == 1] , every s2 = inputStream1[id == 2] "
+        "select s1.id as a insert into o",
+        # quantified every-marked element
+        "from s1 = inputStream1[id == 1] -> every s2 = inputStream1[id == 2]+ "
+        "select s1.id as a insert into o",
+    ):
+        with _pytest.raises(SiddhiQLError):
+            base.cql(bad).returns("o")
