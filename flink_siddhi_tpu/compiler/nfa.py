@@ -1762,16 +1762,72 @@ class StackedChainArtifact:
 class ChainTemplate:
     """The static shape shared by all members of a dynamic chain group.
     Everything here is traced into the compiled program; everything NOT
-    here (filter literals, within values, enable flags) is state."""
+    here (filter literals, comparison OPERATORS, within values, enable
+    flags) is state — so `price > 100`, `price <= 5`, and `id == 2` over
+    the same column all fold into one slot family."""
 
     K: int
     every: bool
     has_within: bool
     stream_ids: Tuple[str, ...]  # per element
-    filter_keys: Tuple[Optional[str], ...]  # tape col key or None
+    # per element: tape col key per conjunct (up to 2, e.g. a range
+    # `lo < x and x < hi`); () = unfiltered element
+    filter_keys: Tuple[Tuple[str, ...], ...]
     pairs: Tuple[Tuple[int, str], ...]
     cap_dtypes: Tuple[str, ...]
     proj_srcs: Tuple[Tuple[int, str], ...]
+
+
+# comparison operators evaluable with a per-slot DATA code (admit writes
+# the code; the device evaluates all variants and selects)
+_CMP_OPS = ("==", "!=", "<", "<=", ">", ">=")
+_CMP_CODE = {op: i for i, op in enumerate(_CMP_OPS)}
+
+
+def _template_conjuncts(el, column_types):
+    """Flatten an element filter into <=2 ``attr OP literal`` conjuncts
+    (None when the filter doesn't fit the parametric family)."""
+    conj: List = []
+    stack = [el.filter]
+    while stack:
+        f = stack.pop()
+        if isinstance(f, ast.Binary) and f.op == "and":
+            stack.append(f.left)
+            stack.append(f.right)
+            continue
+        if not isinstance(f, ast.Binary) or f.op not in _CMP_CODE:
+            return None
+        a, lit, op = f.left, f.right, f.op
+        if isinstance(a, ast.Literal) and isinstance(lit, ast.Attr):
+            # `5 < x` -> `x > 5`
+            a, lit = lit, a
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+        if not (
+            isinstance(a, ast.Attr)
+            and a.qualifier in (None, el.alias, el.stream_id)
+            and a.index is None
+            and isinstance(lit, ast.Literal)
+        ):
+            return None
+        key = f"{el.stream_id}.{a.name}"
+        val = lit.value
+        if column_types is not None:
+            atype = column_types.get(key)
+            if atype is None:
+                return None
+            if atype == AttributeType.STRING and op not in ("==", "!="):
+                return None  # interned codes have no meaningful order
+            if (
+                np.dtype(atype.device_dtype).kind in "iu"
+                and isinstance(val, float)
+                and not float(val).is_integer()
+            ):
+                return None  # param would truncate in the column dtype
+        conj.append((key, _CMP_CODE[op], val))
+    if len(conj) > 2:
+        return None
+    conj.sort(key=lambda c: c[0])  # deterministic key order
+    return conj
 
 
 def chain_template_of(
@@ -1800,37 +1856,18 @@ def chain_template_of(
         return None
     if not spec.proj_srcs or any(s is None for s in spec.proj_srcs):
         return None
-    filter_keys: List[Optional[str]] = []
+    filter_keys: List[Tuple[str, ...]] = []
     params: List = []
     for el in spec.elements:
         if el.filter is None:
-            filter_keys.append(None)
-            params.append(None)
+            filter_keys.append(())
+            params.append(())
             continue
-        f = el.filter
-        if not (
-            isinstance(f, ast.Binary)
-            and f.op == "=="
-            and isinstance(f.left, ast.Attr)
-            and f.left.qualifier in (None, el.alias, el.stream_id)
-            and f.left.index is None
-            and isinstance(f.right, ast.Literal)
-        ):
+        conj = _template_conjuncts(el, column_types)
+        if conj is None:
             return None
-        key = f"{el.stream_id}.{f.left.name}"
-        val = f.right.value
-        if column_types is not None:
-            atype = column_types.get(key)
-            if atype is None:
-                return None
-            if (
-                np.dtype(atype.device_dtype).kind in "iu"
-                and isinstance(val, float)
-                and not float(val).is_integer()
-            ):
-                return None  # int column vs 5.5: never equal statically
-        filter_keys.append(key)
-        params.append(val)
+        filter_keys.append(tuple(key for key, _op, _v in conj))
+        params.append(tuple((op, v) for _key, op, v in conj))
     pairs = tuple(_cap_pairs(spec))
     return (
         ChainTemplate(
@@ -1887,8 +1924,7 @@ class DynamicChainGroup:
             min(q, self.out_cap_factor) * tape_capacity + q * self.pool
         )
 
-    def _param_dtype(self, k: int):
-        key = self.template.filter_keys[k]
+    def _param_dtype(self, key: str):
         return self.column_types[key].device_dtype
 
     def init_state(self) -> Dict:
@@ -1903,11 +1939,12 @@ class DynamicChainGroup:
         }
         if self.template.has_within:
             st["within"] = jnp.zeros(Qc, dtype=jnp.int32)
-        for k, key in enumerate(self.template.filter_keys):
-            if key is not None:
-                st[f"param{k}"] = jnp.zeros(
-                    Qc, dtype=self._param_dtype(k)
+        for k, keys in enumerate(self.template.filter_keys):
+            for j, key in enumerate(keys):
+                st[f"param{k}_{j}"] = jnp.zeros(
+                    Qc, dtype=self._param_dtype(key)
                 )
+                st[f"op{k}_{j}"] = jnp.zeros(Qc, dtype=jnp.int32)
         for pair, dt in zip(self.template.pairs, self.template.cap_dtypes):
             st[_skey("cap", *pair)] = jnp.zeros((Qc, P), dtype=np.dtype(dt))
         return st
@@ -1930,15 +1967,17 @@ class DynamicChainGroup:
         st["overflow"] = st["overflow"].at[slot].set(0)
         if self.template.has_within:
             st["within"] = st["within"].at[slot].set(within_ms)
-        for k, (key, val) in enumerate(
+        for k, (keys, el_params) in enumerate(
             zip(self.template.filter_keys, params)
         ):
-            if key is None:
-                continue
-            atype = self.column_types[key]
-            if atype == AttributeType.STRING:
-                val = string_tables[key].intern(val)
-            st[f"param{k}"] = st[f"param{k}"].at[slot].set(val)
+            for j, (key, (op, val)) in enumerate(zip(keys, el_params)):
+                atype = self.column_types[key]
+                if atype == AttributeType.STRING:
+                    val = string_tables[key].intern(val)
+                st[f"param{k}_{j}"] = (
+                    st[f"param{k}_{j}"].at[slot].set(val)
+                )
+                st[f"op{k}_{j}"] = st[f"op{k}_{j}"].at[slot].set(op)
         return st
 
     def evict(self, state: Dict, slot: int) -> Dict:
@@ -1963,13 +2002,21 @@ class DynamicChainGroup:
         rows = []
         for k in range(K):
             base = tape.valid & (tape.stream == self.stream_code_of[k])
-            key = t.filter_keys[k]
-            if key is not None:
-                col = tape.cols[key]
-                pk = state[f"param{k}"]
-                row = base[None, :] & (col[None, :] == pk[:, None])
-            else:
-                row = jnp.broadcast_to(base, (Qc, E))
+            row = jnp.broadcast_to(base, (Qc, E))
+            for j, key in enumerate(t.filter_keys[k]):
+                col = tape.cols[key][None, :]
+                pk = state[f"param{k}_{j}"][:, None]
+                op = state[f"op{k}_{j}"][:, None]  # (Qc, 1)
+                # the operator is per-slot DATA: evaluate every variant
+                # and select by code (cheap VPU elementwise work)
+                variants = [
+                    col == pk, col != pk, col < pk,
+                    col <= pk, col > pk, col >= pk,
+                ]
+                cmp = variants[0]
+                for ci in range(1, len(variants)):
+                    cmp = jnp.where(op == ci, variants[ci], cmp)
+                row = row & cmp
             rows.append(row & state["enabled"][:, None])
         preds = jnp.stack(rows, axis=1)  # (Qc, K, E)
 

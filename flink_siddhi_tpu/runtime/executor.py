@@ -270,11 +270,10 @@ class Job:
     # -- dynamic chain groups (recompile-free runtime adds) -----------------
     def _group_string_tables(self, plan, tpl) -> Dict:
         out = {}
-        for key in tpl.filter_keys:
-            if key is None:
-                continue
-            sid, fname = key.split(".", 1)
-            out[key] = plan.schemas[sid].string_tables.get(fname)
+        for keys in tpl.filter_keys:
+            for key in keys:  # per-element conjunct keys
+                sid, fname = key.split(".", 1)
+                out[key] = plan.schemas[sid].string_tables.get(fname)
         return out
 
     def _fold_into(
