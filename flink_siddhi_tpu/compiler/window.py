@@ -31,6 +31,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..query import ast
@@ -286,12 +287,21 @@ class SlidingWindowArtifact:
         timestamp column has no ordering guarantee, and expiry-by-search
         over disordered times mis-evicts (an event could even expire
         before its own arrival)."""
-        return (
+        if not (
             self.window_mode == "length"
             or (self.window_mode == "time" and self.ts_key is None)
-        ) and all(
-            a.kind in ("count", "sum", "avg", "stddev") for a in self.aggs
-        )
+        ):
+            return False
+        allowed = {"count", "sum", "avg", "stddev"}
+        if self.window_mode == "length":
+            # min/max ride a range query over the last-cnt same-group
+            # arrivals — a suffix property only FIFO expiry guarantees.
+            # Length windows are FIFO by construction; time windows may
+            # conservatively early-evict cross-batch timestamp
+            # stragglers (exp_pos defense below), making the live set
+            # non-contiguous, so time-mode min/max keeps the matrix path.
+            allowed |= {"min", "max"}
+        return all(a.kind in allowed for a in self.aggs)
 
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         if self._prefixable():
@@ -404,11 +414,54 @@ class SlidingWindowArtifact:
             return cums[arrival_idx]  # per concat-arrival window sum
 
         stats: Dict[str, jnp.ndarray] = {}
-        need_count = any(
+        has_minmax = any(a.kind in ("min", "max") for a in self.aggs)
+        need_count = has_minmax or any(
             a.kind in ("count", "avg", "stddev") for a in self.aggs
         )
         if need_count:
             stats["cnt"] = windowed(jnp.ones(N, jnp.int32))
+
+        rmq_rank = None
+        if has_minmax:
+            # min/max don't distribute over +/- — instead: after a
+            # group-major (valid-first, stable position-order) sort of
+            # the ARRIVALS, FIFO expiry makes a window's live members
+            # exactly the LAST cnt same-group arrivals, so the windowed
+            # extremum is a contiguous range query answered by a sparse
+            # table: log-depth build, two gathers per arrival.
+            ao = jnp.argsort(~cval, stable=True)
+            for j in reversed(range(len(self.group_fns))):
+                g = c_cols[f"g{j}"]
+                ao = ao[jnp.argsort(g[ao], stable=True)]
+            rmq_rank = (
+                jnp.zeros(N, jnp.int32)
+                .at[ao]
+                .set(jnp.arange(N, dtype=jnp.int32))
+            )
+            cnt_q = jnp.maximum(stats["cnt"].astype(jnp.int32), 1)
+            levels = max(1, int(np.ceil(np.log2(max(N, 2)))))
+            lvl = jnp.zeros(N, jnp.int32)
+            for k in range(1, levels + 1):
+                lvl = lvl + (cnt_q >= (1 << k)).astype(jnp.int32)
+            pow_l = (jnp.int32(1) << lvl)
+
+        def windowed_extremum(vals, combine, ident):
+            a_sorted = jnp.where(cval, vals, ident)[ao]
+            table = [a_sorted]
+            for k in range(levels):
+                span = 1 << k
+                shifted = jnp.concatenate(
+                    [jnp.full(span, ident, a_sorted.dtype),
+                     table[-1][:-span]]
+                )
+                table.append(combine(table[-1], shifted))
+            flat = jnp.stack(table).reshape(-1)
+            r = rmq_rank
+            v1 = flat[lvl * N + r]
+            r2 = jnp.clip(r - cnt_q + pow_l, 0, N - 1)
+            v2 = flat[lvl * N + r2]
+            return combine(v1, v2)
+
         for j in range(len(self.arg_fns)):
             kinds = {
                 a.kind for a in self.aggs if a.arg_idx == j
@@ -421,6 +474,26 @@ class SlidingWindowArtifact:
             if "stddev" in kinds:
                 v = c_cols[f"a{j}"].astype(jnp.float32)
                 stats[f"q{j}"] = windowed(v * v)
+            if "min" in kinds:
+                a_col = c_cols[f"a{j}"]
+                ident = (
+                    jnp.array(jnp.inf, a_col.dtype)
+                    if jnp.issubdtype(a_col.dtype, jnp.floating)
+                    else jnp.array(np.iinfo(a_col.dtype).max, a_col.dtype)
+                )
+                stats[f"mn{j}"] = windowed_extremum(
+                    a_col, jnp.minimum, ident
+                )
+            if "max" in kinds:
+                a_col = c_cols[f"a{j}"]
+                ident = (
+                    jnp.array(-jnp.inf, a_col.dtype)
+                    if jnp.issubdtype(a_col.dtype, jnp.floating)
+                    else jnp.array(np.iinfo(a_col.dtype).min, a_col.dtype)
+                )
+                stats[f"mx{j}"] = windowed_extremum(
+                    a_col, jnp.maximum, ident
+                )
 
         def unsort(concat_vals, dtype):
             # concat arrival i corresponds to compacted batch index i-C;
@@ -442,6 +515,10 @@ class SlidingWindowArtifact:
                 rows = stats[f"s{agg.arg_idx}"] / jnp.maximum(
                     stats["cnt"], 1.0
                 )
+            elif agg.kind == "min":
+                rows = stats[f"mn{agg.arg_idx}"]
+            elif agg.kind == "max":
+                rows = stats[f"mx{agg.arg_idx}"]
             else:  # stddev
                 c = jnp.maximum(stats["cnt"], 1.0)
                 mean = stats[f"s{agg.arg_idx}"] / c

@@ -393,3 +393,61 @@ def test_no_consumer_fast_path_counts_only():
     job_s, rows_s = run(with_sink=True)
     assert len(rows_s) == 32
     assert job_s.emitted_counts.get("out", 0) == 32
+
+
+@pytest.mark.parametrize("batch_size", [4096, 23])
+def test_length_window_group_minmax_oracle(batch_size):
+    # pins the prefix-path sparse-table range min/max (group-major
+    # arrival RMQ): randomized prices, group-by, window larger than the
+    # groups' in-window counts, across batch boundaries
+    import random
+
+    rnd = random.Random(11)
+    events = [
+        Event(rnd.randrange(5), "n", float(rnd.randrange(1000)) / 4,
+              1000 + 100 * i)
+        for i in range(300)
+    ]
+    out = run(
+        events,
+        "from inputStream#window.length(37) "
+        "select id, min(price) as lo, max(price) as hi, "
+        "sum(price) as tot group by id insert into out",
+        batch_size=batch_size,
+    )
+    assert len(out) == len(events)
+    for i, row in enumerate(out):
+        w = [
+            e.price
+            for e in events[max(0, i - 36): i + 1]
+            if e.id == events[i].id
+        ]
+        assert row[0] == events[i].id
+        assert row[1] == min(w), f"row {i} min"
+        assert row[2] == max(w), f"row {i} max"
+        assert row[3] == pytest.approx(sum(w), rel=1e-5)
+
+
+def test_time_window_minmax_straggler_stays_exact():
+    # review regression: time-window min/max must NOT use the last-cnt
+    # suffix range query — a cross-batch timestamp straggler is
+    # conservatively early-evicted, making the live set non-contiguous.
+    # batch_size=1 forces each event into its own poll.
+    events = [
+        Event(0, "n", 100.0, 10000),
+        Event(0, "n", 1.0, 7000),    # straggler: regressed timestamp
+        Event(0, "n", 50.0, 13000),
+    ]
+    out = run(
+        events,
+        "from inputStream#window.time(5 sec) "
+        "select min(price) as lo, max(price) as hi, count() as c "
+        "insert into out",
+        batch_size=1,
+    )
+    # at the third event the engine's live set is {100.0, 50.0} (the
+    # straggler was conservatively evicted): min/max must agree with
+    # its own count/sum view
+    lo, hi, c = out[-1]
+    assert c == 2
+    assert (lo, hi) == (50.0, 100.0)
