@@ -141,9 +141,9 @@ def build_job(config, n_events, batch):
         retain_results=False,
     )
     # latency/throughput trade-off knobs (defaults tuned on TPU v5e-1)
-    job.max_inflight_cycles = int(os.environ.get("BENCH_INFLIGHT", 4))
+    job.max_inflight_cycles = int(os.environ.get("BENCH_INFLIGHT", 8))
     job.drain_interval_ms = float(
-        os.environ.get("BENCH_DRAIN_MS", 250.0)
+        os.environ.get("BENCH_DRAIN_MS", 400.0)
     )
     job.prewarm_drains()
     return job

@@ -1644,12 +1644,15 @@ class StackedChainArtifact:
 
         # Per-query relevance compaction ('->' chains ignore events that
         # match none of the query's elements): each query advances over
-        # its own R = E//8 compacted window, cutting the V-sized
-        # pointer-chase gathers AND the per-query intermediates ~8x. One
-        # shared lax.cond falls back to the chunked full path in the
-        # (rare) batch where any query has more than R relevant events.
+        # its own compacted window, cutting the V-sized pointer-chase
+        # gathers AND the per-query intermediates. Stacked members are
+        # selective by construction (structurally-identical literal
+        # filters), so the window is E//16 — tighter than the single
+        # chain's E//8 — and one shared lax.cond falls back to the
+        # chunked full path in the (rare) batch where any query has
+        # more relevant events.
         if E >= _COMPACT_MIN_E:
-            Rw = _compact_width(E)
+            Rw = max(2048, E // 16)
 
             def compact_one(pr):
                 rel = pr.any(axis=0) & tape.valid
