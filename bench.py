@@ -129,10 +129,11 @@ def build_job(config, n_events, batch):
     src = BatchSource("inputStream", schema, iter(batches))
     from flink_siddhi_tpu.compiler.config import EngineConfig
 
-    # late materialization: projection-only columns (price, and the
-    # timestamps' source column) stay host-side — the wire carries only
-    # the predicate column + ts deltas (~2 B/event on the headline)
-    ecfg = EngineConfig(lazy_projection=True)
+    # late materialization + wire predicate pushdown: projection-only
+    # columns stay host-side (ordinals decode against retained batches)
+    # and host-evaluable predicates ship as packed mask bits — the
+    # headline wire drops to 3 predicate bits/event, the filter to 1
+    ecfg = EngineConfig(lazy_projection=True, pred_pushdown=True)
     plan = compile_plan(
         cql, {"inputStream": schema}, plan_id="bench", config=ecfg
     )

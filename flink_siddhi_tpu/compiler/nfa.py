@@ -300,6 +300,9 @@ class _PatternSpec:
     # mid-chain `-> every X`: elements where every matching event FORKS a
     # continuing instance while the matched prefix stays armed
     every_marks: Tuple[bool, ...] = ()
+    # wire predicate pushdown: per element, the numpy twin of its
+    # event-only filter (None when absent or not host-evaluable)
+    host_pred_fns: Tuple = ()
 
     @property
     def n_elements(self) -> int:
@@ -412,6 +415,7 @@ def _build_spec(
                 out.add((alias_idx[a.qualifier], a.name, a.index))
         return tuple(sorted(out))
 
+    host_pred_fns: List = []
     for i, el in enumerate(inp.elements):
         schema = schemas[el.stream_id]
         if el.filter is None:
@@ -419,6 +423,7 @@ def _build_spec(
             cross_fns.append(None)
             cross_refs.append(())
             cross_idx_refs.append(())
+            host_pred_fns.append(None)
             continue
         foreign = {
             a.qualifier
@@ -440,6 +445,9 @@ def _build_spec(
             cross_fns.append(None)
             cross_refs.append(())
             cross_idx_refs.append(())
+            from .expr import compile_host_pred
+
+            host_pred_fns.append(compile_host_pred(el.filter, resolver))
             continue
         if el.negated:
             raise SiddhiQLError(
@@ -456,6 +464,7 @@ def _build_spec(
         cross_fns.append(ce.fn)
         cross_refs.append(tuple(sorted(alias_idx[a] for a in foreign)))
         cross_idx_refs.append(_indexed_refs(el.filter))
+        host_pred_fns.append(None)
     if q.selector.is_star:
         raise SiddhiQLError(
             "select * is not valid for pattern queries; name the captures"
@@ -562,6 +571,7 @@ def _build_spec(
         every_marks=tuple(
             getattr(el, "every_marked", False) for el in inp.elements
         ),
+        host_pred_fns=tuple(host_pred_fns),
     )
 
 
@@ -969,6 +979,9 @@ class ChainPatternArtifact:
     # the host's retained batches (a tunneled/remote device is
     # ingest-bandwidth-bound; see runtime/executor._LazyRing)
     lazy_pairs: Tuple[Tuple[int, str], ...] = ()
+    # wire predicate pushdown: element indices whose event-only filters
+    # are host-evaluated and shipped as packed mask bits ("@p:<i>" cols)
+    pushed_preds: Tuple[int, ...] = ()
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
         """Widest per-cycle emission block (drain-cadence contract)."""
@@ -1298,6 +1311,13 @@ class ChainPatternArtifact:
     @property
     def wants_lookup(self) -> bool:
         return bool(self.lazy_pairs)
+
+    @property
+    def lazy_src_keys(self) -> Tuple[str, ...]:
+        """Tape-column keys whose values the host ring must retain."""
+        return tuple(
+            sorted({self.spec.cap_src_key[p] for p in self.lazy_pairs})
+        )
 
     def decode_packed(self, n: int, block: "np.ndarray", lookup=None):
         """With lazy pairs, projection rows carrying ordinals resolve
@@ -1843,9 +1863,10 @@ def chain_template_of(
     than the statically-compiled query, which promotes to a common type)."""
     if not isinstance(artifact, ChainPatternArtifact):
         return None
-    if artifact.lazy_pairs:
-        # a lazy-projected plan's tape lacks the projection columns the
-        # parametric group would capture from; it keeps its own runtime
+    if artifact.lazy_pairs or artifact.pushed_preds:
+        # a lazy-projected / predicate-pushed plan's tape lacks the raw
+        # columns the parametric group would read; it keeps its own
+        # runtime
         return None
     spec = artifact.spec
     if spec.kind != "pattern" or spec.has_cross:
@@ -2097,17 +2118,21 @@ class DynamicChainGroup:
         )
 
 
-def apply_lazy_projection(artifact: "ChainPatternArtifact"):
+def apply_lazy_projection(
+    artifact: "ChainPatternArtifact",
+    skip_pred_elements: frozenset = frozenset(),
+):
     """Late materialization for a chain plan: capture pairs that are
     PROJECTION-ONLY (their column feeds no predicate, and every select
     item reading them is a plain reference) switch to ordinal capture,
     and their columns drop off the device tape entirely. Returns the set
     of tape columns the device still needs, or None when nothing is
-    lazy-eligible."""
+    lazy-eligible. ``skip_pred_elements``: elements whose filters were
+    pushed to the host wire — their columns no longer pin the tape."""
     spec = artifact.spec
     pred_cols = set()
-    for el in spec.elements:
-        if el.filter is None:
+    for i, el in enumerate(spec.elements):
+        if el.filter is None or i in skip_pred_elements:
             continue
         for a in ast.iter_attrs(el.filter):
             pred_cols.add(f"{el.stream_id}.{a.name}")
@@ -2131,7 +2156,75 @@ def apply_lazy_projection(artifact: "ChainPatternArtifact"):
     for pair in pairs:
         if pair not in artifact.lazy_pairs:
             needed.add(spec.cap_src_key[pair])
+    needed |= set(spec.evt_keys)  # cross filters read these off the tape
     return needed
+
+
+def chain_wire_opts(artifact: "ChainPatternArtifact", config):
+    """Wire optimizations for a chain plan, in order: predicate pushdown
+    (host-evaluable event-only element filters collapse to one packed
+    mask bit per element) then late materialization (with pushed
+    predicate columns now lazy-eligible). Returns (needed_device_columns,
+    host_preds) or None when nothing applies."""
+    from ..runtime.tape import HostPred
+
+    spec = artifact.spec
+    host_preds = []
+    pushed = []
+    if config.pred_pushdown:
+        candidates = [
+            i
+            for i, he in enumerate(spec.host_pred_fns)
+            if he is not None and spec.pred_fns[i] is not None
+        ]
+        # push only elements whose masks FREE wire columns. Columns that
+        # stay regardless: cross-filter event reads, unpushable element
+        # predicates, and capture sources that cannot go lazy (computed
+        # projections, or lazy projection disabled).
+        kept_base = set(spec.evt_keys)
+        for i, el in enumerate(spec.elements):
+            if el.filter is None or i in candidates:
+                continue
+            for a in ast.iter_attrs(el.filter):
+                kept_base.add(f"{el.stream_id}.{a.name}")
+        for pair in _cap_pairs(spec):
+            if not config.lazy_projection:
+                kept_base.add(spec.cap_src_key[pair])
+                continue
+            for pi, prs in enumerate(spec.proj_ref_pairs):
+                if pair in prs and spec.proj_srcs[pi] != pair:
+                    kept_base.add(spec.cap_src_key[pair])
+                    break
+        for i in candidates:
+            he = spec.host_pred_fns[i]
+            if not (set(he.refs) - kept_base):
+                continue  # frees nothing: keep the device predicate
+            key = f"@p:{i}"
+            host_preds.append(HostPred(key, he.fn, he.refs))
+            spec.pred_fns[i] = lambda env, k=key: env[k]
+            pushed.append(i)
+        artifact.pushed_preds = tuple(pushed)
+
+    lazy_needed = None
+    if config.lazy_projection:
+        lazy_needed = apply_lazy_projection(
+            artifact, skip_pred_elements=frozenset(pushed)
+        )
+
+    if not host_preds and lazy_needed is None:
+        return None
+    if lazy_needed is not None:
+        needed = set(lazy_needed)
+    else:
+        needed = set(spec.evt_keys)
+        for i, el in enumerate(spec.elements):
+            if el.filter is None or i in pushed:
+                continue
+            for a in ast.iter_attrs(el.filter):
+                needed.add(f"{el.stream_id}.{a.name}")
+        for pair in _cap_pairs(spec):
+            needed.add(spec.cap_src_key[pair])
+    return needed, tuple(host_preds)
 
 
 def _decode_qid_block(n: int, block, slot_schemas):

@@ -600,19 +600,28 @@ def compile_plan(
     # lever on remote/tunneled devices (wire drops to the predicate
     # columns + timestamps)
     device_columns = None
-    if config.lazy_projection and len(artifacts) == 1:
-        from .nfa import ChainPatternArtifact, apply_lazy_projection
+    host_preds = ()
+    if (
+        config.lazy_projection or config.pred_pushdown
+    ) and len(artifacts) == 1:
+        from .nfa import ChainPatternArtifact, chain_wire_opts
+        from .select import SelectArtifact, select_wire_opts
 
+        res = None
         if isinstance(artifacts[0], ChainPatternArtifact):
-            needed = apply_lazy_projection(artifacts[0])
-            if needed is not None:
-                device_columns = tuple(
-                    k for k in columns if k in needed
-                )
+            res = chain_wire_opts(artifacts[0], config)
+        elif isinstance(artifacts[0], SelectArtifact):
+            res = select_wire_opts(artifacts[0], config)
+        if res is not None:
+            needed, host_preds = res
+            device_columns = tuple(
+                k for k in columns if k in needed
+            )
 
     spec = TapeSpec(
         stream_codes, tuple(columns), column_types, tuple(encoded),
         device_columns=device_columns,
+        host_preds=tuple(host_preds),
     )
 
     partitions = infer_stream_partitions(parsed.queries)

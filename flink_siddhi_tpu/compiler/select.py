@@ -10,21 +10,34 @@ SiddhiStreamOperator.java:51-54).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, FrozenSet, List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..query import ast
 from ..query.lexer import SiddhiQLError
 from ..schema.types import AttributeType
-from .expr import ColumnEnv, CompiledExpr, ExprResolver, compile_expr
-from .output import OutputField, OutputSchema
+from .expr import (
+    ColumnEnv,
+    CompiledExpr,
+    ExprResolver,
+    compile_expr,
+    compile_host_pred,
+)
+from .output import OutputField, OutputSchema, emission_order
 
 
 @dataclass
 class SelectArtifact:
     """Compiled stateless query. State = {'enabled': bool scalar} so the
-    control plane can pause/resume it (OperationControlEvent parity)."""
+    control plane can pause/resume it (OperationControlEvent parity).
+
+    With lazy projection applied (``apply_lazy_select``), projection-only
+    columns never ship to the device at all: their output rows carry the
+    event's ordinal instead, resolved against the host-retained batch at
+    decode time. For a tunneled accelerator this drops the stateless-query
+    wire to the predicate columns + timestamp deltas."""
 
     name: str
     output_schema: OutputSchema
@@ -32,10 +45,33 @@ class SelectArtifact:
     stream_code: int
     filter_fns: List
     proj_fns: List
-    event_ts_fn: Optional[object] = None
+    # per select item: tape key when the item is a plain attribute
+    # reference, else None; and the set of tape keys the item reads
+    proj_srcs: Tuple[Optional[str], ...] = ()
+    proj_refs: Tuple[FrozenSet[str], ...] = ()
+    pred_keys: FrozenSet[str] = frozenset()
+    # per filter conjunct: the numpy-compiled twin (None when the
+    # conjunct isn't host-evaluable) and the tape keys it reads
+    host_filter_fns: Tuple = ()
+    filter_refs: Tuple[FrozenSet[str], ...] = ()
+    # late materialization (set by apply_lazy_select): tape keys whose
+    # values stay host-side; their rows emit ordinals
+    lazy_pairs: Tuple[str, ...] = ()
+    # wire predicate pushdown (set by select_wire_opts): conjuncts now
+    # evaluated host-side and shipped as one packed mask bit
+    pushed_preds: Tuple[int, ...] = ()
+
+    @property
+    def lazy_src_keys(self) -> Tuple[str, ...]:
+        return self.lazy_pairs
 
     def init_state(self) -> Dict:
-        return {"enabled": jnp.asarray(True)}
+        state = {"enabled": jnp.asarray(True)}
+        if self.lazy_pairs:
+            # ordinal base: counts every valid event ever seen, the same
+            # space the host's lazy ring is pushed in
+            state["seen"] = jnp.zeros((), jnp.int32)
+        return state
 
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
@@ -44,11 +80,180 @@ class SelectArtifact:
             mask = mask & f(env)
         mask = mask & state["enabled"]
         cap = tape.capacity
+        if not self.lazy_pairs:
+            cols = tuple(
+                jnp.broadcast_to(jnp.asarray(p(env)), (cap,))
+                for p in self.proj_fns
+            )
+            return state, (mask, tape.ts, cols)
+        lazy = set(self.lazy_pairs)
+        ordinal = state["seen"] + jnp.arange(cap, dtype=jnp.int32)
         cols = tuple(
-            jnp.broadcast_to(jnp.asarray(p(env)), (cap,))
-            for p in self.proj_fns
+            ordinal
+            if src is not None and src in lazy
+            else jnp.broadcast_to(jnp.asarray(p(env)), (cap,))
+            for src, p in zip(self.proj_srcs, self.proj_fns)
         )
-        return state, (mask, tape.ts, cols)
+        new_state = dict(state)
+        new_state["seen"] = (
+            state["seen"] + tape.valid.sum().astype(jnp.int32)
+        )
+        return new_state, (mask, tape.ts, cols)
+
+    @property
+    def wants_lookup(self) -> bool:
+        return bool(self.lazy_pairs)
+
+    def decode_packed(self, n: int, block: "np.ndarray", lookup=None):
+        """Lazy-mode decode: ordinal rows resolve against the host ring;
+        evicted ordinals decode as None (bounded-memory policy)."""
+        schema = self.output_schema
+        if not self.lazy_pairs:
+            return [(schema, schema.decode_packed_block(n, block))]
+        lazy = set(self.lazy_pairs)
+        order = emission_order(block[0], n)
+        ts_list = (
+            np.asarray(block[0, :n])[order].astype(np.int64).tolist()
+        )
+        col_lists = []
+        for c, f in enumerate(schema.fields):
+            raw = np.asarray(block[1 + c, :n])[order]
+            src = self.proj_srcs[c]
+            if src is not None and src in lazy:
+                vals = (
+                    lookup(src, raw)
+                    if lookup is not None
+                    else [None] * n
+                )
+                if f.table is not None:
+                    vals = [
+                        None if v is None else f.table.value(int(v))
+                        for v in vals
+                    ]
+                else:
+                    vals = [
+                        None if v is None
+                        else (v.item() if hasattr(v, "item") else v)
+                        for v in vals
+                    ]
+                col_lists.append(vals)
+            else:
+                if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
+                    raw = raw.view(np.float32)
+                col_lists.append(f.decode_column(raw))
+        rows = (
+            list(zip(ts_list, map(tuple, zip(*col_lists))))
+            if col_lists
+            else [(t, ()) for t in ts_list]
+        )
+        return [(schema, rows)]
+
+
+def apply_lazy_select(artifact: SelectArtifact):
+    """Late materialization for a stateless query: plain-reference select
+    items whose column feeds no predicate (and no computed expression)
+    switch to ordinal emission, and their columns drop off the device
+    tape. Returns the tape columns the device still needs, or None when
+    nothing is lazy-eligible."""
+    keep = set(artifact.pred_keys)
+    for src, refs in zip(artifact.proj_srcs, artifact.proj_refs):
+        if src is None:
+            keep |= set(refs)
+    lazy = {
+        src for src in artifact.proj_srcs if src is not None
+    } - keep
+    if not lazy:
+        return None
+    artifact.lazy_pairs = tuple(sorted(lazy))
+    return keep
+
+
+def select_wire_opts(artifact: SelectArtifact, config):
+    """Wire optimizations for a stateless query, in order: predicate
+    pushdown (host-evaluable conjuncts collapse to ONE packed mask bit
+    per event) then late materialization (with pushed predicate columns
+    now lazy-eligible). Returns (needed_device_columns, host_preds) or
+    None when nothing applies."""
+    from ..runtime.tape import HostPred
+
+    host_preds: Tuple[HostPred, ...] = ()
+    if config.pred_pushdown and artifact.filter_fns:
+        pushable = [
+            i
+            for i, h in enumerate(artifact.host_filter_fns)
+            if h is not None
+        ]
+        if pushable:
+            # push only if it actually FREES wire columns: a pushed
+            # conjunct whose columns still ship (computed projections,
+            # unpushed conjuncts, or non-lazy plain projections) adds a
+            # mask bit and host work for zero savings
+            kept_cols = set()
+            for i, refs in enumerate(artifact.filter_refs):
+                if i not in pushable:
+                    kept_cols |= set(refs)
+            for src, refs in zip(
+                artifact.proj_srcs, artifact.proj_refs
+            ):
+                if src is None:
+                    kept_cols |= set(refs)
+                elif not config.lazy_projection:
+                    kept_cols.add(src)
+            pushed_refs = {
+                k
+                for i in pushable
+                for k in artifact.host_filter_fns[i].refs
+            }
+            if not (pushed_refs - kept_cols):
+                pushable = []
+        if pushable:
+            fns = tuple(
+                artifact.host_filter_fns[i].fn for i in pushable
+            )
+            refs = tuple(
+                sorted(
+                    {
+                        k
+                        for i in pushable
+                        for k in artifact.host_filter_fns[i].refs
+                    }
+                )
+            )
+            key = "@p:0"
+
+            def mask_fn(env, _fns=fns):
+                m = _fns[0](env)
+                for f in _fns[1:]:
+                    m = np.logical_and(m, f(env))
+                return m
+
+            host_preds = (HostPred(key, mask_fn, refs),)
+            kept = set(range(len(artifact.filter_fns))) - set(pushable)
+            artifact.filter_fns = [
+                f
+                for i, f in enumerate(artifact.filter_fns)
+                if i in kept
+            ] + [lambda env, k=key: env[k]]
+            artifact.pred_keys = frozenset(
+                k
+                for i in kept
+                for k in artifact.filter_refs[i]
+            )
+            artifact.pushed_preds = tuple(pushable)
+
+    lazy_needed = None
+    if config.lazy_projection:
+        lazy_needed = apply_lazy_select(artifact)
+
+    if not host_preds and lazy_needed is None:
+        return None
+    if lazy_needed is not None:
+        needed = set(lazy_needed)
+    else:
+        needed = set(artifact.pred_keys)
+        for refs in artifact.proj_refs:
+            needed |= set(refs)
+    return needed, host_preds
 
 
 def compile_select(
@@ -62,11 +267,20 @@ def compile_select(
     inp = query.input
     assert isinstance(inp, ast.StreamInput)
     filter_fns = []
+    pred_keys = set()
+    host_filter_fns = []
+    filter_refs = []
     for f in inp.filters:
         ce = compile_expr(f, resolver, extensions)
         if ce.atype != AttributeType.BOOL:
             raise SiddhiQLError("stream filter must be boolean")
         filter_fns.append(ce.fn)
+        refs = frozenset(
+            resolver.resolve(a).key for a in ast.iter_attrs(f)
+        )
+        filter_refs.append(refs)
+        pred_keys |= refs
+        host_filter_fns.append(compile_host_pred(f, resolver))
 
     items = query.selector.items
     if query.selector.is_star:
@@ -77,11 +291,23 @@ def compile_select(
 
     proj_fns = []
     out_fields = []
+    proj_srcs = []
+    proj_refs = []
     for item in items:
         ce = compile_expr(item.expr, resolver, extensions)
         proj_fns.append(ce.fn)
         out_fields.append(
             OutputField(item.output_name(), ce.atype, ce.table)
+        )
+        proj_srcs.append(
+            resolver.resolve(item.expr).key
+            if isinstance(item.expr, ast.Attr) and item.expr.index is None
+            else None
+        )
+        proj_refs.append(
+            frozenset(
+                resolver.resolve(a).key for a in ast.iter_attrs(item.expr)
+            )
         )
     return SelectArtifact(
         name=name,
@@ -90,4 +316,9 @@ def compile_select(
         stream_code=stream_code,
         filter_fns=filter_fns,
         proj_fns=proj_fns,
+        proj_srcs=tuple(proj_srcs),
+        proj_refs=tuple(proj_refs),
+        pred_keys=frozenset(pred_keys),
+        host_filter_fns=tuple(host_filter_fns),
+        filter_refs=tuple(filter_refs),
     )

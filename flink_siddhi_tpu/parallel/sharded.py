@@ -156,15 +156,23 @@ class ShardedJob(Job):
         # dynamic-group folding is a single-device optimization; sharded
         # adds keep one runtime per plan (dynamic flag accepted for API
         # parity)
-        if any(getattr(a, "lazy_pairs", ()) for a in plan.artifacts):
-            # lazy projection is single-device (the ordinal ring lives on
-            # one host): auto-recompile without it instead of refusing
+        if (
+            any(getattr(a, "lazy_pairs", ()) for a in plan.artifacts)
+            or plan.spec.host_preds
+        ):
+            # lazy projection / predicate pushdown are single-device
+            # (the ordinal ring and the host mask evaluation live on one
+            # ingest host): auto-recompile without them instead of
+            # refusing
             _LOG.warning(
-                "%s: lazy projection is single-device; recompiling the "
-                "plan with lazy_projection=False for the sharded mesh",
+                "%s: lazy projection / predicate pushdown are "
+                "single-device; recompiling the plan without them for "
+                "the sharded mesh",
                 plan.plan_id,
             )
-            plan = plan.recompiled(lazy_projection=False)
+            plan = plan.recompiled(
+                lazy_projection=False, pred_pushdown=False
+            )
         parts = plan.partitions
         if plan.chained:
             # chained consumers keep per-shard state and the producer's

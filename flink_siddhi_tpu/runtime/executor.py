@@ -244,9 +244,9 @@ class Job:
         if admit0 is not None:
             rt.states = admit0(rt.states)
         lazy_keys = {
-            a.spec.cap_src_key[pair]
+            key
             for a in plan.artifacts
-            for pair in getattr(a, "lazy_pairs", ())
+            for key in getattr(a, "lazy_src_keys", ())
         }
         rt.lazy_keys = lazy_keys
         rt.lazy = (
@@ -261,7 +261,7 @@ class Job:
             (
                 a.name
                 for a in plan.artifacts
-                if getattr(a, "lazy_pairs", ())
+                if getattr(a, "lazy_src_keys", ())
             ),
             None,
         )
@@ -1014,20 +1014,33 @@ class Job:
             # retain the merged-order values of projection-only columns;
             # the device will emit ordinals into this ring's space
             lcols: Dict[str, np.ndarray] = {}
-            for key in rt.lazy_keys:
-                sid, fname = key.split(".", 1)
-                col = None
-                for bi, b in enumerate(involved):
-                    if b.stream_id != sid:
-                        continue
-                    sel = _prov[:, 0] == bi
-                    if col is None:
-                        col = np.zeros(
-                            total, dtype=b.columns[fname].dtype
-                        )
-                    col[sel] = b.columns[fname][_prov[sel, 1]]
-                if col is not None:
-                    lcols[key] = col
+            if len(involved) == 1:
+                # single sorted batch: merged order == batch order — a
+                # plain copy replaces the provenance gather. The copy is
+                # NOT optional: sources may legally reuse column buffers
+                # across polls, and event-time releases are views into a
+                # larger concat base (aliasing would both corrupt later
+                # decodes and break the ring's byte accounting)
+                b = involved[0]
+                for key in rt.lazy_keys:
+                    sid, fname = key.split(".", 1)
+                    if b.stream_id == sid:
+                        lcols[key] = np.array(b.columns[fname])
+            else:
+                for key in rt.lazy_keys:
+                    sid, fname = key.split(".", 1)
+                    col = None
+                    for bi, b in enumerate(involved):
+                        if b.stream_id != sid:
+                            continue
+                        sel = _prov[:, 0] == bi
+                        if col is None:
+                            col = np.zeros(
+                                total, dtype=b.columns[fname].dtype
+                            )
+                        col[sel] = b.columns[fname][_prov[sel, 1]]
+                    if col is not None:
+                        lcols[key] = col
             rt.lazy.push(rt.lazy_base, lcols)
             rt.lazy_base += total
         # host interning may have discovered new group keys: re-bucket state

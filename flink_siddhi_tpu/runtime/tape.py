@@ -49,6 +49,18 @@ class EncodedColumn:
 
 
 @dataclass(frozen=True)
+class HostPred:
+    """A predicate evaluated host-side (numpy) and shipped as ONE BIT per
+    event instead of its raw columns (wire predicate pushdown). ``fn``
+    maps a dict of merged-order host columns (raw host dtypes — f64 for
+    DOUBLE) to a bool mask; ``refs`` are the tape keys it reads."""
+
+    out_key: str  # "@p:<n>" pseudo-column the device reads
+    fn: object  # Dict[str, np.ndarray] -> np.ndarray[bool]
+    refs: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
 class TapeSpec:
     """What the step needs materialized."""
 
@@ -60,6 +72,8 @@ class TapeSpec:
     # device (projection-only columns stay host-side; the engine emits
     # event ordinals that decode against the host's retained batches)
     device_columns: Optional[Tuple[str, ...]] = None
+    # wire predicate pushdown: host-evaluated masks added to the tape
+    host_preds: Tuple[HostPred, ...] = ()
 
     def built_columns(self) -> Tuple[str, ...]:
         if self.device_columns is None:
@@ -119,8 +133,10 @@ _KIND_DTYPE = {
     "i16": np.int16,
     "i32": np.int32,
     "f32": np.float32,
-    "b": np.bool_,
+    "b": np.bool_,  # legacy unpacked bools (still expandable)
+    "b1": np.uint8,  # bit-packed bools: 1 bit/event on the wire
 }
+_TS_KINDS = ("d0", "d8", "d16", "i32")  # widening order
 
 
 def _int_kind(lo: int, hi: int) -> str:
@@ -144,12 +160,15 @@ class WireTape:
     stream_const: int = -1  # valid when stream is None
     epoch_i32: int = 0  # int32-wrapped epoch for alias reconstruction
 
-    ts_kind: str = "i32"  # 'i32' absolute | 'd8'/'d16' deltas (+ base)
-    ts_base: object = None  # int32[1], first timestamp (delta kinds)
+    # 'i32' absolute | 'd8'/'d16' per-event deltas (+ base) | 'd0'
+    # constant delta: ZERO wire bytes — ts reconstructs from (base, step)
+    ts_kind: str = "i32"
+    ts_base: object = None  # int32[1] first ts, or int32[2] (first, step)
+    cap: int = 0  # static tape capacity ('d0' ships no ts array)
 
     @property
     def capacity(self) -> int:
-        return self.ts.shape[-1]
+        return self.cap if self.cap else self.ts.shape[-1]
 
     def tree_flatten(self):
         keys = tuple(sorted(self.cols))
@@ -157,25 +176,33 @@ class WireTape:
             self.cols[k] for k in keys
         )
         aux = (keys, self.kinds, self.stream_const, self.epoch_i32,
-               self.ts_kind)
+               self.ts_kind, self.cap)
         return children, aux
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        keys, kinds, stream_const, epoch_i32, ts_kind = aux
+        keys, kinds, stream_const, epoch_i32, ts_kind, cap = aux
         ts, n_valid, stream, ts_base = children[:4]
         cols = dict(zip(keys, children[4:]))
         return cls(ts, n_valid, stream, cols, kinds, stream_const,
-                   epoch_i32, ts_kind, ts_base)
+                   epoch_i32, ts_kind, ts_base, cap)
 
     def expand(self) -> Tape:
         import jax.numpy as jnp
 
-        cap = self.ts.shape[-1]
+        cap = self.capacity
         iota = jnp.arange(cap, dtype=jnp.int32)
         valid = iota < self.n_valid[0]
         if self.ts_kind == "i32":
             ts = self.ts
+        elif self.ts_kind == "d0":
+            # regular cadence: ts = base + step*i, clamped so padding
+            # repeats the last valid timestamp (build_tape contract:
+            # padding must never look like the newest event)
+            last = jnp.maximum(self.n_valid[0] - 1, 0)
+            ts = self.ts_base[0] + self.ts_base[1] * jnp.minimum(
+                iota, last
+            )
         else:
             # sorted timestamps travel as per-event deltas; the padding
             # deltas are 0, which reproduces build_tape's "padding repeats
@@ -193,6 +220,12 @@ class WireTape:
         for key, kind in self.kinds:
             if kind == "alias_ts":
                 cols[key] = ts + jnp.int32(self.epoch_i32)
+            elif kind == "b1":
+                packed = self.cols[key]
+                bits = (
+                    packed[:, None] >> jnp.arange(8, dtype=packed.dtype)
+                ) & 1
+                cols[key] = jnp.reshape(bits, (-1,)).astype(jnp.bool_)
             elif kind == "f32" or kind == "b":
                 cols[key] = self.cols[key]
             else:
@@ -226,7 +259,7 @@ def build_wire_tape(
             if col.dtype == np.float32:
                 kind = "f32"
             elif col.dtype == np.bool_:
-                kind = "b"
+                kind = "b1"  # bit-packed: 1 bit/event on the wire
             else:
                 # alias check first (0 wire bytes); sticky 'alias_ts' may
                 # degrade to a real int kind the first time it mismatches
@@ -251,29 +284,47 @@ def build_wire_tape(
                                          order.index(sticky))]
             sticky_kinds[key] = kind
             kinds.append((key, kind))
-            if kind != "alias_ts":
+            if kind == "b1":
+                cols[key] = np.packbits(col, bitorder="little")
+            elif kind != "alias_ts":
                 cols[key] = (
                     col
                     if kind in ("f32", "b", "i32")
                     else col.astype(_KIND_DTYPE[kind])
                 )
 
-    # timestamps: sorted, so deltas are small -> 1-2 wire bytes instead of 4
+    # timestamps: sorted, so deltas are small -> 1-2 wire bytes instead
+    # of 4; a perfectly regular cadence ('d0', the common replay/sensor
+    # shape) ships ZERO ts bytes — just (first, step)
     ts_kind = sticky_kinds.get("__ts__")
     ts_arr = tape.ts
     ts_base = None
     if ts_kind != "i32" and total:
         deltas = np.diff(tape.ts.astype(np.int64), prepend=tape.ts[0])
-        dmax = int(deltas.max()) if len(deltas) else 0
-        dmin = int(deltas.min()) if len(deltas) else 0
-        want = "d8" if 0 <= dmin and dmax <= 127 else (
-            "d16" if 0 <= dmin and dmax <= 32767 else "i32"
-        )
-        order = ("d8", "d16", "i32")
-        if ts_kind in order and want in order:
-            want = order[max(order.index(want), order.index(ts_kind))]
+        vd = deltas[1:total]  # valid-region deltas (padding repeats)
+        dmax = int(vd.max()) if len(vd) else 0
+        dmin = int(vd.min()) if len(vd) else 0
+        # d0 needs EVIDENCE of a regular cadence: a small batch is
+        # trivially "constant" and would degrade (retrace) on the next
+        # irregular one — below the threshold the saving is noise anyway
+        if dmin == dmax and 0 <= dmin <= (1 << 30) and total >= 4096:
+            want = "d0"
+        elif 0 <= dmin and dmax <= 127:
+            want = "d8"
+        elif 0 <= dmin and dmax <= 32767:
+            want = "d16"
+        else:
+            want = "i32"
+        if ts_kind in _TS_KINDS and want in _TS_KINDS:
+            want = _TS_KINDS[
+                max(_TS_KINDS.index(want), _TS_KINDS.index(ts_kind))
+            ]
         ts_kind = want
-        if ts_kind != "i32":
+        if ts_kind == "d0":
+            step = int(vd[0]) if len(vd) else 0
+            ts_base = np.asarray([tape.ts[0], step], dtype=np.int32)
+            ts_arr = np.zeros(0, dtype=np.int8)
+        elif ts_kind != "i32":
             ts_base = np.asarray([tape.ts[0]], dtype=np.int32)
             ts_arr = deltas.astype(
                 np.int8 if ts_kind == "d8" else np.int16
@@ -301,8 +352,45 @@ def build_wire_tape(
         epoch_i32=epoch_i32,
         ts_kind=ts_kind,
         ts_base=ts_base,
+        cap=tape.capacity,
     )
     return wire, prov
+
+
+def _merged_stream_values(
+    batches: Sequence[EventBatch],
+    stream_id: str,
+    field: str,
+    total: int,
+    order,
+    identity: bool,
+    dtype=None,
+):
+    """One (stream, field)'s values in merged tape order, or None when no
+    batch carries the stream. THE single implementation of the
+    batches->merged-order scatter (device columns and host-predicate
+    inputs both go through it). Native host dtype unless ``dtype`` is
+    given. Single-batch results may alias the batch's column — callers
+    must copy before retaining."""
+    if len(batches) == 1:
+        b = batches[0]
+        if b.stream_id != stream_id:
+            return None
+        col = b.columns[field]
+        return col if dtype is None else col.astype(dtype, copy=False)
+    merged = None
+    offset = 0
+    for b in batches:
+        n = len(b)
+        if b.stream_id == stream_id and n:
+            if merged is None:
+                dt = dtype if dtype is not None else b.columns[field].dtype
+                merged = np.zeros(total, dtype=dt)
+            merged[offset : offset + n] = b.columns[field]
+        offset += n
+    if merged is None:
+        return None
+    return merged if identity else merged[order]
 
 
 def build_tape(
@@ -336,10 +424,21 @@ def build_tape(
         prov[offset : offset + n, 1] = np.arange(n)
         offset += n
 
-    order = np.argsort(ts_all, kind="stable")
-    ts_sorted = ts_all[order]
-    stream_sorted = stream_all[order]
-    prov = prov[order]
+    # per-stream batches arrive time-sorted (the reorder buffer sorts on
+    # release), so a single-batch cycle — and any multi-batch cycle whose
+    # concatenation happens to interleave in order — needs no argsort at
+    # all; the O(n) sortedness check replaces the O(n log n) stable sort
+    # and, more importantly, all the gather copies behind it
+    identity = total == 0 or bool(np.all(ts_all[1:] >= ts_all[:-1]))
+    order = None
+    if identity:
+        ts_sorted = ts_all
+        stream_sorted = stream_all
+    else:
+        order = np.argsort(ts_all, kind="stable")
+        ts_sorted = ts_all[order]
+        stream_sorted = stream_all[order]
+        prov = prov[order]
 
     ts = np.zeros(cap, dtype=np.int32)
     ts[:total] = (ts_sorted - epoch_ms).astype(np.int32)
@@ -357,15 +456,11 @@ def build_tape(
         stream_id, field = key.split(".", 1)
         dtype = spec.column_types[key].device_dtype
         col = np.zeros(cap, dtype=dtype)
-        # scatter this stream's values into merged order
-        merged_vals = np.zeros(total, dtype=dtype)
-        offset = 0
-        for bi, b in enumerate(batches):
-            n = len(b)
-            if b.stream_id == stream_id and n:
-                merged_vals[offset : offset + n] = b.columns[field]
-            offset += n
-        col[:total] = merged_vals[order]
+        vals = _merged_stream_values(
+            batches, stream_id, field, total, order, identity, dtype
+        )
+        if vals is not None:
+            col[:total] = vals
         cols[key] = col
 
     for enc in spec.encoded:
@@ -379,5 +474,30 @@ def build_tape(
         col = np.zeros(cap, dtype=np.int32)
         col[:total] = codes
         cols[enc.out_key] = col
+
+    # wire predicate pushdown: evaluate each host predicate over the
+    # merged-order RAW host columns (f64 where the schema says DOUBLE)
+    # and add the result as a bool pseudo-column — it ships bit-packed,
+    # replacing the raw predicate columns on the wire entirely
+    if spec.host_preds:
+        henv: Dict[str, np.ndarray] = {}
+        ref_keys = {k for hp in spec.host_preds for k in hp.refs}
+        for key in ref_keys:
+            stream_id, fname = key.split(".", 1)
+            vals = _merged_stream_values(
+                batches, stream_id, fname, total, order, identity
+            )
+            henv[key] = (
+                vals
+                if vals is not None
+                else np.zeros(total, dtype=np.int64)
+            )
+        for hp in spec.host_preds:
+            res = np.broadcast_to(
+                np.asarray(hp.fn(henv), dtype=np.bool_), (total,)
+            )
+            col = np.zeros(cap, dtype=np.bool_)
+            col[:total] = res
+            cols[hp.out_key] = col
 
     return Tape(ts, stream, valid, cols), prov

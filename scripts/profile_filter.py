@@ -1,0 +1,146 @@
+"""Phase-level steady-state profile of a bench config's hot loop.
+
+Times, per cycle: source pull + reorder, wire-tape build, lazy-ring push,
+jit dispatch, ticket backpressure wait, drain poll — the components of
+Job.run_cycle — plus the end flush. Prints a per-phase ms/cycle table so
+the host-vs-device split is visible.
+
+Usage: BENCH_CONFIG=filter BENCH_EVENTS=4000000 python scripts/profile_filter.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+import numpy as np  # noqa: E402
+
+import bench  # noqa: E402
+
+
+def main():
+    config = os.environ.get("BENCH_CONFIG", "filter")
+    n_events = int(os.environ.get("BENCH_EVENTS", 4_000_000))
+    batch = int(os.environ.get("BENCH_BATCH", 524_288))
+    job = bench.build_job(config, n_events, batch)
+
+    import jax
+
+    from flink_siddhi_tpu.runtime import executor as ex
+
+    phases = {}
+
+    def timed(name, fn):
+        def wrapper(*a, **kw):
+            t0 = time.perf_counter()
+            out = fn(*a, **kw)
+            phases[name] = phases.get(name, 0.0) + (time.perf_counter() - t0)
+            return out
+        return wrapper
+
+    # instrument the job's phases
+    job._pull_sources = timed("pull_sources", job._pull_sources)
+    job._release_ready = timed("release_ready", job._release_ready)
+    orig_step = job._step_plan
+
+    tape_t = {"t": 0.0}
+    orig_tape = ex.build_wire_tape
+
+    def tape_timed(*a, **kw):
+        t0 = time.perf_counter()
+        out = orig_tape(*a, **kw)
+        tape_t["t"] += time.perf_counter() - t0
+        return out
+
+    ex.build_wire_tape = tape_timed
+
+    dispatch_t = {"t": 0.0}
+    wait_t = {"t": 0.0}
+
+    def step_timed(rt, ready):
+        # wrap jitted_acc & ticket wait
+        orig_acc = rt.jitted_acc
+
+        def acc_timed(*a, **kw):
+            t0 = time.perf_counter()
+            out = orig_acc(*a, **kw)
+            dispatch_t["t"] += time.perf_counter() - t0
+            return out
+
+        rt.jitted_acc = acc_timed
+        orig_block = jax.block_until_ready
+
+        def block_timed(x):
+            t0 = time.perf_counter()
+            out = orig_block(x)
+            wait_t["t"] += time.perf_counter() - t0
+            return out
+
+        jax.block_until_ready = block_timed
+        t0 = time.perf_counter()
+        out = orig_step(rt, ready)
+        phases["step_plan_total"] = (
+            phases.get("step_plan_total", 0.0) + (time.perf_counter() - t0)
+        )
+        jax.block_until_ready = orig_block
+        rt.jitted_acc = orig_acc
+        return out
+
+    job._step_plan = step_timed
+    orig_poll = job._drain_poll
+
+    def poll_timed(*a, **kw):
+        t0 = time.perf_counter()
+        out = orig_poll(*a, **kw)
+        phases["drain_poll"] = (
+            phases.get("drain_poll", 0.0) + (time.perf_counter() - t0)
+        )
+        return out
+
+    job._drain_poll = poll_timed
+
+    warmup = 3
+    cycles = 0
+    t0 = time.perf_counter()
+    counted_at = 0
+    t_meas = t0
+    while not job.finished:
+        job.run_cycle()
+        cycles += 1
+        if cycles == warmup:
+            phases.clear()
+            tape_t["t"] = 0.0
+            dispatch_t["t"] = 0.0
+            wait_t["t"] = 0.0
+            t_meas = time.perf_counter()
+            counted_at = job.processed_events
+    tf0 = time.perf_counter()
+    job.flush()
+    flush_t = time.perf_counter() - tf0
+    elapsed = time.perf_counter() - t_meas
+    measured = job.processed_events - counted_at
+    n_cyc = max(cycles - warmup, 1)
+    print(f"config={config} events={measured} cycles={n_cyc} "
+          f"elapsed={elapsed:.3f}s  ev/s={measured/elapsed:,.0f}")
+    print(f"{'phase':24s} {'total_s':>9s} {'ms/cycle':>9s}")
+    rows = dict(phases)
+    rows["wire_tape"] = tape_t["t"]
+    rows["jit_dispatch"] = dispatch_t["t"]
+    rows["ticket_wait"] = wait_t["t"]
+    rows["flush_end"] = flush_t
+    for k, v in sorted(rows.items(), key=lambda kv: -kv[1]):
+        print(f"{k:24s} {v:9.3f} {1e3*v/n_cyc:9.2f}")
+    acct = sum(v for k, v in rows.items()
+               if k not in ("step_plan_total",))
+    print(f"{'accounted':24s} {acct:9.3f}  (wall {elapsed:.3f})")
+
+
+if __name__ == "__main__":
+    main()

@@ -198,3 +198,86 @@ def test_sharded_job_auto_disables_lazy():
     assert sorted(job.results("matches")) == sorted(
         single.results("matches")
     )
+
+
+# -- lazy stateless select/filter (round-4: the filter bench was wire-
+# bound at 7 B/event because select plans always shipped every projected
+# column; lazy select drops the wire to predicate column + ts deltas) --
+
+SELECT_CQL = (
+    "from S[id == 2] select id, name, price insert into out"
+)
+
+
+def run_select(cfg, cql=SELECT_CQL, batch=64, n=2000):
+    plan = compile_plan(cql, {"S": SCHEMA}, config=cfg)
+    job = Job(
+        [plan],
+        [BatchSource("S", SCHEMA, iter(make_batches(n=n, batch=batch)))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return plan, job.results("out")
+
+
+def test_lazy_select_matches_eager():
+    plan_e, eager = run_select(EngineConfig())
+    plan_l, lazy = run_select(EngineConfig(lazy_projection=True))
+    # only the predicate column ships; name/price resolve host-side
+    assert plan_l.spec.device_columns == ("S.id",)
+    a = plan_l.artifacts[0]
+    assert set(a.lazy_pairs) == {"S.name", "S.price"}
+    assert len(eager) == len(lazy) > 0
+    for (ide, ne, pe), (idl, nl, pl) in zip(eager, lazy):
+        assert (ide, ne) == (idl, nl)
+        # lazy decodes the ORIGINAL float64; eager went through f32
+        assert pl == pytest.approx(pe, rel=1e-6)
+
+
+def test_lazy_select_no_filter_ships_nothing():
+    # a projection-only query's wire is just the timestamp deltas
+    cql = "from S select name, price insert into out"
+    plan_l, lazy = run_select(EngineConfig(lazy_projection=True), cql=cql)
+    assert plan_l.spec.device_columns == ()
+    _, eager = run_select(EngineConfig(), cql=cql)
+    assert len(lazy) == len(eager) == 2000
+    for (ne, pe), (nl, pl) in zip(eager, lazy):
+        assert ne == nl
+        assert pl == pytest.approx(pe, rel=1e-6)
+
+
+def test_lazy_select_computed_expr_stays_on_device():
+    cql = "from S[id == 2] select price * 2.0 as p2, name insert into out"
+    plan_l, lazy = run_select(EngineConfig(lazy_projection=True), cql=cql)
+    a = plan_l.artifacts[0]
+    assert a.lazy_pairs == ("S.name",)
+    assert "S.price" in plan_l.spec.device_columns
+    _, eager = run_select(EngineConfig(), cql=cql)
+    assert lazy == eager and len(lazy) > 0
+
+
+def test_lazy_select_survives_checkpoint_restore(tmp_path):
+    plan = compile_plan(
+        SELECT_CQL, {"S": SCHEMA},
+        config=EngineConfig(lazy_projection=True),
+    )
+    batches = make_batches(n=512, batch=64)
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(batches[:4]))],
+        batch_size=64, time_mode="processing",
+    )
+    job.run()
+    path = str(tmp_path / "ck")
+    job.save_checkpoint(path)
+    plan2 = compile_plan(
+        SELECT_CQL, {"S": SCHEMA},
+        config=EngineConfig(lazy_projection=True),
+    )
+    job2 = Job(
+        [plan2], [BatchSource("S", SCHEMA, iter(batches[4:]))],
+        batch_size=64, time_mode="processing",
+    )
+    job2.restore(path)
+    job2.run()
+    for row in job2.results("out"):
+        assert row[1] is not None and row[2] is not None
