@@ -987,6 +987,105 @@ class ChainPatternArtifact:
         """Widest per-cycle emission block (drain-cadence contract)."""
         return tape_capacity + self.pool
 
+    def _row_plan(self):
+        """Emission block layout. Legacy: [ts, one row per projection].
+        Lazy plans compact it: projections that emit the SAME element's
+        ordinal share one row, and the ts row is dropped entirely when
+        it derives from the completing element's ordinal (the host ring
+        retains rebased timestamps; see executor ``@ts``). d2h match
+        bytes on a tunneled device are precious — the headline pattern's
+        block shrinks 4 rows -> 2.
+
+        Returns (rows, row_of, ts_row, ts_ord_row): ``rows`` is a list of
+        ("ts"|"ord"|"proj", proj_idx) sources, ``row_of[c]`` the block row
+        of projection c, ``ts_row`` the ts row index or None, and
+        ``ts_ord_row`` the row whose ordinals recover the emission ts
+        when ``ts_row`` is None."""
+        spec = self.spec
+        C = len(spec.proj_fns)
+        if not self.lazy_pairs:
+            rows = [("ts", None)] + [("proj", c) for c in range(C)]
+            return rows, list(range(1, 1 + C)), 0, None
+
+        lazyset = set(self.lazy_pairs)
+
+        def dedupable(elem: int) -> bool:
+            # one ordinal == one event: only elements matching exactly
+            # once (unquantified, non-negated, singleton group) qualify
+            el = spec.elements[elem]
+            if (el.min_count, el.max_count) != (1, 1) or el.negated:
+                return False
+            return not any(
+                elem in g and len(g) > 1 for g in spec.groups
+            )
+
+        last = spec.n_elements - 1
+        drop_ts = (
+            self._tfor_ms() is None
+            and dedupable(last)
+            and any(
+                src is not None
+                and src in lazyset
+                and src[0] == last
+                for src in spec.proj_srcs
+            )
+        )
+        rows = []
+        row_of = [0] * C
+        ts_row = None
+        if not drop_ts:
+            ts_row = 0
+            rows.append(("ts", None))
+        ord_row: Dict[int, int] = {}
+        for c, src in enumerate(spec.proj_srcs):
+            if (
+                src is not None
+                and src in lazyset
+                and dedupable(src[0])
+            ):
+                e = src[0]
+                if e in ord_row:
+                    row_of[c] = ord_row[e]
+                    continue
+                ord_row[e] = row_of[c] = len(rows)
+                rows.append(("ord", c))
+            else:
+                row_of[c] = len(rows)
+                rows.append(("proj", c))
+        return rows, row_of, ts_row, (
+            ord_row.get(last) if drop_ts else None
+        )
+
+    @property
+    def acc_rows(self) -> int:
+        return len(self._row_plan()[0])
+
+    @property
+    def ring_needs_ts(self) -> bool:
+        """True when decode recovers emission timestamps from the host
+        ring (the executor then retains a rebased ``@ts`` column)."""
+        return bool(self.lazy_pairs) and self._row_plan()[2] is None
+
+    def _emit_block(self, emit_ts, emit_env, width: int):
+        """Stack the emission rows per ``_row_plan`` ("ord" rows evaluate
+        their representative projection — identical values by the dedup
+        criterion)."""
+        spec = self.spec
+        out = []
+        for kind, c in self._row_plan()[0]:
+            if kind == "ts":
+                out.append(_as_i32(emit_ts))
+            else:
+                out.append(
+                    _as_i32(
+                        jnp.broadcast_to(
+                            jnp.asarray(spec.proj_fns[c](emit_env)),
+                            (width,),
+                        )
+                    )
+                )
+        return jnp.stack(out)
+
     def _tfor_ms(self) -> Optional[int]:
         last = self.spec.elements[-1]
         return last.absent_for if last.negated else None
@@ -1087,17 +1186,9 @@ class ChainPatternArtifact:
                     for elem, col, which in spec.captures
                 },
             )
-            emit_rows = jnp.stack(
-                [_as_i32(v_emit_ts)]
-                + [
-                    _as_i32(
-                        jnp.broadcast_to(jnp.asarray(p(emit_env)), (v,))
-                    )
-                    for p in spec.proj_fns
-                ]
-            )
+            emit_rows = self._emit_block(v_emit_ts, emit_env, v)
             packed = (
-                jnp.zeros((1 + C, V), dtype=jnp.int32)
+                jnp.zeros((self.acc_rows, V), dtype=jnp.int32)
                 .at[:, emit_dest]
                 .set(emit_rows, mode="drop")
             )
@@ -1294,15 +1385,9 @@ class ChainPatternArtifact:
                 for elem, col, which in spec.captures
             },
         )
-        emit_rows = jnp.stack(
-            [_as_i32(emit_ts)]
-            + [
-                _as_i32(jnp.broadcast_to(jnp.asarray(p(emit_env)), (W,)))
-                for p in spec.proj_fns
-            ]
-        )
+        emit_rows = self._emit_block(emit_ts, emit_env, W)
         packed = (
-            jnp.zeros((1 + C, W), dtype=jnp.int32)
+            jnp.zeros((self.acc_rows, W), dtype=jnp.int32)
             .at[:, dest]
             .set(emit_rows, mode="drop")
         )
@@ -1320,21 +1405,34 @@ class ChainPatternArtifact:
         )
 
     def decode_packed(self, n: int, block: "np.ndarray", lookup=None):
-        """With lazy pairs, projection rows carrying ordinals resolve
-        against the host's retained batches; evicted ordinals decode as
-        None (bounded-memory policy, like every other engine cap)."""
+        """With lazy pairs, ordinal rows resolve against the host's
+        retained batches; evicted ordinals decode as None (bounded-memory
+        policy, like every other engine cap). On the compact layout the
+        emission ts itself recovers from the completing element's ordinal
+        (ring column ``@ts``)."""
         schema = self.output_schema
         if not self.lazy_pairs:
             return [(schema, schema.decode_packed_block(n, block))]
         from .output import emission_order
 
-        order = emission_order(block[0], n)
-        ts_list = (
-            np.asarray(block[0, :n])[order].astype(np.int64).tolist()
-        )
+        _rows, row_of, ts_row, ts_ord_row = self._row_plan()
+        if ts_row is not None:
+            ts_arr = np.asarray(block[ts_row, :n]).astype(np.int64)
+        else:
+            ords = np.asarray(block[ts_ord_row, :n])
+            tvals = (
+                lookup("@ts", ords) if lookup is not None else [None] * n
+            )
+            # an evicted ordinal loses its emission ts too: decode 0
+            # (its values decode None anyway)
+            ts_arr = np.asarray(
+                [0 if v is None else int(v) for v in tvals], np.int64
+            )
+        order = emission_order(ts_arr, n)
+        ts_list = ts_arr[order].tolist()
         col_lists = []
         for c, f in enumerate(schema.fields):
-            raw = np.asarray(block[1 + c, :n])[order]
+            raw = np.asarray(block[row_of[c], :n])[order]
             src = self.spec.proj_srcs[c]
             if src is not None and src in self.lazy_pairs:
                 vals = (
@@ -1380,7 +1478,7 @@ class ChainPatternArtifact:
         if tfor is None:
             return state, (
                 jnp.asarray(0, jnp.int32),
-                jnp.zeros((1 + C, 1), jnp.int32),
+                jnp.zeros((self.acc_rows, 1), jnp.int32),
             )
         K = _ChainCfg.of(spec).K
         waiting = state["active"] & (state["step"] == K)
@@ -1408,13 +1506,7 @@ class ChainPatternArtifact:
                 for e, c, w in spec.captures
             },
         )
-        rows = jnp.stack(
-            [_as_i32(deadline)]
-            + [
-                _as_i32(jnp.broadcast_to(jnp.asarray(p(emit_env)), (P,)))
-                for p in spec.proj_fns
-            ]
-        )
+        rows = self._emit_block(deadline, emit_env, P)
         packed = jnp.zeros_like(rows).at[:, dest].set(rows, mode="drop")
         new_state = dict(state)
         new_state["active"] = state["active"] & ~waiting

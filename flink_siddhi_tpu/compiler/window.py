@@ -263,6 +263,10 @@ class SlidingWindowArtifact:
     proj_types: List[AttributeType]
     having_fn: Optional[Callable]
     output_mode: str = "aligned"
+    # dense group codes (host-interned): lets the blocked (sort-free)
+    # path one-hot groups onto the MXU instead of argsorting the tape
+    code_key: Optional[str] = None
+    encoder: Optional[GroupEncoder] = None
 
     def init_state(self) -> Dict:
         C = self.capacity
@@ -272,9 +276,56 @@ class SlidingWindowArtifact:
         }
         for j, t in enumerate(self.arg_types):
             ring[f"a{j}"] = jnp.zeros(C, t.device_dtype)
+        if self._blocked():
+            state = {"enabled": jnp.asarray(True)}
+            ring["gc"] = jnp.zeros(C, jnp.int32)
+            state["ring"] = ring
+            # one-hot width placeholder: grow_state re-buckets it as the
+            # host encoder discovers groups (one-off retrace per bucket)
+            state["groups"] = jnp.zeros(self._gcap(), jnp.int32)
+            return state
         for j, dt in enumerate(self.group_dtypes):
             ring[f"g{j}"] = jnp.zeros(C, dt)
         return {"enabled": jnp.asarray(True), "ring": ring}
+
+    def _gcap(self) -> int:
+        from ..runtime.tape import bucket_size
+
+        n = len(self.encoder) if self.encoder is not None else 1
+        return bucket_size(max(n, 1), minimum=128)
+
+    def grow_state(self, state: Dict) -> Dict:
+        if "groups" not in state:
+            return state
+        if state["groups"].shape[0] >= self._gcap():
+            return state
+        out = dict(state)
+        out["groups"] = jnp.zeros(self._gcap(), jnp.int32)
+        return out
+
+    def _blocked(self) -> bool:
+        """Sort-free tiled path: per-group running sums over the merged
+        arrival/expiry sequence via one-hot / lower-triangular matmuls
+        (MXU work) instead of multi-key argsorts (the slow op class on
+        TPU — ~5 sorts of 2(C+E) elements dominated this step). Needs
+        distributive aggregates and float (or count) arguments — int
+        sums keep the exact integer scan path."""
+        if not (
+            self.window_mode == "length"
+            or (self.window_mode == "time" and self.ts_key is None)
+        ):
+            return False
+        if self.group_fns and self.code_key is None:
+            return False
+        for a in self.aggs:
+            if a.kind not in ("count", "sum", "avg", "stddev"):
+                return False
+            if a.kind != "count" and not jnp.issubdtype(
+                np.dtype(self.arg_types[a.arg_idx].device_dtype),
+                jnp.floating,
+            ):
+                return False
+        return True
 
     def _prefixable(self) -> bool:
         """Windows whose aggregates distribute over +/- can use the
@@ -304,9 +355,268 @@ class SlidingWindowArtifact:
         return all(a.kind in allowed for a in self.aggs)
 
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        if self._blocked():
+            return self._step_blocked(state, tape)
         if self._prefixable():
             return self._step_prefix(state, tape)
         return self._step_matrix(state, tape)
+
+    # -- blocked (sort-free) sliding aggregation ---------------------------
+    def _step_blocked(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        """Windowed per-group sums with ZERO sorts.
+
+        Same semantics as ``_step_prefix`` (window = last C matching
+        events / time span; aggregates over the emitting event's group),
+        new machinery: arrivals compact via scatter (not argsort); the
+        arrival(+v)/expiry(-v) sequences are each already sorted by
+        merge key, so their interleave comes from two searchsorteds; and
+        the per-group running sum of the merged sequence is computed in
+        tiles — a [t,G] one-hot matmul gives per-tile group totals whose
+        exclusive scan is the across-tile carry, and a [t,t] same-group
+        lower-triangular matmul gives the within-tile prefix. All the
+        heavy work is matmul (MXU), not sort."""
+        env: ColumnEnv = dict(tape.cols)
+        mask = tape.valid & (tape.stream == self.stream_code)
+        for f in self.filter_fns:
+            mask = mask & f(env)
+        mask = mask & state["enabled"]
+        E = tape.capacity
+        C = self.capacity
+        ring = state["ring"]
+        G = state["groups"].shape[0]
+
+        M = mask.sum()
+        rank = jnp.cumsum(mask) - 1
+        dest = jnp.where(mask, rank, E)  # E -> dropped
+
+        def compact(col, dtype=None):
+            col = jnp.broadcast_to(jnp.asarray(col), (E,))
+            if dtype is not None:
+                col = col.astype(dtype)
+            return jnp.zeros(E, col.dtype).at[dest].set(col, mode="drop")
+
+        # value columns: one per agg arg needing sums, plus squares for
+        # stddev, plus an implicit count column
+        need_sq = sorted(
+            {a.arg_idx for a in self.aggs if a.kind == "stddev"}
+        )
+        need_sum = sorted(
+            {
+                a.arg_idx
+                for a in self.aggs
+                if a.kind in ("sum", "avg", "stddev")
+            }
+        )
+        vcols = []
+        vmap: Dict[str, int] = {}
+        for j in need_sum:
+            vmap[f"s{j}"] = len(vcols)
+            vcols.append(
+                compact(self.arg_fns[j](env), jnp.float32)
+            )
+        for j in need_sq:
+            vmap[f"q{j}"] = len(vcols)
+            v = compact(self.arg_fns[j](env), jnp.float32)
+            vcols.append(v * v)
+        vmap["cnt"] = len(vcols)
+        vcols.append(jnp.ones(E, jnp.float32))
+        K = len(vcols)
+
+        if self.code_key is not None:
+            codes_b = compact(env[self.code_key], jnp.int32)
+            ring_gc = ring["gc"]
+        else:
+            codes_b = jnp.zeros(E, jnp.int32)
+            ring_gc = jnp.zeros(C, jnp.int32)
+        ts_b = compact(tape.ts)
+        live_b = jnp.arange(E, dtype=jnp.int32) < M
+
+        # concat sequence: ring (oldest C) ++ this batch's arrivals
+        N = C + E
+        codes = jnp.concatenate([ring_gc, codes_b])
+        ts_n = jnp.concatenate([ring["ts"], ts_b])
+        live = jnp.concatenate([ring["valid"], live_b])
+        ring_vals = []
+        for j in need_sum:
+            ring_vals.append(ring[f"a{j}"].astype(jnp.float32))
+        for j in need_sq:
+            rv = ring[f"a{j}"].astype(jnp.float32)
+            ring_vals.append(rv * rv)
+        ring_vals.append(jnp.ones(C, jnp.float32))
+        V_n = jnp.stack(
+            [
+                jnp.concatenate([rv, bv])
+                for rv, bv in zip(ring_vals, vcols)
+            ],
+            axis=1,
+        )  # [N, K]
+
+        pos = jnp.arange(N, dtype=jnp.int32)
+        if self.window_mode == "length":
+            exp_rank = pos + C
+        else:
+            ts_c = ts_n.astype(jnp.int32)
+            mono = lax.cummax(ts_c)
+            tgt = ts_c + jnp.int32(self.time_ms)
+            tgt = jnp.where(tgt < ts_c, jnp.int32(2 ** 31 - 1), tgt)
+            # 'sort' lowers to ONE sort; the default 'scan' method costs
+            # ~100ms at this width on TPU
+            exp_rank = jnp.searchsorted(
+                mono, tgt, side="left", method="sort"
+            ).astype(jnp.int32)
+            exp_rank = jnp.maximum(exp_rank, pos + 1)
+
+        # merge two sorted streams without sorting or searching: arrival
+        # p has key 2p+1, expiry of p has key 2*exp_rank[p] (ties:
+        # expiry first). Both key sequences are nondecreasing, so merge
+        # ranks are direct counts: an expiry at rank r precedes arrivals
+        # p >= r (histogram + cumsum), and arrivals q < exp_rank[p]
+        # precede expiry p (clip).
+        exp_clip = jnp.clip(exp_rank, 0, N)
+        hist = (
+            jnp.zeros(N + 1, jnp.int32).at[exp_clip].add(1, mode="drop")
+        )
+        cum = jnp.cumsum(hist)
+        m_arr = pos + cum[pos]
+        m_exp = pos + exp_clip
+        N2 = 2 * N
+        src = (
+            jnp.zeros(N2, jnp.int32)
+            .at[m_arr]
+            .set(pos)
+            .at[m_exp]
+            .set(pos + N)
+        )
+        is_arr = src < N
+        idx = jnp.where(is_arr, src, src - N)
+        m_code = codes[idx]
+        m_live = live[idx]
+        sign = jnp.where(is_arr, 1.0, -1.0).astype(jnp.float32)
+        V2 = jnp.where(
+            m_live[:, None], V_n[idx] * sign[:, None], 0.0
+        )  # [2N, K]
+
+        # tiled running per-own-group sums. All tiles are independent
+        # matmul work (MXU): a [t,G] one-hot contraction gives per-tile
+        # group totals, a same-group lower-triangular [t,t] contraction
+        # gives within-tile prefixes; the only sequential piece is a
+        # [T,G,K] cumsum across tiles. Tiles run in CHUNKS of batched
+        # matmuls — a per-tile lax.scan would pay ~2000 iterations of
+        # dispatch overhead for microscopic matmuls.
+        t = 512
+        chunk = 16
+        pad = (-N2) % (t * chunk)
+        if pad:
+            m_code = jnp.concatenate(
+                [m_code, jnp.zeros(pad, jnp.int32)]
+            )
+            V2 = jnp.concatenate(
+                [V2, jnp.zeros((pad, K), jnp.float32)]
+            )
+        T = (N2 + pad) // t
+        codes_t = m_code.reshape(T, t)
+        V_t = V2.reshape(T, t, K)
+        tril = jnp.tril(jnp.ones((t, t), jnp.float32))
+        giota = jnp.arange(G, dtype=jnp.int32)
+
+        def chunk_body(inp):
+            c, v = inp  # [chunk, t] codes, [chunk, t, K] signed values
+            onehot = (
+                c[:, :, None] == giota[None, None, :]
+            ).astype(jnp.float32)
+            tile_sums = jnp.einsum("cig,cik->cgk", onehot, v)
+            eq = (
+                c[:, :, None] == c[:, None, :]
+            ).astype(jnp.float32) * tril[None]
+            partial = jnp.einsum("cij,cjk->cik", eq, v)
+            return tile_sums, partial
+
+        S, partial = lax.map(
+            chunk_body,
+            (
+                codes_t.reshape(T // chunk, chunk, t),
+                V_t.reshape(T // chunk, chunk, t, K),
+            ),
+        )
+        S = S.reshape(T, G, K)
+        partial = partial.reshape(T * t, K)
+        # exclusive across-tile scan; laid out scan-axis-last (cumsum
+        # along a large-stride leading axis is ~30x slower on TPU)
+        cum = jnp.cumsum(S.reshape(T, G * K).T, axis=1)
+        carry = cum.T.reshape(T, G, K) - S
+        tile_of = jnp.arange(T * t, dtype=jnp.int32) // t
+        flat = carry.reshape(T * G, K)
+        R = flat[tile_of * G + m_code] + partial
+        win = R[m_arr]  # [N, K]: windowed sums at each concat arrival
+
+        def unsort(concat_vals, dtype):
+            batch_vals = concat_vals[C + jnp.clip(rank, 0)]
+            return jnp.where(mask, batch_vals, 0).astype(dtype)
+
+        cnt = win[:, vmap["cnt"]]
+        for agg in self.aggs:
+            if agg.kind == "count":
+                rows = cnt
+            elif agg.kind == "sum":
+                rows = win[:, vmap[f"s{agg.arg_idx}"]]
+                if not jnp.issubdtype(
+                    agg.out_type.device_dtype, jnp.floating
+                ):
+                    rows = jnp.round(rows)
+            elif agg.kind == "avg":
+                rows = win[:, vmap[f"s{agg.arg_idx}"]] / jnp.maximum(
+                    cnt, 1.0
+                )
+            else:  # stddev
+                c_ = jnp.maximum(cnt, 1.0)
+                mean = win[:, vmap[f"s{agg.arg_idx}"]] / c_
+                rows = jnp.sqrt(
+                    jnp.maximum(
+                        win[:, vmap[f"q{agg.arg_idx}"]] / c_
+                        - mean * mean,
+                        0.0,
+                    )
+                )
+            env[agg.slot] = unsort(rows, agg.out_type.device_dtype)
+
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(env)), (E,))
+            for p in self.proj_fns
+        )
+        out_mask = mask
+        if self.having_fn is not None:
+            henv = dict(env)
+            for f, c_ in zip(self.output_schema.fields, cols):
+                henv[f"@out:{f.name}"] = c_
+            out_mask = out_mask & self.having_fn(henv)
+
+        # FIFO ring: last C live entries of [ring ++ arrivals]
+        new_ring = {
+            "ts": lax.dynamic_slice(ts_n, (M,), (C,)),
+            "valid": lax.dynamic_slice(live, (M,), (C,)),
+        }
+        for j, _t in enumerate(self.arg_types):
+            cat = jnp.concatenate(
+                [
+                    ring[f"a{j}"],
+                    compact(
+                        self.arg_fns[j](dict(tape.cols)),
+                        ring[f"a{j}"].dtype,
+                    ),
+                ]
+            )
+            new_ring[f"a{j}"] = lax.dynamic_slice(cat, (M,), (C,))
+        if self.code_key is not None:
+            cat = jnp.concatenate([ring_gc, codes_b])
+            new_ring["gc"] = lax.dynamic_slice(cat, (M,), (C,))
+        else:
+            new_ring["gc"] = jnp.zeros(C, jnp.int32)
+        new_state = {
+            "enabled": state["enabled"],
+            "ring": new_ring,
+            "groups": state["groups"],
+        }
+        return new_state, (out_mask, tape.ts, cols)
 
     def _step_prefix(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         """Sliding length-window aggregation as a difference of per-group
@@ -1393,6 +1703,9 @@ def compile_window_query(
             key = r.key
             group_fns.append(lambda env, k=key: env[k])
             group_dtypes.append(r.atype.device_dtype)
+        code_key, encoder, encoded = _group_encoding(
+            name, group_resolved, sc, filter_fns
+        )
         art = SlidingWindowArtifact(
             name=name,
             output_schema=out_schema,
@@ -1410,8 +1723,19 @@ def compile_window_query(
             proj_fns=proj_fns,
             proj_types=[f.atype for f in out_fields],
             having_fn=having_fn,
+            code_key=code_key,
+            encoder=encoder,
         )
-        art.encoded_columns = ()
+        if art._blocked():
+            # the sort-free tiled path consumes dense host-interned
+            # group codes off the tape
+            art.encoded_columns = encoded
+        else:
+            # sort/matrix paths read raw group columns; don't pay host
+            # interning for a code column nobody reads
+            art.code_key = None
+            art.encoder = None
+            art.encoded_columns = ()
         return art
 
     # batch windows

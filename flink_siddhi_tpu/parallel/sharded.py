@@ -273,6 +273,11 @@ class ShardedJob(Job):
         for rt in self._plans.values():
             self._drain_plan(rt)
 
+    def _interval_drain(self) -> None:
+        for rt in self._plans.values():
+            if self._has_consumers(rt):
+                self._drain_plan(rt)
+
     def _drain_plan(self, rt: _PlanRuntime) -> None:
         if rt.acc is None or not rt.plan.artifacts:
             return

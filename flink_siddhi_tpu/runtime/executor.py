@@ -103,20 +103,32 @@ class _LazyRing:
                 self.bytes -= sum(c.nbytes for c in old.values())
 
     def lookup(self, key: str, ords) -> List:
+        """Batch ordinal resolve: one searchsorted + one gather per ring
+        entry touched (matches cluster in 1-2 entries), instead of a
+        per-ordinal Python loop."""
         with self._lock:
             ords = np.asarray(ords, dtype=np.int64)
-            idx = np.searchsorted(self.starts, ords, side="right") - 1
-            out: List = [None] * len(ords)
-            for j, (o, i) in enumerate(zip(ords.tolist(), idx.tolist())):
-                if i < 0:
-                    self.missed += 1
-                    continue
-                off = o - self.starts[i]
+            n = len(ords)
+            out: List = [None] * n
+            if n == 0 or not self.starts:
+                self.missed += n
+                return out
+            starts = np.asarray(self.starts, dtype=np.int64)
+            lens = np.asarray(self.lens, dtype=np.int64)
+            idx = np.searchsorted(starts, ords, side="right") - 1
+            safe = np.clip(idx, 0, None)
+            ok = (idx >= 0) & (ords - starts[safe] < lens[safe])
+            self.missed += int(n - ok.sum())
+            offs = ords - starts[safe]
+            for i in np.unique(idx[ok]).tolist():
+                sel = np.nonzero(ok & (idx == i))[0]
                 entry = self.cols[i]
-                if off >= self.lens[i] or key not in entry:
-                    self.missed += 1
+                if key not in entry:
+                    self.missed += len(sel)
                     continue
-                out[j] = entry[key][off]
+                vals = entry[key][offs[sel]].tolist()
+                for j, v in zip(sel.tolist(), vals):
+                    out[j] = v
             return out
 
 
@@ -184,8 +196,20 @@ class Job:
         # bounded by ~max_inflight_cycles * device_cycle_time + drain
         # interval, and the device stays fed as long as it is >= 2.
         self.max_inflight_cycles = 6
+        # adaptive depth: when set, max_inflight_cycles tracks the
+        # measured cycle pace so queued device work stays within about
+        # half the latency target (the other half is drain staleness +
+        # fetch time). None = fixed depth.
+        self.target_p99_ms: Optional[float] = None
+        self._cycle_ema: Optional[float] = None
+        self._last_cycle_t: Optional[float] = None
         # per-plan capacity-check cadence (recomputed as plans come and go)
         self._drain_hints: Dict[str, int] = {}
+        # observability: when True, each drain's request->completion wall
+        # time is appended here (visibility-latency reporting for jobs
+        # with no row consumers, where match latency can't be sampled)
+        self.record_drain_latency = False
+        self.drain_latencies: List[float] = []
 
     # -- plan management (dynamic control plane hooks) ----------------------
     # Parity: AbstractSiddhiOperator.onEventReceived (:399-467) — add/update/
@@ -249,6 +273,11 @@ class Job:
             for key in getattr(a, "lazy_src_keys", ())
         }
         rt.lazy_keys = lazy_keys
+        # compact lazy blocks drop the device ts row; the ring then also
+        # retains rebased timestamps under the synthetic "@ts" key
+        rt.lazy_ts = any(
+            getattr(a, "ring_needs_ts", False) for a in plan.artifacts
+        )
         rt.lazy = (
             _LazyRing(plan.config.lazy_ring_budget_bytes)
             if lazy_keys
@@ -644,12 +673,28 @@ class Job:
         self._drain_request(rt)
         self._drain_poll(rt, block=True)
 
+    def _interval_drain(self) -> None:
+        """Latency-bounding drain pass over plans someone observes
+        (overridden by ShardedJob, whose drains are synchronous).
+
+        Flow control: a plan with a drain still in flight is skipped —
+        on a slow d2h tunnel, queueing drains faster than fetches
+        complete only grows a backlog whose depth becomes match latency.
+        Skipping keeps visibility latency ~= one fetch duration."""
+        for rt in self._plans.values():
+            self._drain_poll(rt)
+            if rt.drain_q:
+                continue
+            if self._has_consumers(rt):
+                self._drain_request(rt)
+                self._drain_poll(rt)
+
     def prewarm_drains(
         self, widths: Sequence[int] = (1024, 4096, 16384, 65536, 262144)
     ) -> None:
-        """Compile the bucketed drain-slice programs up front. The first
-        eager slice at a new width costs ~0.7s on a tunneled device;
-        prewarming moves that out of the steady-state loop (benchmarks /
+        """Compile the bucketed packed-drain programs up front. The first
+        one at a new width costs ~0.7s on a tunneled device; prewarming
+        moves that out of the steady-state loop (benchmarks /
         latency-sensitive pipelines call this once at startup)."""
         for rt in self._plans.values():
             if rt.acc is None or not rt.plan.artifacts:
@@ -657,12 +702,36 @@ class Job:
             cap = rt.plan.acc_capacity()
             for w in widths:
                 if w <= cap:
-                    rt.acc["buf"][:, :w]  # dispatch compiles; result dropped
+                    self._pack_drain(rt, rt.acc, w)  # compile; drop result
+
+    @staticmethod
+    def _pack_drain(rt: _PlanRuntime, acc: Dict, width: int):
+        """ONE device array holding [meta | buf[:, :width]] flattened —
+        a d2h fetch on a tunneled device pays ~one RTT regardless of
+        size, so meta and data must cross in a single transfer, not two."""
+        jits = getattr(rt, "pack_jits", None)
+        if jits is None:
+            jits = rt.pack_jits = {}
+        fn = jits.get(width)
+        if fn is None:
+            def pack(a, _w=width):
+                rows = a["buf"].shape[0]
+                return jnp.concatenate(
+                    [
+                        a["meta"].ravel(),
+                        jax.lax.slice(
+                            a["buf"], (0, 0), (rows, _w)
+                        ).ravel(),
+                    ]
+                )
+
+            fn = jits[width] = jax.jit(pack)
+        return fn(acc)
 
     def _drain_request(self, rt: _PlanRuntime) -> None:
         """Swap the device accumulator for a fresh one and queue the
         swapped-out copy for fetching. The entry stays in a cheap
-        "waiting for the device" stage until its meta array is_ready —
+        "waiting for the device" stage until its packed array is_ready —
         polled for free from the run loop — and only then goes to the
         fetch thread, which therefore only ever pays transfer time,
         never a block-on-unfinished-compute stall."""
@@ -675,17 +744,23 @@ class Job:
             # retention off), so only the counts cross the wire — the
             # data transfer AND the host decode are skipped entirely.
             # The swap itself still happens (overflow accounting).
-            rt.drain_q.append({"acc": old, "data": None, "width": 0})
+            rt.drain_q.append(
+                {"acc": old, "packed": None, "width": 0,
+                 "t_req": time.monotonic()}
+            )
             self._advance_ready(rt)
             if len(rt.drain_q) > self.MAX_PENDING_DRAINS:
                 self._drain_poll(rt, block=True, limit=1)
             return
         width = min(max(rt.fetch_width, 1024), rt.plan.acc_capacity())
-        # dispatch the predicted-width data slice NOW: by the time meta
-        # is ready the slice is computed too, so the fetch thread's
-        # asarray calls pay transfer time only — no compute stall
-        data_dev = old["buf"][:, :width]
-        rt.drain_q.append({"acc": old, "data": data_dev, "width": width})
+        # dispatch the packed meta+data array NOW at the predicted width:
+        # by fetch time it is computed, so the fetch thread's asarray
+        # pays transfer time only — and exactly ONE d2h round trip
+        packed = self._pack_drain(rt, old, width)
+        rt.drain_q.append(
+            {"acc": old, "packed": packed, "width": width,
+             "t_req": time.monotonic()}
+        )
         self._advance_ready(rt)
         if len(rt.drain_q) > self.MAX_PENDING_DRAINS:
             self._drain_poll(rt, block=True, limit=1)
@@ -700,18 +775,22 @@ class Job:
         )
 
     def _advance_ready(self, rt: _PlanRuntime) -> None:
-        """Promote waiting entries whose meta and predicted slice are
-        ready to fetch jobs (FIFO: stop at the first not-ready entry)."""
+        """Promote waiting entries whose packed array (or bare meta, for
+        counts-only drains) is ready to fetch jobs (FIFO: stop at the
+        first not-ready entry)."""
         for entry in rt.drain_q:
             if "fut" in entry:
                 continue
-            if not entry["acc"]["meta"].is_ready():
-                break
-            if entry["data"] is not None and not entry["data"].is_ready():
+            gate = (
+                entry["packed"]
+                if entry["packed"] is not None
+                else entry["acc"]["meta"]
+            )
+            if not gate.is_ready():
                 break
             entry["fut"] = self._fetch_pool.submit(
                 self._fetch_acc, rt, entry.pop("acc"),
-                entry.pop("data"), entry.pop("width"),
+                entry.pop("packed"), entry.pop("width"),
             )
 
     @property
@@ -731,18 +810,21 @@ class Job:
         return pool
 
     @staticmethod
-    def _fetch_acc(rt: _PlanRuntime, acc: Dict, data_dev, width: int):
-        """Fetch-thread body: both meta and the predicted slice are
-        already computed, so the asarray calls cost transfer time only;
-        decode also happens here so the run loop only emits. Bucketed
-        widths keep the eager slice program count to a handful of shapes
-        (a distinct shape per drain would compile a fresh program every
-        time, ~1s each on a tunneled device)."""
-        meta = np.asarray(acc["meta"])
+    def _fetch_acc(rt: _PlanRuntime, acc: Dict, packed, width: int):
+        """Fetch-thread body: the packed [meta | data-slice] array is
+        already computed, so ONE asarray pays one d2h round trip for the
+        whole drain; decode also happens here so the run loop only
+        emits. Bucketed widths keep the pack program count to a handful
+        of shapes (a distinct shape per drain would compile a fresh
+        program every time, ~1s each on a tunneled device)."""
+        a_count = max(len(rt.plan.artifacts), 1)
+        if packed is None:  # no-consumer fast path: counts only
+            meta = np.asarray(acc["meta"])
+            return meta[0], meta[1], None
+        arr = np.asarray(packed)
+        meta = arr[: 2 * a_count].reshape(2, a_count)
         counts, overflow = meta[0], meta[1]
         max_n = int(counts.max()) if counts.size else 0
-        if data_dev is None:  # no-consumer fast path: counts only
-            return counts, overflow, None
         rt.fetch_width = min(
             bucket_size(max(max_n, 1), minimum=1024),
             rt.plan.acc_capacity(),
@@ -752,7 +834,7 @@ class Job:
         if max_n > width:  # misprediction: pay one extra slice fetch
             data = np.asarray(acc["buf"][:, :rt.fetch_width])[:, :max_n]
         else:
-            data = np.asarray(data_dev)[:, :max_n]
+            data = arr[2 * a_count :].reshape(-1, width)[:, :max_n]
         decoded = rt.plan.drain_decode(
             counts, data,
             lookup=(
@@ -777,16 +859,22 @@ class Job:
                 if not block:
                     return
                 # block path (results/flush/checkpoint): force the wait
-                jax.block_until_ready(entry["acc"]["meta"])
-                if entry["data"] is not None:
-                    jax.block_until_ready(entry["data"])
+                jax.block_until_ready(
+                    entry["packed"]
+                    if entry["packed"] is not None
+                    else entry["acc"]["meta"]
+                )
                 self._advance_ready(rt)
                 entry = rt.drain_q[0]
             fut = entry["fut"]
             if not block and not fut.done():
                 return
             counts, overflow, decoded = fut.result()
-            rt.drain_q.popleft()
+            done_entry = rt.drain_q.popleft()
+            if self.record_drain_latency:
+                self.drain_latencies.append(
+                    time.monotonic() - done_entry["t_req"]
+                )
             for ai, a in enumerate(rt.plan.artifacts):
                 if overflow[ai] > 0:
                     _LOG.warning(
@@ -873,23 +961,47 @@ class Job:
                 if rt.enabled:
                     self._step_plan(rt, ready)
             self._cycles_since_drain += 1
+            # adaptive in-flight depth: the wall time between working
+            # cycles tracks the device pace once the ticket window is
+            # full, so depth * pace ~= queued latency
+            t_now = time.monotonic()
+            if self._last_cycle_t is not None:
+                dt = t_now - self._last_cycle_t
+                self._cycle_ema = (
+                    dt
+                    if self._cycle_ema is None
+                    else 0.8 * self._cycle_ema + 0.2 * dt
+                )
+                if self.target_p99_ms:
+                    budget_s = self.target_p99_ms / 2000.0
+                    self.max_inflight_cycles = max(
+                        2,
+                        min(
+                            8,
+                            int(budget_s / max(self._cycle_ema, 1e-3)),
+                        ),
+                    )
+            self._last_cycle_t = t_now
         # advance any in-flight drain fetches (never blocks the host)
         for rt in self._plans.values():
             self._drain_poll(rt)
         now = time.monotonic()
-        if (
+        interval_due = (
             self.drain_interval_ms is not None
             and (now - self._last_full_drain) * 1000.0
             >= self.drain_interval_ms
-        ):
+        )
+        if interval_due:
             # latency-bounding drain: START surfacing accumulated matches
             # (swap + async fetch riding behind queued device work) even
             # on idle cycles — a stalled source must not delay visibility
-            # of matches already produced
-            self.drain_outputs(wait=False)
-            self._cycles_since_drain = 0
+            # of matches already produced. Plans NOBODY observes (no
+            # sinks, retention off) skip it: each drain costs a d2h round
+            # trip on the tunnel, and with no consumer there is no
+            # visibility to bound — their capacity swaps below suffice.
+            self._interval_drain()
             self._last_full_drain = time.monotonic()
-        elif ready and self._cycles_since_drain >= min(
+        if ready and self._cycles_since_drain >= min(
             self.drain_every_cycles,
             min(self._drain_hints.values(), default=self.drain_every_cycles),
         ):
@@ -1026,6 +1138,10 @@ class Job:
                     sid, fname = key.split(".", 1)
                     if b.stream_id == sid:
                         lcols[key] = np.array(b.columns[fname])
+                if rt.lazy_ts:
+                    lcols["@ts"] = (
+                        b.timestamps - self._epoch_ms
+                    ).astype(np.int32)
             else:
                 for key in rt.lazy_keys:
                     sid, fname = key.split(".", 1)
@@ -1041,6 +1157,14 @@ class Job:
                         col[sel] = b.columns[fname][_prov[sel, 1]]
                     if col is not None:
                         lcols[key] = col
+                if rt.lazy_ts:
+                    tcol = np.zeros(total, dtype=np.int32)
+                    for bi, b in enumerate(involved):
+                        sel = _prov[:, 0] == bi
+                        tcol[sel] = (
+                            b.timestamps[_prov[sel, 1]] - self._epoch_ms
+                        ).astype(np.int32)
+                    lcols["@ts"] = tcol
             rt.lazy.push(rt.lazy_base, lcols)
             rt.lazy_base += total
         # host interning may have discovered new group keys: re-bucket state

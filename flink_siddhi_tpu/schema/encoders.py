@@ -38,6 +38,29 @@ class GroupEncoder:
             return out
         codes = self._codes
         values = self._values
+        if len(cols) == 1 and cols[0].dtype != object:
+            # vectorized single-column path: unique once (distinct group
+            # count, not row count), Python only per NEW group — the
+            # per-row loop below would dominate the host at bench batch
+            # sizes (~500k rows/batch)
+            col = cols[0]
+            sel_vals = col[select]
+            if not len(sel_vals):
+                return out
+            uniq = np.unique(sel_vals)
+            ucodes = np.empty(len(uniq), dtype=np.int32)
+            for u_i, u in enumerate(uniq.tolist()):
+                key = (u,)
+                code = codes.get(key)
+                if code is None:
+                    code = len(values)
+                    codes[key] = code
+                    values.append(key)
+                ucodes[u_i] = code
+            out[select] = ucodes[
+                np.searchsorted(uniq, sel_vals)
+            ]
+            return out
         idx = np.nonzero(select)[0]
         for i in idx:
             key = tuple(c[i].item() for c in cols)
