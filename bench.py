@@ -5,13 +5,17 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"vs_jvm_estimate", latency fields}.
 
-``vs_baseline``: the reference publishes no numbers (BASELINE.md — repo has
-no benchmarks). The denominator is a pinned 500_000 events/sec estimate of
-the in-JVM Siddhi runtime on a single-core 3-step pattern (siddhi-core's
-published simple-filter throughput is low-millions/sec; multi-step pattern
-state machines run well under that). North star: vs_baseline >= 20.
+``vs_baseline``: the reference publishes no numbers (BASELINE.md — repo
+has no benchmarks), so the denominator is MEASURED: the single-core
+per-event reference interpreter (``python bench.py --baseline``,
+flink_siddhi_tpu/baseline/) replaying the identical stream — per-config
+values recorded in MEASURED_BASELINE below and in BASELINE.md.
+``vs_jvm_estimate`` keeps rounds 1-3's pinned 500_000 ev/s estimate of
+the in-JVM Siddhi runtime as a second denominator for continuity (the
+north star "vs 20x" was stated against it).
 
 Env knobs: BENCH_EVENTS (default 10_000_000), BENCH_BATCH (default 524288),
 BENCH_CONFIG (headline | filter | pattern2 | window_groupby | multiquery64).
@@ -38,7 +42,65 @@ os.environ.setdefault(
     "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2"
 )
 
-BASELINE_EVENTS_PER_SEC = 500_000.0
+BASELINE_EVENTS_PER_SEC = 500_000.0  # pinned JVM-runtime estimate
+
+# Measured single-core per-event reference interpreter (the JVM
+# engine's architectural shape in Python; flink_siddhi_tpu/baseline).
+# Reproduce any entry with: BENCH_CONFIG=<cfg> python bench.py --baseline
+# Values from this machine (see BASELINE.md for the runs); ``vs_baseline``
+# divides by these. The pinned JVM estimate is reported alongside as
+# ``vs_jvm_estimate`` (CPython is slower than a warmed JVM; for the
+# single-query configs the two happen to land within ~2x).
+MEASURED_BASELINE = {
+    "filter": 951_000.0,
+    "pattern2": 694_000.0,
+    "headline": 495_000.0,
+    "window_groupby": 331_000.0,
+    "multiquery64": 21_800.0,
+}
+
+
+def run_baseline(config, n_events):
+    """Replay the IDENTICAL synthetic stream (same make_batches draws,
+    per-batch RNG interleaving and all) through the per-event reference
+    interpreter on one core; prints ONE JSON line."""
+    from flink_siddhi_tpu.baseline import BaselineEngine
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [
+            ("id", AttributeType.INT),
+            ("name", AttributeType.STRING),
+            ("price", AttributeType.DOUBLE),
+            ("timestamp", AttributeType.LONG),
+        ]
+    )
+    cql = _config_cql(config)
+    n_ids = 1000 if config == "window_groupby" else 50
+    batch = int(os.environ.get("BENCH_BATCH", 524_288))
+    batches = make_batches(n_events, batch, schema, "inputStream", n_ids)
+    ids = np.concatenate([b.columns["id"] for b in batches]).tolist()
+    prices = np.concatenate(
+        [b.columns["price"] for b in batches]
+    ).tolist()
+    ts = np.concatenate([b.timestamps for b in batches]).tolist()
+    cols = {
+        "id": ids,
+        "name": ["test_event"] * n_events,
+        "price": prices,
+        "timestamp": ts,
+    }
+    eng = BaselineEngine(cql, ["id", "name", "price", "timestamp"])
+    t0 = time.perf_counter()
+    eng.run_columns(cols, ts)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": f"baseline events/sec ({config}, {n_events} events)",
+        "value": round(n_events / dt, 1),
+        "unit": "events/sec",
+        "emitted": eng.emitted,
+    }))
 
 
 def make_batches(n_events, batch, schema, stream_id, n_ids=50, step_ms=1):
@@ -65,6 +127,47 @@ def make_batches(n_events, batch, schema, stream_id, n_ids=50, step_ms=1):
     return out
 
 
+def _config_cql(config):
+    if config == "headline":
+        return (
+            "from every s1 = inputStream[id == 1] -> "
+            "s2 = inputStream[id == 2] -> s3 = inputStream[id == 3] "
+            "within 5 sec "
+            "select s1.timestamp as t1, s3.timestamp as t3, "
+            "s3.price as price insert into matches"
+        )
+    if config == "filter":
+        return (
+            "from inputStream[id == 2] select id, name, price "
+            "insert into matches"
+        )
+    if config == "pattern2":
+        return (
+            "from every s1 = inputStream[id == 1] -> "
+            "s2 = inputStream[id == 2] "
+            "select s1.timestamp as t1, s2.timestamp as t2 "
+            "insert into matches"
+        )
+    if config == "window_groupby":
+        return (
+            "from inputStream#window.length(1000) "
+            "select id, sum(price) as total, count() as cnt "
+            "group by id insert into matches"
+        )
+    if config == "multiquery64":
+        parts = []
+        for q in range(64):
+            a, b = q % 50, (q * 7 + 1) % 50
+            parts.append(
+                f"from every s1 = inputStream[id == {a}] -> "
+                f"s2 = inputStream[id == {b}] "
+                f"select s1.timestamp as t1, s2.timestamp as t2 "
+                f"insert into m{q}"
+            )
+        return "; ".join(parts)
+    raise SystemExit(f"unknown BENCH_CONFIG {config!r}")
+
+
 def build_job(config, n_events, batch):
     from flink_siddhi_tpu import CEPEnvironment
     from flink_siddhi_tpu.compiler.plan import compile_plan
@@ -84,45 +187,7 @@ def build_job(config, n_events, batch):
         shared_strings=env.shared_strings,
     )
 
-    if config == "headline":
-        cql = (
-            "from every s1 = inputStream[id == 1] -> "
-            "s2 = inputStream[id == 2] -> s3 = inputStream[id == 3] "
-            "within 5 sec "
-            "select s1.timestamp as t1, s3.timestamp as t3, "
-            "s3.price as price insert into matches"
-        )
-    elif config == "filter":
-        cql = (
-            "from inputStream[id == 2] select id, name, price "
-            "insert into matches"
-        )
-    elif config == "pattern2":
-        cql = (
-            "from every s1 = inputStream[id == 1] -> "
-            "s2 = inputStream[id == 2] "
-            "select s1.timestamp as t1, s2.timestamp as t2 "
-            "insert into matches"
-        )
-    elif config == "window_groupby":
-        cql = (
-            "from inputStream#window.length(1000) "
-            "select id, sum(price) as total, count() as cnt "
-            "group by id insert into matches"
-        )
-    elif config == "multiquery64":
-        parts = []
-        for q in range(64):
-            a, b = q % 50, (q * 7 + 1) % 50
-            parts.append(
-                f"from every s1 = inputStream[id == {a}] -> "
-                f"s2 = inputStream[id == {b}] "
-                f"select s1.timestamp as t1, s2.timestamp as t2 "
-                f"insert into m{q}"
-            )
-        cql = "; ".join(parts)
-    else:
-        raise SystemExit(f"unknown BENCH_CONFIG {config!r}")
+    cql = _config_cql(config)
 
     n_ids = 1000 if config == "window_groupby" else 50
     batches = make_batches(n_events, batch, schema, "inputStream", n_ids)
@@ -133,7 +198,13 @@ def build_job(config, n_events, batch):
     # columns stay host-side (ordinals decode against retained batches)
     # and host-evaluable predicates ship as packed mask bits — the
     # headline wire drops to 3 predicate bits/event, the filter to 1
-    ecfg = EngineConfig(lazy_projection=True, pred_pushdown=True)
+    ecfg = EngineConfig(
+        lazy_projection=True,
+        pred_pushdown=True,
+        max_tape_capacity=(
+            int(os.environ.get("BENCH_TAPE_CAP", 0)) or None
+        ),
+    )
     plan = compile_plan(
         cql, {"inputStream": schema}, plan_id="bench", config=ecfg
     )
@@ -158,6 +229,11 @@ def main():
     config = os.environ.get("BENCH_CONFIG", "headline")
     n_events = int(os.environ.get("BENCH_EVENTS", 10_000_000))
     batch = int(os.environ.get("BENCH_BATCH", 524_288))
+    if "--baseline" in sys.argv:
+        run_baseline(
+            config, int(os.environ.get("BENCH_BASELINE_EVENTS", 1_000_000))
+        )
+        return
     warmup_cycles = 3
 
     job = build_job(config, n_events, batch)
@@ -184,11 +260,17 @@ def main():
         measured = job.processed_events
         elapsed = time.perf_counter() - t_start
     ev_per_sec = measured / max(elapsed, 1e-9)
+    base = MEASURED_BASELINE.get(config, BASELINE_EVENTS_PER_SEC)
     out = {
         "metric": f"events/sec ({config}, {n_events} events)",
         "value": round(ev_per_sec, 1),
         "unit": "events/sec",
-        "vs_baseline": round(ev_per_sec / BASELINE_EVENTS_PER_SEC, 3),
+        # measured single-core reference interpreter (bench --baseline)
+        "vs_baseline": round(ev_per_sec / base, 3),
+        # the historical pinned in-JVM Siddhi estimate, for continuity
+        "vs_jvm_estimate": round(
+            ev_per_sec / BASELINE_EVENTS_PER_SEC, 3
+        ),
     }
 
     # Phase 2: MATCH LATENCY at a sustainable offered load (80% of the
@@ -202,11 +284,14 @@ def main():
     # (visibility) latency from phase 1 instead.
     measure_latency = config in ("headline", "pattern2", "filter")
     if measure_latency:
-        # offered load: HALF the full-throttle rate, capped at 2.5M
-        # ev/s — the sink path (data drains + host decode) has lower
-        # capacity than the counts-only throughput phase, and latency
-        # above capacity is unbounded queueing, not an engine property
-        lat_rate = min(0.5 * ev_per_sec, 2_500_000.0)
+        # offered load: capped at 1M ev/s (~2x the measured single-core
+        # baseline's throughput) and at half the full-throttle rate —
+        # the sink path (data drains over a slow d2h tunnel + host
+        # decode) has lower capacity than the counts-only throughput
+        # phase, and latency above capacity is unbounded queueing (now
+        # honestly visible since samples stamp scheduled due times),
+        # not an engine property
+        lat_rate = min(0.5 * ev_per_sec, 1_000_000.0)
         lat_rate = float(os.environ.get("BENCH_LAT_RATE", lat_rate))
         lat = _latency_phase(config, lat_rate)
         if lat is not None:
@@ -245,11 +330,25 @@ class _PacedSource:
             self.t0 = time.perf_counter()
         if self.i >= len(self.batches):
             return None, None, True
-        due = self.t0 + self.i * self.period
-        if time.perf_counter() < due:
+        now = time.perf_counter()
+        out = []
+        # release every due batch, up to 4 per poll (a stall — e.g. a
+        # drain fetch paying a tunnel RTT — must not throttle the
+        # offered load to one batch per cycle, or the phase measures
+        # the throttle; the 4x cap keeps concats on the 1x/2x/4x tape
+        # shapes the warmup precompiled)
+        while (
+            self.i < len(self.batches)
+            and len(out) < 4
+            and now >= self.t0 + self.i * self.period
+        ):
+            out.append(self.batches[self.i])
+            self.i += 1
+        if not out:
             return None, None, False
-        b = self.batches[self.i]
-        self.i += 1
+        from flink_siddhi_tpu.schema.batch import EventBatch
+
+        b = out[0] if len(out) == 1 else EventBatch.concat(out)
         return b, int(b.timestamps.max()), self.i >= len(self.batches)
 
 
@@ -258,13 +357,20 @@ def _latency_phase(config, rate):
     Returns per-batch latency samples (seconds), middle 80% of the run."""
     if rate <= 0:
         return None
-    period = 0.025  # one micro-batch per 25 ms
-    m = max(int(rate * period), 1024)
+    # power-of-two micro-batch so catch-up concats (2x, 4x) land on
+    # precompiled tape shapes instead of triggering mid-run compiles.
+    # Sized so ONE tunnel round trip (~100 ms — every dispatch pays it
+    # once drains keep d2h traffic in flight) carries >=1 period of
+    # events; smaller batches just queue behind their own RTTs.
+    m = 131072
+    period = m / rate
     seconds = float(os.environ.get("BENCH_LAT_SECONDS", 6.0))
     n_batches = max(int(seconds / period), 10)
     job = build_job(config, m * n_batches, m)
+    # each data drain costs ~one d2h round trip that serializes with the
+    # pipeline; 150 ms balances staleness against that toll
     job.drain_interval_ms = float(
-        os.environ.get("BENCH_LAT_DRAIN_MS", 120.0)
+        os.environ.get("BENCH_LAT_DRAIN_MS", 150.0)
     )
     # re-source with the paced release schedule
     src = job._sources[0]
@@ -275,14 +381,22 @@ def _latency_phase(config, rate):
             batches.append(b)
         if done:
             break
-    # warm up OFF the clock: the first batch at this (new) tape shape
-    # compiles; a compile mid-schedule would make every later batch
-    # "due" at once and measure a burst, not the steady state
+    # warm up OFF the clock: compile the 1x, 2x and 4x tape shapes
+    # (single batches + catch-up concats) before the schedule starts; a
+    # compile mid-schedule would make every later batch "due" at once
+    # and measure a burst, not the steady state
     from flink_siddhi_tpu.runtime.sources import BatchSource as _BS
+    from flink_siddhi_tpu.schema.batch import EventBatch as _EB
 
-    warm_n = 4
+    warm_n = 8
+    warm = [
+        batches[0],
+        batches[1],
+        _EB.concat(batches[2:4]),
+        _EB.concat(batches[4:8]),
+    ]
     job._sources = [_BS(batches[0].stream_id, batches[0].schema,
-                        iter(batches[:warm_n]))]
+                        iter(warm))]
     job._source_wm = [-(2 ** 62)]
     job._source_done = [False]
     while not job.finished:
@@ -308,12 +422,15 @@ def _latency_phase(config, rate):
     while not job.finished:
         before = job.processed_events
         job.run_cycle()
-        if job.processed_events > before:
-            # stamp the batch's SCHEDULED due time, not its ingest time:
-            # stamping at ingest would hide queueing delay whenever the
-            # engine falls behind the offered load (coordinated omission)
-            arrivals[seen] = src.t0 + (seen - warm_n) * period
-            seen += 1
+        ingested = (job.processed_events - before) // m
+        if ingested:
+            # stamp each batch's SCHEDULED due time, not its ingest
+            # time: stamping at ingest would hide queueing delay
+            # whenever the engine falls behind the offered load
+            # (coordinated omission); a catch-up cycle ingests several
+            for _ in range(ingested):
+                arrivals[seen] = src.t0 + (seen - warm_n) * period
+                seen += 1
         else:
             time.sleep(0.002)
     job.flush()

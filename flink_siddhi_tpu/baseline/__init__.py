@@ -1,0 +1,3 @@
+from .interp import BaselineEngine
+
+__all__ = ["BaselineEngine"]

@@ -1,0 +1,248 @@
+"""Single-core per-event reference interpreter (the measured baseline).
+
+The repo's benchmarks used to grade themselves against a PINNED estimate
+of the in-JVM Siddhi runtime (500k events/sec) that nobody had measured
+— BASELINE.md documents that the reference publishes no numbers. This
+module is the falsifiable stand-in: a straightforward per-event engine
+in the exact architectural shape of siddhi-core's hot path (one event at
+a time through filter processors / NFA partial-match lists / window
+processors with running aggregates —
+``AbstractSiddhiOperator.processElement`` feeding siddhi-core,
+reference: operator/AbstractSiddhiOperator.java:209-233), written
+against the same parsed CQL AST the TPU engine compiles.
+
+``python bench.py --baseline`` replays the identical event stream
+through it on one core and prints its events/sec; BENCH numbers divide
+by the recorded measurement. It is deliberately the SIMPLE obvious
+implementation — per-event dispatch, dict state, no vectorization — the
+way the JVM engine processes events (which JIT-compiles to far faster
+code than CPython; BASELINE.md keeps the JVM-estimate ratio alongside
+for that reason).
+"""
+
+from __future__ import annotations
+
+import operator
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..query import ast
+from ..query.lexer import SiddhiQLError
+from ..query.parser import parse_plan
+
+
+_OPS = {
+    "==": operator.eq, "!=": operator.ne, "<": operator.lt,
+    "<=": operator.le, ">": operator.gt, ">=": operator.ge,
+    "+": operator.add, "-": operator.sub, "*": operator.mul,
+    "%": operator.mod,
+}
+
+
+def _compile_scalar(expr: ast.Expr) -> Callable[[Dict[str, Any]], Any]:
+    """AST -> per-event Python closure over a field dict."""
+    if isinstance(expr, ast.Literal):
+        v = expr.value
+        return lambda ev: v
+    if isinstance(expr, ast.TimeLiteral):
+        v = expr.ms
+        return lambda ev: v
+    if isinstance(expr, ast.Attr):
+        name = expr.name
+        if expr.qualifier is not None:
+            key = f"{expr.qualifier}.{name}"
+            return lambda ev: ev[key] if key in ev else ev[name]
+        return lambda ev: ev[name]
+    if isinstance(expr, ast.Unary):
+        f = _compile_scalar(expr.operand)
+        if expr.op == "not":
+            return lambda ev: not f(ev)
+        return lambda ev: -f(ev)
+    if isinstance(expr, ast.Binary):
+        lf = _compile_scalar(expr.left)
+        rf = _compile_scalar(expr.right)
+        if expr.op == "and":
+            return lambda ev: lf(ev) and rf(ev)
+        if expr.op == "or":
+            return lambda ev: lf(ev) or rf(ev)
+        if expr.op == "/":
+            return lambda ev: lf(ev) / rf(ev)
+        op = _OPS[expr.op]
+        return lambda ev: op(lf(ev), rf(ev))
+    raise SiddhiQLError(f"baseline interpreter: unsupported {expr!r}")
+
+
+class _Select:
+    def __init__(self, q: ast.Query):
+        inp = q.input
+        self.filters = [_compile_scalar(f) for f in inp.filters]
+        self.projs = [
+            _compile_scalar(it.expr) for it in q.selector.items
+        ]
+        self.out = q.output_stream
+
+    def on_event(self, ev, ts, emit):
+        for f in self.filters:
+            if not f(ev):
+                return
+        emit(self.out, ts, tuple(p(ev) for p in self.projs))
+
+
+class _Chain:
+    """``every e0 -> e1 [-> ...] [within W]`` NFA: a list of partial
+    matches, advanced per event (the JVM engine's partial-match chain)."""
+
+    def __init__(self, q: ast.Query):
+        inp = q.input
+        self.within = inp.within
+        self.elements = []
+        for el in inp.elements:
+            flt = (
+                _compile_scalar(el.filter)
+                if el.filter is not None
+                else None
+            )
+            self.elements.append((el.alias, flt))
+        self.projs = [
+            _compile_scalar(it.expr) for it in q.selector.items
+        ]
+        self.out = q.output_stream
+        self.partials: List[Tuple[int, int, Dict[str, Any]]] = []
+        # (next_element_idx, start_ts, captures)
+
+    def on_event(self, ev, ts, emit):
+        K = len(self.elements)
+        w = self.within
+        # expire, then try to advance every partial (oldest first)
+        out_partials = []
+        for step, start_ts, caps in self.partials:
+            if w is not None and ts - start_ts > w:
+                continue
+            alias, flt = self.elements[step]
+            if flt is None or flt(ev):
+                caps = dict(caps)
+                for k, v in ev.items():
+                    caps[f"{alias}.{k}"] = v
+                if step + 1 == K:
+                    row = tuple(p(caps) for p in self.projs)
+                    emit(self.out, ts, row)
+                    continue
+                out_partials.append((step + 1, start_ts, caps))
+            else:
+                out_partials.append((step, start_ts, caps))
+        self.partials = out_partials
+        # every-semantics: each e0 match starts a fresh instance
+        alias0, flt0 = self.elements[0]
+        if flt0 is None or flt0(ev):
+            caps = {f"{alias0}.{k}": v for k, v in ev.items()}
+            if K == 1:
+                emit(self.out, ts, tuple(p(caps) for p in self.projs))
+            else:
+                self.partials.append((1, ts, caps))
+
+
+class _LengthWindowGroupBy:
+    """``#window.length(C) select ... group by k``: ring of the last C
+    events + per-group running aggregates (add on arrival, subtract on
+    eviction), emitting the group's row per event — siddhi-core's
+    LengthWindowProcessor + GroupByKeyGenerator shape."""
+
+    def __init__(self, q: ast.Query, capacity: int):
+        inp = q.input
+        self.filters = [_compile_scalar(f) for f in inp.filters]
+        self.cap = capacity
+        self.group_keys = list(q.selector.group_by)
+        self.ring: deque = deque()
+        self.sums: Dict[Any, float] = {}
+        self.counts: Dict[Any, int] = {}
+        # each select item: ('group', fn) | ('sum', fn) | ('count',)
+        self.items = []
+        for it in q.selector.items:
+            e = it.expr
+            if isinstance(e, ast.Call) and e.name == "sum":
+                self.items.append(("sum", _compile_scalar(e.args[0])))
+            elif isinstance(e, ast.Call) and e.name == "count":
+                self.items.append(("count", None))
+            else:
+                self.items.append(("group", _compile_scalar(e)))
+        self.out = q.output_stream
+
+    def on_event(self, ev, ts, emit):
+        for f in self.filters:
+            if not f(ev):
+                return
+        key = tuple(ev[k] for k in self.group_keys)
+        sv = 0.0
+        for kind, fn in self.items:
+            if kind == "sum":
+                sv = fn(ev)
+        self.ring.append((key, sv))
+        self.sums[key] = self.sums.get(key, 0.0) + sv
+        self.counts[key] = self.counts.get(key, 0) + 1
+        if len(self.ring) > self.cap:
+            okey, osv = self.ring.popleft()
+            self.sums[okey] -= osv
+            self.counts[okey] -= 1
+        row = []
+        for kind, fn in self.items:
+            if kind == "sum":
+                row.append(self.sums[key])
+            elif kind == "count":
+                row.append(self.counts[key])
+            else:
+                row.append(fn(ev))
+        emit(self.out, ts, tuple(row))
+
+
+class BaselineEngine:
+    """Per-event interpreter for the benchmark CQL surface: stateless
+    filters, every-chains with within, and sliding length-window
+    group-by aggregation. Multi-query plans fan each event through every
+    query, one runtime per query (the reference's operator design)."""
+
+    def __init__(self, cql: str, field_names: List[str]):
+        plan = parse_plan(cql)
+        self.field_names = list(field_names)
+        self.handlers = []
+        for q in plan.queries:
+            inp = q.input
+            if isinstance(inp, ast.PatternInput):
+                self.handlers.append(_Chain(q))
+            elif isinstance(inp, ast.StreamInput):
+                if inp.windows:
+                    win = inp.windows[0]
+                    if win.name != "length":
+                        raise SiddhiQLError(
+                            "baseline interpreter: only length windows"
+                        )
+                    cap = win.args[0]
+                    assert isinstance(cap, ast.Literal)
+                    self.handlers.append(
+                        _LengthWindowGroupBy(q, int(cap.value))
+                    )
+                else:
+                    self.handlers.append(_Select(q))
+            else:
+                raise SiddhiQLError(
+                    "baseline interpreter: unsupported input"
+                )
+        self.emitted = 0
+
+    def _emit(self, out, ts, row):
+        self.emitted += 1
+
+    def process(self, ev: Dict[str, Any], ts: int) -> None:
+        emit = self._emit
+        for h in self.handlers:
+            h.on_event(ev, ts, emit)
+
+    def run_columns(self, cols: Dict[str, list], ts_list: list) -> int:
+        """Replay columnar data per event (zip to dicts on the fly)."""
+        names = list(cols)
+        seqs = [cols[n] for n in names]
+        process = self.process
+        n = 0
+        for ts, vals in zip(ts_list, zip(*seqs)):
+            process(dict(zip(names, vals)), ts)
+            n += 1
+        return n

@@ -15,6 +15,7 @@ batch.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 
 @dataclass(frozen=True)
@@ -37,6 +38,11 @@ class EngineConfig:
     acc_budget_bytes: int = 256 * 1024 * 1024
     # pre-padded query slots per dynamic chain group
     dyn_query_slots: int = 8
+    # compile-window cap (None = auto): oversized micro-batches step in
+    # chunks of this tape capacity instead of compiling one huge program
+    # — XLA compile time scales with tape width, catastrophically so for
+    # wide multi-query stacks
+    max_tape_capacity: Optional[int] = None
     # late materialization for single-chain plans: projection-only
     # columns never ship to the device — the matcher emits event
     # ordinals and decode resolves them against host-retained batches.

@@ -625,6 +625,33 @@ def _compact_index(rel, R: int):
     return idx, cnt, cvalid
 
 
+def _compact_index_batched(rel, R: int):
+    """(Q, E) batched variant of ``_compact_index`` as ONE flat scatter.
+    A vmapped scatter lowers to a batched scatter XLA serializes badly
+    on TPU (it dominated the stacked step); flattening the destination
+    space to Q*R restores the cheap single-scatter lowering."""
+    Q, E = int(rel.shape[0]), int(rel.shape[1])
+    cnt = rel.sum(axis=1).astype(jnp.int32)
+    cpos = jnp.cumsum(rel.astype(jnp.int32), axis=1) - 1
+    ok = rel & (cpos < R)
+    qoff = jnp.arange(Q, dtype=jnp.int32)[:, None] * R
+    dest = jnp.where(ok, cpos + qoff, Q * R)
+    src = jnp.broadcast_to(
+        jnp.arange(E, dtype=jnp.int32)[None, :], (Q, E)
+    )
+    idx = (
+        jnp.zeros(Q * R, dtype=jnp.int32)
+        .at[dest.reshape(-1)]
+        .set(src.reshape(-1), mode="drop")
+        .reshape(Q, R)
+    )
+    cvalid = (
+        jnp.arange(R, dtype=jnp.int32)[None, :]
+        < jnp.minimum(cnt, R)[:, None]
+    )
+    return idx, cnt, cvalid
+
+
 def _element_preds(spec: _PatternSpec, tape, enabled) -> List[jnp.ndarray]:
     """bool[E] match mask per element, fused over the whole batch."""
     env: ColumnEnv = dict(tape.cols)
@@ -1538,6 +1565,7 @@ class StackedChainArtifact:
     # for stacks up to out_cap_factor queries, bounded (with a drained
     # overflow counter) beyond that
     out_cap_factor: int = 8
+    column_types: Optional[Dict] = None
 
     def __post_init__(self):
         self.pool = self.members[0].pool
@@ -1545,6 +1573,104 @@ class StackedChainArtifact:
         assert all(
             _ChainCfg.of(m.spec) == self._cfg for m in self.members
         ), "stacked members must share a chain signature"
+        self._vec_info = self._build_vec_preds()
+
+    def _build_vec_preds(self):
+        """Per-element conjunct vectors for the broadcast predicate path:
+        when every member's element-k filter flattens to the same
+        ``attr OP literal`` conjunct keys (numeric literals), the Q*K
+        closure evaluations collapse to a handful of (Q, E) broadcast
+        compares — Q separate HLO ops per element defeat XLA fusion and
+        dominated the stacked step. None = fall back to closures."""
+        specs = [m.spec for m in self.members]
+        K = specs[0].n_elements
+        Q = len(self.members)
+        info = []
+        for k in range(K):
+            el0 = specs[0].elements[k]
+            if el0.negated or (el0.min_count, el0.max_count) != (1, 1):
+                return None
+            if specs[0].pred_fns[k] is None:
+                if any(s.pred_fns[k] is not None for s in specs):
+                    return None
+                if any(s.elements[k].filter is not None for s in specs):
+                    return None  # cross filters stay on the slot path
+                info.append(())
+                continue
+            per_member = []
+            for s in specs:
+                el = s.elements[k]
+                if el.filter is None:
+                    return None
+                conj = _template_conjuncts(el, self.column_types)
+                if conj is None:
+                    return None
+                per_member.append(conj)
+            n_conj = len(per_member[0])
+            if any(len(c) != n_conj for c in per_member):
+                return None
+            conjs = []
+            for j in range(n_conj):
+                keys = {c[j][0] for c in per_member}
+                if len(keys) != 1:
+                    return None
+                vals = [c[j][2] for c in per_member]
+                if any(isinstance(v, (str, bool)) for v in vals):
+                    return None  # interned/string literals: closure path
+                # preserve integer literals exactly: routing them
+                # through float64 would corrupt int64 values past 2^53
+                vals_np = (
+                    np.asarray(vals, np.int64)
+                    if all(isinstance(v, int) for v in vals)
+                    else np.asarray(vals, np.float64)
+                )
+                conjs.append(
+                    (
+                        next(iter(keys)),
+                        np.asarray(
+                            [c[j][1] for c in per_member], np.int32
+                        ),
+                        vals_np,
+                    )
+                )
+            info.append(tuple(conjs))
+        return tuple(info)
+
+    def _vec_preds(self, tape, enabled):
+        """(Q, K, E) element masks via broadcast compares."""
+        Q = len(self.members)
+        spec0 = self.members[0].spec
+        E = tape.capacity
+        out = []
+        ops = (
+            jnp.equal, jnp.not_equal, jnp.less, jnp.less_equal,
+            jnp.greater, jnp.greater_equal,
+        )
+        for k, conjs in enumerate(self._vec_info):
+            base = tape.valid & (
+                tape.stream == spec0.stream_code_of[k]
+            )
+            mk = jnp.broadcast_to(base[None, :], (Q, E))
+            for key, opcodes, vals in conjs:
+                col = tape.cols[key]
+                lits = jnp.asarray(vals).astype(col.dtype)[:, None]
+                colb = col[None, :]
+                distinct = sorted(set(opcodes.tolist()))
+                cm = None
+                if len(distinct) == 1:
+                    cm = ops[distinct[0]](colb, lits)
+                else:
+                    opc = jnp.asarray(opcodes)[:, None]
+                    for oc in distinct:
+                        m = ops[oc](colb, lits)
+                        cm = (
+                            m
+                            if cm is None
+                            else jnp.where(opc == oc, m, cm)
+                        )
+                mk = mk & cm
+            out.append(mk & enabled[:, None])
+        return jnp.stack(out, axis=1)
 
     @property
     def output_schema(self) -> OutputSchema:
@@ -1593,14 +1719,17 @@ class StackedChainArtifact:
         P = self.pool
         Q = len(self.members)
 
-        preds = jnp.stack(
-            [
-                jnp.stack(
-                    _element_preds(m.spec, tape, state["enabled"][qi])
-                )
-                for qi, m in enumerate(self.members)
-            ]
-        )  # (Q, K, E)
+        if self._vec_info is not None:
+            preds = self._vec_preds(tape, state["enabled"])  # (Q, K, E)
+        else:
+            preds = jnp.stack(
+                [
+                    jnp.stack(
+                        _element_preds(m.spec, tape, state["enabled"][qi])
+                    )
+                    for qi, m in enumerate(self.members)
+                ]
+            )  # (Q, K, E)
         cap_srcs = {
             pair: jnp.stack(
                 [
@@ -1765,17 +1894,8 @@ class StackedChainArtifact:
         # more relevant events.
         if E >= _COMPACT_MIN_E:
             Rw = max(2048, E // 16)
-
-            def compact_one(pr):
-                rel = pr.any(axis=0) & tape.valid
-                idx, cnt, _cv = _compact_index(rel, Rw)
-                return idx, cnt
-
-            idxs, cnts = jax.vmap(compact_one)(preds)  # (Q, Rw), (Q,)
-            cvalid = (
-                jnp.arange(Rw)[None, :]
-                < jnp.minimum(cnts, Rw)[:, None]
-            )  # (Q, Rw)
+            rel = preds.any(axis=1) & tape.valid[None, :]  # (Q, E)
+            idxs, cnts, cvalid = _compact_index_batched(rel, Rw)
 
             def run_compact():
                 ts_c = tape.ts[idxs]  # (Q, Rw)
@@ -2338,11 +2458,15 @@ def _decode_qid_block(n: int, block, slot_schemas):
     return out
 
 
-def group_chain_artifacts(artifacts: List, exclude=frozenset()) -> List:
+def group_chain_artifacts(
+    artifacts: List, exclude=frozenset(), column_types=None
+) -> List:
     """Replace runs of structurally-identical ChainPatternArtifacts with
     one StackedChainArtifact (multi-query parallelism). Artifacts in
     ``exclude`` (e.g. chained-query producers, read by name) stay
-    standalone."""
+    standalone. ``column_types`` enables the vectorized predicate path
+    (per-element broadcast compare against a literal vector instead of
+    Q*K separate closure ops)."""
     groups: Dict = {}
     for a in artifacts:
         if isinstance(a, ChainPatternArtifact) and a.name not in exclude:
@@ -2361,6 +2485,7 @@ def group_chain_artifacts(artifacts: List, exclude=frozenset()) -> List:
             stacked = StackedChainArtifact(
                 name="@stack:" + members[0].name,
                 members=members,
+                column_types=column_types,
             )
             for m in members:
                 stacked_of[m.name] = stacked

@@ -65,6 +65,12 @@ class CompiledPlan:
     # projection, which changes the wire format itself)
     source_text: str = ""
     extensions: object = None
+    # compile-window cap: XLA compile time grows with tape width, and a
+    # wide multi-query stack at a 512k tape compiles for many MINUTES.
+    # When set, the executor steps oversized micro-batches in chunks of
+    # this capacity instead of compiling one huge program (the ingest
+    # batch size is unchanged; only the compiled window shrinks).
+    tape_capacity_limit: Optional[int] = None
 
     def recompiled(self, **config_overrides) -> "CompiledPlan":
         """Recompile this plan from its original CQL with EngineConfig
@@ -593,6 +599,7 @@ def compile_plan(
     artifacts = group_chain_artifacts(
         artifacts,
         exclude=frozenset(ci.producer for ci in chained.values()),
+        column_types=column_types,
     )
 
     # late materialization (opt-in): a single chain plan whose
@@ -669,6 +676,20 @@ def compile_plan(
             )
         ):
             segment_names.add(a.name)
+    # compile-window cap for wide multi-query stacks: XLA compile time
+    # grows with tape width * query count — a 64-query stack at a 512k
+    # tape compiles for minutes. Chunked stepping keeps compiles in the
+    # tens of seconds at a negligible per-chunk dispatch cost.
+    cap_limit = config.max_tape_capacity
+    if cap_limit is None:
+        from .nfa import StackedChainArtifact
+
+        for a in artifacts:
+            q_n = len(getattr(a, "members", ()) or ())
+            if isinstance(a, StackedChainArtifact) and q_n >= 16:
+                cap_limit = 131072
+                break
+
     return CompiledPlan(
         plan_id=plan_id,
         spec=spec,
@@ -682,6 +703,7 @@ def compile_plan(
         segment_artifacts=frozenset(segment_names),
         source_text=plan_text,
         extensions=extensions,
+        tape_capacity_limit=cap_limit,
     )
 
 

@@ -690,17 +690,28 @@ class Job:
                 self._drain_poll(rt)
 
     def prewarm_drains(
-        self, widths: Sequence[int] = (1024, 4096, 16384, 65536, 262144)
+        self, widths: Optional[Sequence[int]] = None
     ) -> None:
-        """Compile the bucketed packed-drain programs up front. The first
-        one at a new width costs ~0.7s on a tunneled device; prewarming
-        moves that out of the steady-state loop (benchmarks /
-        latency-sensitive pipelines call this once at startup)."""
+        """Compile the bucketed packed-drain programs up front — EVERY
+        power-of-two width fetch prediction can land on, by default. A
+        first compile at a new width mid-run stalls the pipeline for
+        seconds on a tunneled device; prewarming moves that out of the
+        steady-state loop (benchmarks / latency-sensitive pipelines
+        call this once at startup)."""
         for rt in self._plans.values():
             if rt.acc is None or not rt.plan.artifacts:
                 continue
             cap = rt.plan.acc_capacity()
-            for w in widths:
+            ws = widths
+            if ws is None:
+                # every power of two up to the full accumulator width
+                ws = []
+                w = 1024
+                while w < cap:
+                    ws.append(w)
+                    w <<= 1
+                ws.append(cap)
+            for w in ws:
                 if w <= cap:
                     self._pack_drain(rt, rt.acc, w)  # compile; drop result
 
@@ -1091,6 +1102,26 @@ class Job:
         ]
         if not involved:
             return
+        total = sum(len(b) for b in involved)
+        # compile-window cap (wide multi-query stacks): step oversized
+        # micro-batches in chunks so the compiled program stays at a
+        # tractable tape width. Single-input plans only — chunking a
+        # multi-stream merge would need a time-aligned cut per stream
+        # (stacked groups are single-stream by construction).
+        limit = plan.tape_capacity_limit
+        if limit and total > limit and len(involved) == 1:
+            b = involved[0]
+            for s in range(0, len(b), limit):
+                self._step_plan_window(
+                    rt, [b.slice(s, min(s + limit, len(b)))]
+                )
+            return
+        self._step_plan_window(rt, involved)
+
+    def _step_plan_window(
+        self, rt: _PlanRuntime, involved: List[EventBatch]
+    ) -> None:
+        plan = rt.plan
         total = sum(len(b) for b in involved)
         rt.tape_capacity = max(rt.tape_capacity, bucket_size(total))
         tape, _prov = build_wire_tape(
