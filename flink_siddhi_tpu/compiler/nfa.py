@@ -313,6 +313,93 @@ class _PatternSpec:
         return any(f is not None for f in self.cross_fns)
 
 
+def _rewrite_sequence_absence(inp: ast.PatternInput) -> ast.PatternInput:
+    """``A, not B, C`` in a STRICT sequence: any intervening event
+    already breaks contiguity, so the absence collapses into the next
+    element's filter — the event after A must be C and must NOT match B
+    (when B and C read the same stream; a different-stream B could never
+    be that event, so the guard is vacuous). Siddhi sequence absence
+    semantics via pure AST rewrite (README.md:77-96 "Sequence
+    Processing")."""
+    import dataclasses
+
+    els = list(inp.elements)
+    if els and els[0].negated:
+        raise SiddhiQLError(
+            "a sequence cannot start with an absent ('not') element"
+        )
+    if els and els[-1].negated:
+        raise SiddhiQLError(
+            "a sequence cannot end with an absent ('not') element"
+        )
+    out: List[ast.PatternElement] = []
+    pending: List[ast.PatternElement] = []  # consecutive absent run
+    for el in els:
+        if el.negated:
+            pending.append(el)
+            continue
+        if pending:
+            # every guard of the run applies to THIS (the next
+            # non-absent) element's event — folding one absent filter
+            # into another absent element would negate it twice
+            nxt = el
+            for ab in pending:
+                if ab.stream_id != nxt.stream_id:
+                    # strictness makes the guard vacuous: an
+                    # other-stream event between the neighbors would
+                    # break the sequence by itself
+                    continue
+                if ab.filter is None:
+                    raise SiddhiQLError(
+                        f"'not {ab.stream_id}' without a filter before "
+                        "a same-stream element can never match; filter "
+                        "the absent element"
+                    )
+                guard = ast.Unary(
+                    "not", _rebind_alias(ab.filter, ab.alias, nxt.alias)
+                )
+                nxt = dataclasses.replace(
+                    nxt,
+                    filter=(
+                        guard
+                        if nxt.filter is None
+                        else ast.Binary("and", nxt.filter, guard)
+                    ),
+                )
+            pending = []
+            out.append(nxt)
+        else:
+            out.append(el)
+    return dataclasses.replace(inp, elements=tuple(out))
+
+
+def _rebind_alias(expr: ast.Expr, old: str, new: str) -> ast.Expr:
+    """Rewrite attribute qualifiers ``old.x`` -> ``new.x`` (the absence
+    guard evaluates against the NEXT element's event)."""
+    import dataclasses
+
+    if isinstance(expr, ast.Attr):
+        if expr.qualifier == old:
+            return dataclasses.replace(expr, qualifier=new)
+        return expr
+    if isinstance(expr, ast.Unary):
+        return dataclasses.replace(
+            expr, operand=_rebind_alias(expr.operand, old, new)
+        )
+    if isinstance(expr, ast.Binary):
+        return dataclasses.replace(
+            expr,
+            left=_rebind_alias(expr.left, old, new),
+            right=_rebind_alias(expr.right, old, new),
+        )
+    if isinstance(expr, ast.Call):
+        return dataclasses.replace(
+            expr,
+            args=tuple(_rebind_alias(a, old, new) for a in expr.args),
+        )
+    return expr
+
+
 def _build_spec(
     q: ast.Query,
     schemas,
@@ -321,6 +408,8 @@ def _build_spec(
 ) -> _PatternSpec:
     inp = q.input
     assert isinstance(inp, ast.PatternInput)
+    if inp.kind == "sequence" and any(el.negated for el in inp.elements):
+        inp = _rewrite_sequence_absence(inp)
     aliases = [el.alias for el in inp.elements]
     if len(set(aliases)) != len(aliases):
         raise SiddhiQLError("pattern aliases must be unique")
@@ -339,10 +428,6 @@ def _build_spec(
     for g, mem in enumerate(groups):
         if len(mem) == 1:
             continue
-        if inp.kind == "sequence":
-            raise SiddhiQLError(
-                "'and'/'or' groups are not supported in sequences yet"
-            )
         for e in mem:
             el = inp.elements[e]
             if el.negated:

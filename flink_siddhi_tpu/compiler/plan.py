@@ -741,11 +741,29 @@ def _rewrite_partitioned(q: ast.Query, schemas) -> ast.Query:
             ast.contains_aggregate(i.expr) for i in sel.items
         )
         if inp.windows:
-            raise SiddhiQLError(
-                "windows inside 'partition with' are not supported yet "
-                "(a per-partition window is not a group-by over a shared "
-                "window)"
-            )
+            # per-partition window: EACH key's window holds that key's
+            # last C events (NOT a group-by over one shared window) —
+            # compiles to the per-key window artifact, which reads the
+            # partition key from group_by (the canonical Siddhi
+            # partition use; README.md:77-96)
+            if q.output_events != "current":
+                # per-key EXPIRY order differs from a shared window's;
+                # silently compiling to shared-window expiry would be
+                # exactly the wrong-answer class the partition carve-out
+                # exists to prevent
+                raise SiddhiQLError(
+                    "'insert expired events into' inside 'partition "
+                    "with' is not supported yet"
+                )
+            if not has_agg:
+                # plain windowed projection emits arriving CURRENT
+                # events unchanged; partitioning changes nothing
+                return dataclasses.replace(q, partition_with=())
+            if attr not in sel.group_by:
+                sel = dataclasses.replace(
+                    sel, group_by=tuple(sel.group_by) + (attr,)
+                )
+            return dataclasses.replace(q, selector=sel)
         if has_agg and attr not in sel.group_by:
             sel = dataclasses.replace(
                 sel, group_by=tuple(sel.group_by) + (attr,)
@@ -839,6 +857,15 @@ def _compile_query(
             config,
         )
     inp = q.input
+    if q.output_events != "current":
+        from .window import compile_expired_window
+
+        # `insert expired events into`: emit events as they LEAVE the
+        # window. Round-3 verdict: this was silently parsed as current
+        # events — the worst kind of wrong answer.
+        return compile_expired_window(
+            q, name, schemas, stream_codes, extensions, config
+        )
     if isinstance(inp, ast.JoinInput) and (
         inp.left.stream_id in table_schemas
         or inp.right.stream_id in table_schemas

@@ -219,6 +219,32 @@ def _seg_scan(flags, vals, combine_vals):
     return out
 
 
+def _seg_scan_sum_kahan(flags, vals):
+    """Compensated inclusive segmented SUM scan: each element carries a
+    (sum, err) pair combined with Neumaier two-sum, so an f32 prefix over
+    a long run keeps ~f64 accuracy instead of losing every addend below
+    the running magnitude's rounding grain. (Two-sum composition is not
+    exactly associative; the residual of re-association is itself
+    compensated, leaving errors at the 1-ulp-of-err scale.) Returns
+    (sum, err) arrays; the corrected prefix is their sum."""
+
+    def comb(a, b):
+        fa, sa, ca = a
+        fb, sb, cb = b
+        t = sa + sb
+        err = jnp.where(
+            jnp.abs(sa) >= jnp.abs(sb), (sa - t) + sb, (sb - t) + sa
+        )
+        s = jnp.where(fb, sb, t)
+        c = jnp.where(fb, cb, ca + cb + err)
+        return fa | fb, s, c
+
+    _, s, c = lax.associative_scan(
+        comb, (flags, vals, jnp.zeros_like(vals))
+    )
+    return s, c
+
+
 def _acc_stats_for(aggs: Sequence[_Agg]) -> Dict[int, set]:
     """arg_idx -> set of accumulator stats needed ('sum','sumsq','min','max')."""
     need: Dict[int, set] = {}
@@ -524,11 +550,20 @@ class SlidingWindowArtifact:
             onehot = (
                 c[:, :, None] == giota[None, None, :]
             ).astype(jnp.float32)
-            tile_sums = jnp.einsum("cig,cik->cgk", onehot, v)
+            # HIGHEST precision: the TPU's default matmul precision
+            # truncates f32 operands to bf16 passes — a window SUM must
+            # not lose mantissa (caught by the real-device smoke lane)
+            tile_sums = jnp.einsum(
+                "cig,cik->cgk", onehot, v,
+                precision=lax.Precision.HIGHEST,
+            )
             eq = (
                 c[:, :, None] == c[:, None, :]
             ).astype(jnp.float32) * tril[None]
-            partial = jnp.einsum("cij,cjk->cik", eq, v)
+            partial = jnp.einsum(
+                "cij,cjk->cik", eq, v,
+                precision=lax.Precision.HIGHEST,
+            )
             return tile_sums, partial
 
         S, partial = lax.map(
@@ -891,7 +926,7 @@ class SlidingWindowArtifact:
         idx = jnp.arange(E)[:, None] + 1 + jnp.arange(C)[None, :]
         win = {k: v[idx] for k, v in c_cols.items()}
         member = cval[idx]
-        if self.window_mode == "time":
+        if self.window_mode in ("time", "timeLength"):
             cur_ts = win["ts"][:, -1:]
             member = member & (win["ts"] > cur_ts - self.time_ms)
         for j in range(len(self.group_fns)):
@@ -998,6 +1033,13 @@ class CumulativeAggArtifact:
                         else jnp.int32
                     )
                     st[f"{s}{arg_idx}"] = jnp.zeros(G, adt)
+                    if adt == jnp.float32:
+                        # Neumaier compensation: an UNBOUNDED f32 running
+                        # sum otherwise silently loses every update once
+                        # the accumulated magnitude outgrows the mantissa
+                        # (round-3 verdict item 6; Siddhi double is f64
+                        # end-to-end)
+                        st[f"kc_{s}{arg_idx}"] = jnp.zeros(G, adt)
                 else:
                     st[f"{s}{arg_idx}"] = jnp.full(
                         G, _identity(s, dt), dt
@@ -1070,20 +1112,48 @@ class CumulativeAggArtifact:
                     if s == "sumsq":
                         vv_s = vv_s * vv_s
                     vv_s = jnp.where(mask[order], vv_s, 0)
-                    pre = _seg_scan(flags, vv_s, jnp.add) + acc[gather_g]
-                    stats_env[key] = pre[inv]
-                    tot = jax.ops.segment_sum(
-                        jnp.where(mask, v.astype(acc.dtype), 0)
-                        if s == "sum"
-                        else jnp.where(
-                            mask,
-                            v.astype(acc.dtype) * v.astype(acc.dtype),
-                            0,
-                        ),
-                        segkey,
-                        num_segments=G + 1,
-                    )[:G]
-                    new_state[key] = acc + tot
+                    kc = state.get(f"kc_{key}")
+                    if kc is None:
+                        # integer accumulators are exact: plain scan
+                        pre = (
+                            _seg_scan(flags, vv_s, jnp.add)
+                            + acc[gather_g]
+                        )
+                        stats_env[key] = pre[inv]
+                        tot = jax.ops.segment_sum(
+                            vv_s[inv], segkey, num_segments=G + 1
+                        )[:G]
+                        new_state[key] = acc + tot
+                    else:
+                        # f32 running sums: compensated scan within the
+                        # batch + Neumaier two-sum into the carried
+                        # accumulator — an unbounded cumulative sum must
+                        # not stall once its magnitude outgrows the
+                        # mantissa (round-3 verdict item 6)
+                        s_scan, c_scan = _seg_scan_sum_kahan(
+                            flags, vv_s
+                        )
+                        base = acc + kc
+                        pre = (s_scan + c_scan) + base[gather_g]
+                        stats_env[key] = pre[inv]
+                        ends = jnp.concatenate(
+                            [flags[1:], jnp.ones(1, bool)]
+                        )
+                        gi = jnp.where(ends & (g_s < G), g_s, G)
+                        tot = jnp.zeros(G + 1, acc.dtype).at[gi].add(
+                            jnp.where(ends, s_scan, 0), mode="drop"
+                        )[:G]
+                        tot_c = jnp.zeros(G + 1, acc.dtype).at[gi].add(
+                            jnp.where(ends, c_scan, 0), mode="drop"
+                        )[:G]
+                        t = acc + tot
+                        err = jnp.where(
+                            jnp.abs(acc) >= jnp.abs(tot),
+                            (acc - t) + tot,
+                            (tot - t) + acc,
+                        )
+                        new_state[key] = t
+                        new_state[f"kc_{key}"] = kc + err + tot_c
                 else:
                     ident = _identity(s, acc.dtype)
                     comb = jnp.minimum if s == "min" else jnp.maximum
@@ -1169,6 +1239,9 @@ class BatchWindowArtifact:
     having_fn: Optional[Callable]
     output_mode: str = "buffered"
     batch_slots: int = TIME_BATCH_SLOTS
+    # externalTimeBatch: window boundaries follow this tape column's
+    # values instead of event time
+    ts_key: Optional[str] = None
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
         """Widest per-cycle emission block: every window-grid cell can
@@ -1276,7 +1349,11 @@ class BatchWindowArtifact:
             completed = (first_batch + rows + 1) * n <= new_seen
         else:
             T = self.time_ms
-            ts = tape.ts
+            ts = (
+                env[self.ts_key].astype(jnp.int32)
+                if self.ts_key is not None
+                else tape.ts
+            )
             first_ts = jnp.where(
                 M > 0,
                 jnp.min(jnp.where(mask, ts, jnp.iinfo(jnp.int32).max)),
@@ -1559,6 +1636,20 @@ def _window_of(inp: ast.StreamInput):
                 "#window.externalTime needs (tsAttribute, duration)"
             )
         return ("externalTime", (w.args[0], _time_arg(w.args[1])))
+    if lname == "externaltimebatch":
+        if len(w.args) != 2 or not isinstance(w.args[0], ast.Attr):
+            raise SiddhiQLError(
+                "#window.externalTimeBatch needs (tsAttribute, duration)"
+            )
+        return ("externalTimeBatch", (w.args[0], _time_arg(w.args[1])))
+    if lname == "timelength":
+        if len(w.args) != 2 or not isinstance(w.args[1], ast.Literal):
+            raise SiddhiQLError(
+                "#window.timeLength needs (duration, count)"
+            )
+        return ("timeLength", (_time_arg(w.args[0]), int(w.args[1].value)))
+    if lname in ("sort", "unique"):
+        return (lname, tuple(w.args))
     raise SiddhiQLError(f"unsupported window #window.{w.name}")
 
 
@@ -1663,7 +1754,56 @@ def compile_window_query(
 
     group_resolved = [resolver.resolve(ast.Attr(n)) for n in group_names]
 
-    if window is None or window[0] in ("length", "time", "externalTime"):
+    if window is not None and window[0] in ("sort", "unique"):
+        if q.partition_with:
+            raise SiddhiQLError(
+                f"#window.{window[0]} inside 'partition with' is not "
+                "supported yet"
+            )
+        from .scan_windows import compile_scan_window
+
+        return compile_scan_window(
+            q, name, window, resolver, schemas, stream_codes, extensions,
+            config, filter_fns, rewritten, collector, having_re,
+        )
+
+    if q.partition_with and window is not None:
+        # per-partition window: each key's OWN last-C window
+        if window[0] != "length":
+            raise SiddhiQLError(
+                f"#window.{window[0]} inside 'partition with' is not "
+                "supported yet (length windows only)"
+            )
+        attr = dict(q.partition_with).get(inp.stream_id)
+        if tuple(group_names) != (attr,):
+            raise SiddhiQLError(
+                "additional 'group by' inside a partitioned window "
+                "query is not supported yet (the partition key is the "
+                "grouping)"
+            )
+        code_key, encoder, encoded = _group_encoding(
+            name, group_resolved, sc, filter_fns
+        )
+        art = PerKeyWindowArtifact(
+            name=name,
+            output_schema=out_schema,
+            stream_code=sc,
+            filter_fns=filter_fns,
+            capacity=int(window[1]),
+            aggs=collector.aggs,
+            arg_fns=collector.arg_fns,
+            arg_types=collector.arg_types,
+            code_key=code_key,
+            encoder=encoder,
+            proj_fns=proj_fns,
+            having_fn=having_fn,
+        )
+        art.encoded_columns = encoded
+        return art
+
+    if window is None or window[0] in (
+        "length", "time", "externalTime", "timeLength",
+    ):
         if window is None:
             mode, cap, time_ms, ts_key = "cumulative", 0, None, None
         elif window[0] == "length":
@@ -1672,6 +1812,12 @@ def compile_window_query(
             mode, cap, time_ms, ts_key = (
                 "time", config.time_window_capacity, window[1], None,
             )
+        elif window[0] == "timeLength":
+            # last-n AND within-t: the window matrix bounds membership
+            # to the most recent `count` matching events and the member
+            # mask adds the time cut — exactly min(time, length)
+            dur, n = window[1]
+            mode, cap, time_ms, ts_key = "timeLength", n, dur, None
         else:  # externalTime
             ts_attr, dur = window[1]
             r = resolver.resolve(ts_attr)
@@ -1711,7 +1857,7 @@ def compile_window_query(
             output_schema=out_schema,
             stream_code=sc,
             filter_fns=filter_fns,
-            window_mode="length" if mode == "length" else "time",
+            window_mode=mode if mode != "cumulative" else "length",
             capacity=cap,
             time_ms=time_ms,
             ts_key=ts_key,
@@ -1740,6 +1886,13 @@ def compile_window_query(
 
     # batch windows
     mode, arg = window
+    batch_ts_key = None
+    if mode == "externalTimeBatch":
+        # same tumbling machinery as timeBatch, but stream time advances
+        # with the user's timestamp attribute instead of event time
+        ts_attr, dur = arg
+        batch_ts_key = resolver.resolve(ts_attr).key
+        mode, arg = "timeBatch", dur
     code_key, encoder, encoded = _group_encoding(
         name, group_resolved, sc, filter_fns
     )
@@ -1768,6 +1921,7 @@ def compile_window_query(
         proj_fns=proj_fns,
         having_fn=having_fn,
         batch_slots=config.time_batch_slots,
+        ts_key=batch_ts_key,
     )
     art.encoded_columns = encoded
     return art
@@ -1807,3 +1961,448 @@ def _group_encoding(
         select_fn=select_fn,
     )
     return out_key, encoder, (enc,)
+
+
+# --------------------------------------------------------------------------
+# Expired-event output: ``insert expired events into O``
+# --------------------------------------------------------------------------
+
+@dataclass
+class ExpiredWindowArtifact:
+    """Emit events as they LEAVE a sliding window (Siddhi's expired
+    stream; siddhi-core ships this through any window processor's
+    expired-event chunk). Length windows expire an event when the C-th
+    matching event after it arrives (emission ts = the displacing
+    event's ts); time windows when stream time passes ts + span
+    (emission ts = ts + span; end-of-stream flushes the remainder, the
+    same "+inf watermark" rule the pattern matcher's timed absence
+    uses). Plain projections only — aggregates over the expired stream
+    are not part of the benchmarked reference surface and raise at
+    compile."""
+
+    name: str
+    output_schema: OutputSchema
+    output_mode: str  # 'buffered'
+    stream_code: int
+    filter_fns: List
+    window_mode: str  # 'length' | 'time'
+    capacity: int
+    time_ms: Optional[int]
+    proj_fns: List
+    ref_keys: List[str]  # tape columns the projections read
+    ref_dtypes: Dict[str, object]  # device dtype per ref column
+
+    def init_state(self) -> Dict:
+        C = self.capacity
+        ring: Dict[str, jnp.ndarray] = {
+            "ts": jnp.zeros(C, jnp.int32),
+            "count": jnp.zeros((), jnp.int32),
+            "overflow": jnp.zeros((), jnp.int32),
+        }
+        for k in self.ref_keys:
+            ring[f"c:{k}"] = jnp.zeros(C, self.ref_dtypes[k])
+        return {"enabled": jnp.asarray(True), "ring": ring}
+
+    def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
+        return tape_capacity + self.capacity
+
+    def _seq_gather(self, ring_col, arr_col, P0, idx):
+        """sequence[j] for the FIFO view ring[0:P0] ++ arrivals: j < P0
+        reads the ring, else the arrival at j - P0."""
+        C = self.capacity
+        src = jnp.where(idx < P0, jnp.clip(idx, 0, C - 1), 0)
+        from_ring = ring_col[src]
+        ai = jnp.clip(idx - P0, 0, arr_col.shape[0] - 1)
+        return jnp.where(idx < P0, from_ring, arr_col[ai])
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        env: ColumnEnv = dict(tape.cols)
+        mask = tape.valid & (tape.stream == self.stream_code)
+        for f in self.filter_fns:
+            mask = mask & f(env)
+        mask = mask & state["enabled"]
+        E = tape.capacity
+        C = self.capacity
+        ring = state["ring"]
+        P0 = ring["count"]
+        M = mask.sum().astype(jnp.int32)
+        rank = jnp.cumsum(mask) - 1
+        dest = jnp.where(mask, rank, E)
+
+        def compact(col, dtype=None):
+            col = jnp.broadcast_to(jnp.asarray(col), (E,))
+            if dtype is not None:
+                col = col.astype(dtype)
+            return jnp.zeros(E, col.dtype).at[dest].set(col, mode="drop")
+
+        arr_ts = compact(tape.ts)
+        arr_cols = {k: compact(env[k]) for k in self.ref_keys}
+        total = P0 + M
+        W = C + E
+        j = jnp.arange(W, dtype=jnp.int32)
+        seq_ts = self._seq_gather(ring["ts"], arr_ts, P0, j)
+
+        if self.window_mode == "length":
+            n_exp = jnp.clip(total - C, 0, W)
+            # entry j is displaced by arrival j + C - P0 of this batch
+            di = jnp.clip(j + C - P0, 0, E - 1)
+            emit_ts = arr_ts[di]
+        else:
+            bmax = jnp.max(
+                jnp.where(mask, tape.ts, jnp.int32(-(2 ** 30)))
+            )
+            horizon = bmax - jnp.int32(self.time_ms)
+            # expiry over the RUNNING-MAX timestamp so the expired set is
+            # always a sequence prefix — a cross-batch straggler (older
+            # ts arriving after newer ones) conservatively expires late
+            # instead of desyncing the emit/retain split (same defense
+            # as the sliding-window paths)
+            mono = lax.cummax(
+                jnp.where(j < total, seq_ts, jnp.int32(2 ** 31 - 1))
+            )
+            expired = (mono <= horizon) & (j < total)
+            n_exp = expired.sum().astype(jnp.int32)
+            emit_ts = seq_ts + jnp.int32(self.time_ms)
+
+        emit_env = {
+            k: self._seq_gather(ring[f"c:{k}"], arr_cols[k], P0, j)
+            for k in self.ref_keys
+        }
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(emit_env)), (W,))
+            for p in self.proj_fns
+        )
+
+        # survivors: sequence[n_keep_from .. total); clamp to ring cap
+        # (time windows can briefly hold more than C — count the drop)
+        n_live = jnp.clip(total - n_exp, 0, None)
+        dropped = jnp.clip(n_live - C, 0, None)
+        n_keep = jnp.minimum(n_live, C)
+        base = total - n_keep  # oldest kept entry
+        ki = jnp.arange(C, dtype=jnp.int32) + base
+        new_ring = {
+            "ts": self._seq_gather(ring["ts"], arr_ts, P0, ki),
+            "count": n_keep,
+            "overflow": ring["overflow"] + dropped,
+        }
+        for k in self.ref_keys:
+            new_ring[f"c:{k}"] = self._seq_gather(
+                ring[f"c:{k}"], arr_cols[k], P0, ki
+            )
+        new_state = {"enabled": state["enabled"], "ring": new_ring}
+        return new_state, (n_exp, emit_ts, cols)
+
+    @property
+    def flush_is_noop(self) -> bool:
+        return self.window_mode != "time"
+
+    def flush(self, state: Dict) -> Tuple[Dict, Tuple]:
+        """End of stream: time advances past every pending deadline, so
+        all retained entries expire (length windows never flush)."""
+        ring = state["ring"]
+        C = self.capacity
+        if self.window_mode != "time":
+            return state, (
+                jnp.zeros((), jnp.int32),
+                jnp.zeros(1, jnp.int32),
+                tuple(
+                    jnp.zeros(1, jnp.int32) for _ in self.proj_fns
+                ),
+            )
+        n = ring["count"]
+        emit_ts = ring["ts"] + jnp.int32(self.time_ms)
+        emit_env = {k: ring[f"c:{k}"] for k in self.ref_keys}
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(emit_env)), (C,))
+            for p in self.proj_fns
+        )
+        new_ring = dict(ring)
+        new_ring["count"] = jnp.zeros((), jnp.int32)
+        return (
+            {"enabled": state["enabled"], "ring": new_ring},
+            (n, emit_ts, cols),
+        )
+
+
+def compile_expired_window(
+    q: ast.Query,
+    name: str,
+    schemas,
+    stream_codes: Dict[str, int],
+    extensions,
+    config=None,
+):
+    from .config import DEFAULT_CONFIG
+
+    config = config or DEFAULT_CONFIG
+    if q.output_events == "all":
+        raise SiddhiQLError(
+            "'insert all events into' is not supported yet; issue the "
+            "current-events and expired-events queries separately"
+        )
+    inp = q.input
+    if not isinstance(inp, ast.StreamInput) or not inp.windows:
+        raise SiddhiQLError(
+            "'insert expired events into' needs a windowed single-stream "
+            "input (only windows retain events to expire)"
+        )
+    if q.selector.group_by or q.selector.having is not None or any(
+        ast.contains_aggregate(i.expr) for i in q.selector.items
+    ):
+        raise SiddhiQLError(
+            "aggregations/group by/having over the expired stream are "
+            "not supported; select plain attributes"
+        )
+    window = _window_of(inp)
+    if window[0] not in ("length", "time"):
+        raise SiddhiQLError(
+            f"expired-events output supports #window.length and "
+            f"#window.time (got #window.{window[0]})"
+        )
+    ref = inp.ref_name
+    scopes = {ref: (inp.stream_id, schemas[inp.stream_id])}
+    if ref != inp.stream_id:
+        scopes[inp.stream_id] = (inp.stream_id, schemas[inp.stream_id])
+    resolver = ExprResolver(scopes, default_scope=ref)
+    filter_fns = []
+    for f in inp.filters:
+        ce = compile_expr(f, resolver, extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError("stream filter must be boolean")
+        filter_fns.append(ce.fn)
+    items = q.selector.items
+    schema = schemas[inp.stream_id]
+    if q.selector.is_star:
+        items = tuple(
+            ast.SelectItem(ast.Attr(n), None) for n in schema.field_names
+        )
+    proj_fns: List = []
+    out_fields: List[OutputField] = []
+    ref_keys: List[str] = []
+    ref_dtypes: Dict[str, object] = {}
+    for item in items:
+        ce = compile_expr(item.expr, resolver, extensions)
+        proj_fns.append(ce.fn)
+        out_fields.append(
+            OutputField(item.output_name(), ce.atype, ce.table)
+        )
+        for a in ast.iter_attrs(item.expr):
+            r = resolver.resolve(a)
+            if r.key not in ref_keys:
+                ref_keys.append(r.key)
+                ref_dtypes[r.key] = r.atype.device_dtype
+    mode, arg = window
+    cap = arg if mode == "length" else config.time_window_capacity
+    art = ExpiredWindowArtifact(
+        name=name,
+        output_schema=OutputSchema(q.output_stream, tuple(out_fields)),
+        output_mode="buffered",
+        stream_code=stream_codes[inp.stream_id],
+        filter_fns=filter_fns,
+        window_mode=mode,
+        capacity=int(cap),
+        time_ms=arg if mode == "time" else None,
+        proj_fns=proj_fns,
+        ref_keys=ref_keys,
+        ref_dtypes=ref_dtypes,
+    )
+    art.encoded_columns = ()
+    return art
+
+
+# --------------------------------------------------------------------------
+# Per-key sliding windows: `partition with (k of S) begin ...#window.length`
+# --------------------------------------------------------------------------
+
+@dataclass
+class PerKeyWindowArtifact:
+    """``partition with (k of S) ... #window.length(C)``: EVERY key has
+    its own window of its own last C matching events (Siddhi partition
+    semantics — NOT a group-by over one shared window; the round-3
+    verdict's canonical partition carve-out).
+
+    TPU shape: per-key windows are per-group LOCAL prefix differences —
+    windowed_g(n) = S_g(n) - S_g(n - C) where S_g is the key's running
+    (Neumaier-compensated) sum and n its local arrival ordinal. State is
+    a [G] running-total table plus a [G, C] ring of the last C prefix
+    CHECKPOINTS per key; a batch needs one group-sort, segmented scans,
+    and two gathers — no per-event work, no window matrix."""
+
+    name: str
+    output_schema: OutputSchema
+    stream_code: int
+    filter_fns: List
+    capacity: int  # C: per-key window length
+    aggs: List[_Agg]
+    arg_fns: List[Callable]
+    arg_types: List[AttributeType]
+    code_key: str
+    encoder: GroupEncoder
+    proj_fns: List
+    having_fn: Optional[Callable]
+    output_mode: str = "aligned"
+
+    def _stats(self) -> Dict[int, set]:
+        return _acc_stats_for(self.aggs)
+
+    def _G(self) -> int:
+        return _bucket(len(self.encoder), MIN_GROUP_CAPACITY)
+
+    def init_state(self) -> Dict:
+        G, C = self._G(), self.capacity
+        st = {
+            "enabled": jnp.asarray(True),
+            "cnt": jnp.zeros(G, jnp.int32),  # arrivals ever, per key
+        }
+        for arg_idx, stats in self._stats().items():
+            for s in stats:
+                if s not in ("sum", "sumsq"):
+                    raise SiddhiQLError(
+                        "per-partition windows support sum/count/avg/"
+                        "stddev aggregates (min/max need the window "
+                        "matrix; group by outside the partition instead)"
+                    )
+                st[f"S_{s}{arg_idx}"] = jnp.zeros(G, jnp.float32)
+                st[f"kc_{s}{arg_idx}"] = jnp.zeros(G, jnp.float32)
+                st[f"ring_{s}{arg_idx}"] = jnp.zeros(
+                    (G, C), jnp.float32
+                )
+        return st
+
+    def grow_state(self, state: Dict) -> Dict:
+        G = state["cnt"].shape[0]
+        need = self._G()
+        if need <= G:
+            return state
+        out = {"enabled": state["enabled"]}
+        for k, v in state.items():
+            if k == "enabled":
+                continue
+            pad_shape = (need - G,) + v.shape[1:]
+            out[k] = jnp.concatenate(
+                [v, jnp.zeros(pad_shape, v.dtype)]
+            )
+        return out
+
+    def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
+        env: ColumnEnv = dict(tape.cols)
+        mask = tape.valid & (tape.stream == self.stream_code)
+        for f in self.filter_fns:
+            mask = mask & f(env)
+        mask = mask & state["enabled"]
+        E = tape.capacity
+        C = self.capacity
+        G = state["cnt"].shape[0]
+
+        g = env[self.code_key].astype(jnp.int32)
+        segkey = jnp.where(mask, g, G)
+        order = jnp.argsort(segkey)  # stable: groups contiguous
+        inv = jnp.argsort(order)
+        g_s = segkey[order]
+        flags = jnp.concatenate(
+            [jnp.ones(1, bool), g_s[1:] != g_s[:-1]]
+        )
+        gather_g = jnp.clip(g_s, 0, G - 1)
+        mask_s = mask[order]
+
+        ones = jnp.ones(E, jnp.int32)
+        seg_rank = _seg_scan(flags, ones, jnp.add) - 1  # 0-based local
+        local_n = state["cnt"][gather_g] + seg_rank  # per-key ordinal
+        pos = jnp.arange(E, dtype=jnp.int32)
+
+        new_state = dict(state)
+        seg_tot = jax.ops.segment_sum(
+            mask.astype(jnp.int32), segkey, num_segments=G + 1
+        )[:G]
+        new_state["cnt"] = state["cnt"] + seg_tot
+
+        # windowed count has a closed form: min(local_n + 1, C)
+        stats_env: Dict[str, jnp.ndarray] = {
+            "cnt": jnp.minimum(local_n + 1, C)[inv]
+        }
+
+        for arg_idx, stats in self._stats().items():
+            v = self.arg_fns[arg_idx](env)
+            v = jnp.broadcast_to(jnp.asarray(v), (E,)).astype(
+                jnp.float32
+            )
+            v_s = jnp.where(mask_s, v[order], 0.0)
+            for s in stats:
+                if s == "sumsq":
+                    vals = v_s * v_s
+                else:
+                    vals = v_s
+                Skey, kckey, rkey = (
+                    f"S_{s}{arg_idx}", f"kc_{s}{arg_idx}",
+                    f"ring_{s}{arg_idx}",
+                )
+                base = state[Skey] + state[kckey]
+                p_scan, c_scan = _seg_scan_sum_kahan(flags, vals)
+                pref = p_scan + c_scan
+                S_at = base[gather_g] + pref  # S_g(local_n), inclusive
+                # S_g(local_n - C): inside this batch's segment when
+                # seg_rank >= C, else the ring checkpoint, else 0
+                in_batch = seg_rank >= C
+                prev_batch = pref[jnp.clip(pos - C, 0)] + base[gather_g]
+                ring = state[rkey]
+                slot = jnp.clip(local_n - C, 0) % C
+                prev_ring = ring[gather_g, slot]
+                S_prev = jnp.where(
+                    in_batch,
+                    prev_batch,
+                    jnp.where(local_n >= C, prev_ring, 0.0),
+                )
+                stats_env[f"{s}{arg_idx}"] = (S_at - S_prev)[inv]
+                # ring update: each key's LAST min(C, seg_len) arrivals
+                # checkpoint S(n) into slot n mod C (distinct slots)
+                seg_len = jax.ops.segment_sum(
+                    mask_s.astype(jnp.int32),
+                    jnp.where(mask_s, gather_g, G),
+                    num_segments=G + 1,
+                )[:G]
+                is_tail = mask_s & (
+                    seg_rank >= seg_len[gather_g] - C
+                )
+                wslot = local_n % C
+                flat = ring.reshape(G * C)
+                widx = jnp.where(
+                    is_tail, gather_g * C + wslot, G * C
+                )
+                flat = flat.at[widx].set(S_at, mode="drop")
+                new_state[rkey] = flat.reshape(G, C)
+                # carry totals forward (two-sum)
+                tot_ends = jnp.concatenate(
+                    [flags[1:], jnp.ones(1, bool)]
+                )
+                gi = jnp.where(tot_ends & (g_s < G), g_s, G)
+                tot = jnp.zeros(G + 1, jnp.float32).at[gi].add(
+                    jnp.where(tot_ends, p_scan, 0.0), mode="drop"
+                )[:G]
+                tot_c = jnp.zeros(G + 1, jnp.float32).at[gi].add(
+                    jnp.where(tot_ends, c_scan, 0.0), mode="drop"
+                )[:G]
+                acc = state[Skey]
+                t = acc + tot
+                err = jnp.where(
+                    jnp.abs(acc) >= jnp.abs(tot),
+                    (acc - t) + tot,
+                    (tot - t) + acc,
+                )
+                new_state[Skey] = t
+                new_state[kckey] = state[kckey] + err + tot_c
+
+        for agg in self.aggs:
+            env[agg.slot] = _agg_from_stats(agg, stats_env).astype(
+                agg.out_type.device_dtype
+            )
+        cols = tuple(
+            jnp.broadcast_to(jnp.asarray(p(env)), (E,))
+            for p in self.proj_fns
+        )
+        out_mask = mask
+        if self.having_fn is not None:
+            henv = dict(env)
+            for f, c in zip(self.output_schema.fields, cols):
+                henv[f"@out:{f.name}"] = c
+            out_mask = out_mask & self.having_fn(henv)
+        return new_state, (out_mask, tape.ts, cols)

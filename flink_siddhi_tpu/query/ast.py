@@ -247,6 +247,10 @@ class Query:
     # `partition with (attr of Stream, ...) begin ... end`: per-key
     # isolated execution — (stream_id -> key attribute) for this query
     partition_with: Tuple[Tuple[str, str], ...] = ()
+    # output event category: 'current' (default) | 'expired' | 'all' —
+    # ``insert expired events into O`` emits events as they LEAVE the
+    # window, not as they arrive
+    output_events: str = "current"
 
     def input_stream_ids(self) -> Tuple[str, ...]:
         inp = self.input

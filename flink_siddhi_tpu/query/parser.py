@@ -158,8 +158,11 @@ def _parse_query(ts: TokenStream, name: Optional[str] = None) -> ast.Query:
     ts.expect_keyword("from")
     input_clause = _parse_input(ts)
     selector = _parse_selector(ts)
-    action, out, on = _parse_output(ts)
-    return ast.Query(input_clause, selector, out, action, name, on)
+    action, out, on, events = _parse_output(ts)
+    return ast.Query(
+        input_clause, selector, out, action, name, on,
+        output_events=events,
+    )
 
 
 def _parse_partition(
@@ -492,11 +495,13 @@ def _parse_select_item(ts: TokenStream) -> ast.SelectItem:
     return ast.SelectItem(expr, alias)
 
 
-def _parse_output(ts: TokenStream) -> Tuple[str, str]:
+def _parse_output(ts: TokenStream) -> Tuple[str, str, object, str]:
+    events = "current"
     if ts.accept_keyword("insert"):
         action = "insert"
-        # optional output event category: current | expired | all [events]
+        # output event category: current | expired | all [events]
         if ts.at_keyword("current", "expired", "all"):
+            events = ts.current.text.lower()
             ts.advance()
             ts.accept_keyword("events")
         ts.expect_keyword("into")
@@ -513,7 +518,7 @@ def _parse_output(ts: TokenStream) -> Tuple[str, str]:
     on = None
     if action in ("update", "delete") and ts.accept_keyword("on"):
         on = _parse_expr(ts)
-    return action, target, on
+    return action, target, on, events
 
 
 # --------------------------------------------------------------------------

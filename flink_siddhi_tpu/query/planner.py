@@ -136,6 +136,16 @@ def infer_stream_partitions(
         inp = q.input
         group_keys = q.selector.group_by
         if isinstance(inp, ast.StreamInput):
+            if q.partition_with:
+                # `partition with (key of S)`: per-key state (windows,
+                # aggregates) — every key's events owned by one shard
+                attr = dict(q.partition_with).get(inp.stream_id)
+                if attr is not None:
+                    put(
+                        inp.stream_id,
+                        StreamPartition("groupby", (attr,)),
+                    )
+                    continue
             if group_keys:
                 # group-by forces key partitioning (the reference requires
                 # windows+groupBy, findStreamPartition :194-210; here

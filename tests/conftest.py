@@ -12,7 +12,14 @@ initializes (``jax.backends()`` would otherwise try to init them all).
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# TPU smoke lane (`FST_TPU_SMOKE=1 python -m pytest -m tpu tests/`):
+# keep the real accelerator backend alive instead of pinning CPU —
+# the only configuration under which the real chip runs result-asserting
+# tests (bench.py asserts nothing; round-3 verdict item 8)
+_TPU_SMOKE = os.environ.get("FST_TPU_SMOKE") == "1"
+
+if not _TPU_SMOKE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -22,10 +29,31 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 from jax._src import xla_bridge as _xb  # noqa: E402
 
-# jax may already be imported (an interpreter-startup hook importing it
-# captures JAX_PLATFORMS before this file runs), so set the config directly.
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_SMOKE:
+    # jax may already be imported (an interpreter-startup hook importing
+    # it captures JAX_PLATFORMS before this file runs), so set the
+    # config directly.
+    jax.config.update("jax_platforms", "cpu")
 
-for _name in list(_xb._backend_factories):
-    if _name != "cpu":
-        del _xb._backend_factories[_name]
+    for _name in list(_xb._backend_factories):
+        if _name != "cpu":
+            del _xb._backend_factories[_name]
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+
+    if _TPU_SMOKE:
+        # the smoke lane runs ONLY tpu-marked tests (everything else
+        # assumes the CPU mesh)
+        skip = _pytest.mark.skip(reason="non-tpu test in TPU smoke lane")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+    else:
+        skip = _pytest.mark.skip(
+            reason="TPU smoke test (FST_TPU_SMOKE=1 -m tpu to run)"
+        )
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip)

@@ -1,0 +1,153 @@
+"""``insert expired events into``: events emitted as they LEAVE a
+window (round-3 verdict item: this used to parse and silently run with
+current-event semantics — a silent wrong answer).
+
+Reference semantics: any CQL accepted by siddhi-core's validateSiddhiApp
+runs with its window's expired-event chunk
+(core/.../operator/AbstractSiddhiOperator.java:301-313).
+"""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.query.lexer import SiddhiQLError
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.sources import BatchSource
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+SCHEMA = StreamSchema(
+    [("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+     ("timestamp", AttributeType.LONG)]
+)
+
+
+def run(cql, ids, prices=None, ts=None, batch=4):
+    n = len(ids)
+    prices = prices if prices is not None else [float(i) for i in range(n)]
+    ts = ts if ts is not None else [1000 + i for i in range(n)]
+    batches = [
+        EventBatch(
+            "S", SCHEMA,
+            {
+                "id": np.asarray(ids[s:s + batch], np.int32),
+                "price": np.asarray(prices[s:s + batch], np.float64),
+                "timestamp": np.asarray(ts[s:s + batch], np.int64),
+            },
+            np.asarray(ts[s:s + batch], np.int64),
+        )
+        for s in range(0, n, batch)
+    ]
+    plan = compile_plan(cql, {"S": SCHEMA})
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return job
+
+
+def test_length_window_expired_oracle():
+    # window.length(2): event i expires when event i+2 arrives
+    cql = (
+        "from S#window.length(2) select id, price "
+        "insert expired events into ex"
+    )
+    job = run(cql, ids=list(range(6)))
+    rows = job.results_with_ts("ex")
+    # events 0..3 expire (displaced by 2..5); 4,5 still in the window
+    assert [r for _, r in rows] == [
+        (0, 0.0), (1, 1.0), (2, 2.0), (3, 3.0)
+    ]
+    # expiry ts = the displacing event's ts
+    assert [t for t, _ in rows] == [1002, 1003, 1004, 1005]
+
+
+def test_length_window_expired_across_batches():
+    cql = (
+        "from S#window.length(3) select id insert expired events into ex"
+    )
+    job = run(cql, ids=list(range(10)), batch=2)
+    assert [r[0] for r in job.results("ex")] == list(range(7))
+
+
+def test_length_window_expired_with_filter():
+    # only matching events enter (and therefore leave) the window
+    cql = (
+        "from S[id % 2 == 0]#window.length(2) select id "
+        "insert expired events into ex"
+    )
+    job = run(cql, ids=list(range(10)))
+    assert [r[0] for r in job.results("ex")] == [0, 2, 4]
+
+
+def test_time_window_expired_oracle():
+    cql = (
+        "from S#window.time(10 ms) select id "
+        "insert expired events into ex"
+    )
+    ts = [1000, 1002, 1004, 1030, 1032]
+    job = run(cql, ids=[0, 1, 2, 3, 4], ts=ts, batch=5)
+    rows = job.results_with_ts("ex")
+    # 0,1,2 expired when stream time reached 1030; 3,4 flush at stream
+    # end (time advances past every deadline)
+    assert [r[0] for _, r in rows] == [0, 1, 2, 3, 4]
+    assert [t for t, _ in rows] == [1010, 1012, 1014, 1040, 1042]
+
+
+def test_current_events_unchanged():
+    cql = "from S#window.length(2) select id insert current events into c"
+    job = run(cql, ids=[7, 8, 9])
+    assert [r[0] for r in job.results("c")] == [7, 8, 9]
+
+
+def test_expired_rejects_aggregates_loudly():
+    with pytest.raises(SiddhiQLError):
+        compile_plan(
+            "from S#window.length(2) select sum(price) as s "
+            "insert expired events into ex",
+            {"S": SCHEMA},
+        )
+
+
+def test_expired_rejects_windowless_loudly():
+    with pytest.raises(SiddhiQLError):
+        compile_plan(
+            "from S select id insert expired events into ex",
+            {"S": SCHEMA},
+        )
+
+
+def test_all_events_rejects_loudly():
+    with pytest.raises(SiddhiQLError):
+        compile_plan(
+            "from S#window.length(2) select id insert all events into o",
+            {"S": SCHEMA},
+        )
+
+
+def test_time_window_expired_cross_batch_straggler():
+    # review finding: a straggler (older ts after newer ones, processing
+    # time) must not desync the emit/retain split — it conservatively
+    # expires late, and every event still expires exactly once
+    cql = (
+        "from S#window.time(10 ms) select id "
+        "insert expired events into ex"
+    )
+    ts = [1004, 1012, 1003, 1025, 1040]
+    job = run(cql, ids=[0, 1, 2, 3, 4], ts=ts, batch=2)
+    rows = job.results_with_ts("ex")
+    ids_out = sorted(r[0] for _, r in rows)
+    assert ids_out == [0, 1, 2, 3, 4]  # exactly once each
+
+
+def test_partitioned_expired_rejected_loudly():
+    with pytest.raises(SiddhiQLError):
+        compile_plan(
+            "partition with (id of S) begin "
+            "from S#window.length(2) select id "
+            "insert expired events into ex end",
+            {"S": SCHEMA},
+        )

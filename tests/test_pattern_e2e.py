@@ -394,9 +394,6 @@ def test_absence_validation_errors():
         # absence cannot lead
         "from not S[id == 2] -> s1 = S[id == 1] "
         "select s1.id as a insert into o",
-        # absence is pattern-only (no sequences)
-        "from every s1 = S[id == 1], not S[id == 2], s3 = S[id == 3] "
-        "select s1.id as a insert into o",
         # absent elements cannot be quantified
         "from every s1 = S[id == 1] -> not S[id == 2]+ -> "
         "s3 = S[id == 3] select s1.id as a insert into o",

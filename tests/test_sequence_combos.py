@@ -1,0 +1,162 @@
+"""Round-4: 'and'/'or' groups and absence inside SEQUENCES (strict
+contiguity). Reference: siddhi-core sequence processing
+(README.md:77-96); round-3 verdict item 10."""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.query.lexer import SiddhiQLError
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.sources import BatchSource
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+SCHEMA = StreamSchema(
+    [("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+     ("timestamp", AttributeType.LONG)]
+)
+
+
+def run(cql, ids, batch=8):
+    n = len(ids)
+    prices = [float(i) for i in range(n)]
+    ts = [1000 + i for i in range(n)]
+    batches = [
+        EventBatch(
+            "S", SCHEMA,
+            {
+                "id": np.asarray(ids[s:s + batch], np.int32),
+                "price": np.asarray(prices[s:s + batch], np.float64),
+                "timestamp": np.asarray(ts[s:s + batch], np.int64),
+            },
+            np.asarray(ts[s:s + batch], np.int64),
+        )
+        for s in range(0, n, batch)
+    ]
+    plan = compile_plan(cql, {"S": SCHEMA})
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return job
+
+
+def test_sequence_and_group_oracle():
+    # s1 = A and s2 = B (any order, two consecutive events), then C
+    cql = (
+        "from every s1 = S[id == 1] and s2 = S[id == 2], s3 = S[id == 3] "
+        "select s1.timestamp as t1, s2.timestamp as t2, "
+        "s3.timestamp as t3 insert into m"
+    )
+    #      0  1  2  3  4  5  6  7  8  9 10 11
+    ids = [1, 2, 3, 2, 1, 3, 1, 2, 9, 3, 1, 3]
+    job = run(cql, ids)
+    rows = job.results("m")
+    # matches: (1@0, 2@1, 3@2) both orders ok: (2@3, 1@4, 3@5);
+    # (1@6, 2@7) broken by 9@8 -> no match
+    assert sorted(rows) == [
+        (1000, 1001, 1002), (1004, 1003, 1005),
+    ]
+
+
+def test_sequence_or_group_oracle():
+    cql = (
+        "from every s1 = S[id == 1] or s2 = S[id == 2], s3 = S[id == 3] "
+        "select s3.timestamp as t3 insert into m"
+    )
+    ids = [1, 3, 9, 2, 3, 1, 9, 3]
+    job = run(cql, ids)
+    # 1@0,3@1 match; 2@3,3@4 match; 1@5 broken by 9@6
+    assert sorted(r[0] for r in job.results("m")) == [1001, 1004]
+
+
+def test_sequence_absence_same_stream_oracle():
+    # A, not B, C over one stream: the event right after A must be C
+    # and must NOT match B's filter
+    cql = (
+        "from every s1 = S[id == 1], not S[price > 50.0], "
+        "s3 = S[id == 3] "
+        "select s1.timestamp as t1, s3.timestamp as t3 insert into m"
+    )
+    # prices are 0,1,2,... so price > 50 from index 51 on
+    ids = [0] * 100
+    for i, v in [(10, 1), (11, 3), (60, 1), (61, 3), (80, 1), (81, 9)]:
+        ids[i] = v
+    job = run(cql, ids)
+    rows = job.results("m")
+    # (1@10, 3@11): price@11 = 11 <= 50 -> match
+    # (1@60, 3@61): price@61 = 61 > 50 -> guard kills it
+    # (1@80, 9@81): contiguity broken
+    assert rows == [(1010, 1011)]
+
+
+def test_sequence_absence_different_stream_is_vacuous():
+    # a different-stream 'not' between strict steps can never fire:
+    # any T event in between would break the sequence by itself
+    t_schema = StreamSchema(
+        [("k", AttributeType.INT), ("timestamp", AttributeType.LONG)]
+    )
+    cql = (
+        "from every s1 = S[id == 1], not T[k == 7], s3 = S[id == 3] "
+        "select s1.timestamp as t1, s3.timestamp as t3 insert into m"
+    )
+    plan = compile_plan(cql, {"S": SCHEMA, "T": t_schema})
+    n = 6
+    ids = [1, 3, 1, 9, 1, 3]
+    batches = [
+        EventBatch(
+            "S", SCHEMA,
+            {
+                "id": np.asarray(ids, np.int32),
+                "price": np.zeros(n, np.float64),
+                "timestamp": 1000 + np.arange(n, dtype=np.int64),
+            },
+            1000 + np.arange(n, dtype=np.int64),
+        )
+    ]
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(batches))],
+        batch_size=8, time_mode="processing",
+    )
+    job.run()
+    assert sorted(job.results("m")) == [(1000, 1001), (1004, 1005)]
+
+
+def test_sequence_absence_terminal_rejected():
+    with pytest.raises(SiddhiQLError):
+        compile_plan(
+            "from every s1 = S[id == 1], not S[id == 2] "
+            "select s1.timestamp as t1 insert into m",
+            {"S": SCHEMA},
+        )
+
+
+def test_sequence_unfiltered_same_stream_absence_rejected():
+    with pytest.raises(SiddhiQLError):
+        compile_plan(
+            "from every s1 = S[id == 1], not S, s3 = S[id == 3] "
+            "select s1.timestamp as t1 insert into m",
+            {"S": SCHEMA},
+        )
+
+
+def test_sequence_chained_absences_guard_all():
+    # review finding: 'A, not B1, not B2, C' must apply BOTH guards to
+    # the next concrete element (folding one absent filter into another
+    # absent element would negate it twice)
+    cql = (
+        "from every s1 = S[id == 1], not S[price > 50.0], "
+        "not S[price < 10.0], s3 = S[id == 3] "
+        "select s1.timestamp as t1, s3.timestamp as t3 insert into m"
+    )
+    # price = index; id pattern: 1 at i, 3 at i+1
+    ids = [0] * 100
+    for i, v in [(20, 1), (21, 3),   # price 21: 10<=21<=50 -> match
+                 (60, 1), (61, 3),   # price 61 > 50 -> killed by guard 1
+                 (5, 1), (6, 3)]:    # price 6 < 10 -> killed by guard 2
+        ids[i] = v
+    job = run(cql, ids)
+    assert job.results("m") == [(1020, 1021)]

@@ -1,0 +1,191 @@
+"""Real-TPU smoke lane: result-ASSERTING runs on the actual chip.
+
+Everything else in tests/ runs on the virtual CPU mesh, and bench.py
+(the only other thing that touches the real device) asserts nothing —
+so f32/Pallas-lowering divergence on hardware would go unseen (round-3
+verdict item 8). This 5-minute lane runs the headline pattern, a
+sliding window aggregation, and a join at small N against the same
+Python oracles the CPU tests use, with Pallas COMPILED (not
+interpreted).
+
+Invocation (one TPU client at a time — see .claude/skills/verify):
+
+    FST_TPU_SMOKE=1 timeout 600 python -m pytest -m tpu tests/ -q
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+from flink_siddhi_tpu.compiler.config import EngineConfig  # noqa: E402
+from flink_siddhi_tpu.compiler.plan import compile_plan  # noqa: E402
+from flink_siddhi_tpu.runtime.executor import Job  # noqa: E402
+from flink_siddhi_tpu.runtime.sources import BatchSource  # noqa: E402
+from flink_siddhi_tpu.schema.batch import EventBatch  # noqa: E402
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema  # noqa: E402
+from flink_siddhi_tpu.schema.types import AttributeType  # noqa: E402
+
+SCHEMA = StreamSchema(
+    [("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+     ("timestamp", AttributeType.LONG)]
+)
+
+
+@pytest.fixture(scope="module")
+def on_tpu():
+    import jax
+
+    devs = jax.devices()
+    if not devs or devs[0].platform in ("cpu",):
+        pytest.skip("no accelerator visible")
+    return devs[0]
+
+
+def _batches(n, batch, seed=7, n_ids=6):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, n_ids, n).astype(np.int32)
+    prices = np.round(rng.random(n) * 100, 3)
+    ts = (1000 + np.arange(n)).astype(np.int64)
+    return ids, prices, ts, [
+        EventBatch(
+            "S", SCHEMA,
+            {"id": ids[s:s + batch], "price": prices[s:s + batch],
+             "timestamp": ts[s:s + batch]},
+            ts[s:s + batch],
+        )
+        for s in range(0, n, batch)
+    ]
+
+
+def _run(cql, batches, batch, config=None):
+    plan = compile_plan(cql, {"S": SCHEMA}, config=config)
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return job
+
+
+def test_headline_pattern_matches_oracle_on_device(on_tpu):
+    ids, prices, ts, batches = _batches(4096, 1024)
+    cql = (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] -> "
+        "s3 = S[id == 3] within 5 sec "
+        "select s1.timestamp as t1, s3.timestamp as t3, "
+        "s3.price as price insert into m"
+    )
+    job = _run(
+        cql, batches, 1024,
+        EngineConfig(lazy_projection=True, pred_pushdown=True),
+    )
+    rows = sorted(job.results("m"))
+    # per-event oracle (the JVM engine's partial-match walk)
+    partials, exp = [], []
+    for i in range(len(ids)):
+        nxt = []
+        for step, t1, _caps in partials:
+            if ts[i] - t1 > 5000:
+                continue
+            want = (2, 3)[step - 1]
+            if ids[i] == want:
+                if step == 2:
+                    exp.append((int(t1), int(ts[i]), float(prices[i])))
+                    continue
+                nxt.append((step + 1, t1, None))
+            else:
+                nxt.append((step, t1, _caps))
+        partials = nxt
+        if ids[i] == 1:
+            partials.append((1, ts[i], None))
+    exp.sort()
+    assert len(rows) == len(exp) > 0
+    for (t1, t3, p), (et1, et3, ep) in zip(rows, exp):
+        assert (t1, t3) == (et1, et3)
+        assert p == pytest.approx(ep, rel=1e-6)
+
+
+def test_window_groupby_matches_oracle_on_device(on_tpu):
+    ids, prices, ts, batches = _batches(3000, 1024)
+    cql = (
+        "from S#window.length(100) select id, sum(price) as s, "
+        "count() as c group by id insert into o"
+    )
+    job = _run(cql, batches, 1024)
+    rows = job.results("o")
+    hist = []
+    exp = []
+    for i in range(len(ids)):
+        hist.append((int(ids[i]), float(prices[i])))
+        win = hist[-100:]
+        mine = [p for k, p in win if k == ids[i]]
+        exp.append((int(ids[i]), sum(mine), len(mine)))
+    assert len(rows) == len(exp)
+    for (k, s, c), (ek, es, ec) in zip(rows, exp):
+        assert (k, c) == (ek, ec)
+        assert s == pytest.approx(es, rel=1e-4)
+
+
+def test_join_matches_oracle_on_device(on_tpu):
+    t_schema = StreamSchema(
+        [("id", AttributeType.INT), ("qty", AttributeType.INT),
+         ("timestamp", AttributeType.LONG)]
+    )
+    rng = np.random.default_rng(5)
+    n = 512
+    ids_s = rng.integers(0, 4, n).astype(np.int32)
+    prices = np.round(rng.random(n) * 10, 2)
+    ts_s = (1000 + 2 * np.arange(n)).astype(np.int64)
+    ids_t = rng.integers(0, 4, n).astype(np.int32)
+    qty = rng.integers(1, 9, n).astype(np.int32)
+    ts_t = (1001 + 2 * np.arange(n)).astype(np.int64)
+    sb = [EventBatch("S", SCHEMA,
+                     {"id": ids_s, "price": prices, "timestamp": ts_s},
+                     ts_s)]
+    tb = [EventBatch("T", t_schema,
+                     {"id": ids_t, "qty": qty, "timestamp": ts_t},
+                     ts_t)]
+    cql = (
+        "from S#window.length(8) join T#window.length(8) "
+        "on S.id == T.id "
+        "select S.timestamp as st, T.timestamp as tt insert into j"
+    )
+    plan = compile_plan(cql, {"S": SCHEMA, "T": t_schema})
+    job = Job(
+        [plan],
+        [BatchSource("S", SCHEMA, iter(sb)),
+         BatchSource("T", t_schema, iter(tb))],
+        batch_size=2048, time_mode="processing",
+    )
+    job.run()
+    got = sorted(job.results("j"))
+    # oracle: merged arrival order; each arrival pairs against the
+    # other side's last-8 ring
+    events = sorted(
+        [(int(t), "S", int(i)) for t, i in zip(ts_s, ids_s)]
+        + [(int(t), "T", int(i)) for t, i in zip(ts_t, ids_t)]
+    )
+    ring = {"S": [], "T": []}
+    exp = []
+    for t, side, k in events:
+        other = "T" if side == "S" else "S"
+        for (ot, ok) in ring[other][-8:]:
+            if ok == k:
+                exp.append((t, ot) if side == "S" else (ot, t))
+        ring[side].append((t, k))
+    exp.sort()
+    assert got == exp and len(got) > 0
+
+
+def test_pallas_compiled_not_interpreted(on_tpu):
+    # the chain core's Pallas reverse-cummin must COMPILE on hardware
+    # (warmup returns False when the kernel fell back to XLA)
+    import os
+
+    from flink_siddhi_tpu.compiler import pallas_ops
+
+    assert not os.environ.get("FST_PALLAS_INTERPRET")
+    assert pallas_ops.warmup(), (
+        "Pallas kernel unavailable on the real device (XLA fallback)"
+    )

@@ -451,3 +451,47 @@ def test_time_window_minmax_straggler_stays_exact():
     lo, hi, c = out[-1]
     assert c == 2
     assert (lo, hi) == (50.0, 100.0)
+
+
+def test_cumulative_f32_sum_compensated_drift():
+    """Round-4 verdict item 6: an unbounded cumulative sum() must not
+    silently stall once the f32 accumulator outgrows its mantissa.
+    3M events of value 1000.0 push the running sum to 3e9 (f32 grain
+    there is 256); the Neumaier-compensated accumulator stays within
+    1e-6 relative of the f64 oracle where a bare f32 sum drifts ~1e-3."""
+    import numpy as np
+
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.batch import EventBatch
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [("v", AttributeType.DOUBLE), ("timestamp", AttributeType.LONG)]
+    )
+    n, batch = 3_000_000, 262_144
+    batches = []
+    for s in range(0, n, batch):
+        m = min(batch, n - s)
+        ts = 1000 + np.arange(s, s + m, dtype=np.int64)
+        batches.append(
+            EventBatch(
+                "S", schema,
+                {"v": np.full(m, 1000.0), "timestamp": ts},
+                ts,
+            )
+        )
+    plan = compile_plan(
+        "from S select sum(v) as total insert into o", {"S": schema}
+    )
+    job = Job(
+        [plan], [BatchSource("S", schema, iter(batches))],
+        batch_size=batch, time_mode="processing", retain_results=False,
+    )
+    last = {}
+    job.add_sink("o", lambda ts_, row: last.__setitem__("v", row[0]))
+    job.run()
+    oracle = 1000.0 * n  # exact in f64
+    assert last["v"] == pytest.approx(oracle, rel=1e-6)

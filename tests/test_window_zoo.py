@@ -1,0 +1,203 @@
+"""Round-4 window zoo: timeLength, externalTimeBatch, sort, unique —
+each against a per-event Python oracle (siddhi-core 4.2.40 window
+surface; the reference treats any window generically,
+SiddhiExecutionPlanner.java:194-210)."""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.query.lexer import SiddhiQLError
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.sources import BatchSource
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+SCHEMA = StreamSchema(
+    [("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+     ("timestamp", AttributeType.LONG)]
+)
+
+
+def run(cql, ids, prices, ts, batch=8):
+    n = len(ids)
+    batches = [
+        EventBatch(
+            "S", SCHEMA,
+            {
+                "id": np.asarray(ids[s:s + batch], np.int32),
+                "price": np.asarray(prices[s:s + batch], np.float64),
+                "timestamp": np.asarray(ts[s:s + batch], np.int64),
+            },
+            np.asarray(ts[s:s + batch], np.int64),
+        )
+        for s in range(0, n, batch)
+    ]
+    plan = compile_plan(cql, {"S": SCHEMA})
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return job
+
+
+def make(n=60, seed=3, span=40):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, 5, n).tolist()
+    prices = np.round(rng.random(n) * 100, 2).tolist()
+    ts = (1000 + np.cumsum(rng.integers(1, 9, n))).tolist()
+    return ids, prices, ts
+
+
+def test_timelength_window_oracle():
+    ids, prices, ts = make()
+    cql = (
+        "from S#window.timeLength(20 ms, 5) "
+        "select sum(price) as s, count() as c insert into o"
+    )
+    job = run(cql, ids, prices, ts)
+    rows = job.results("o")
+    # oracle: member iff within last 5 events AND ts > cur - 20
+    exp = []
+    hist = []
+    for i in range(len(ids)):
+        hist.append((ts[i], prices[i]))
+        win = [p for t, p in hist[-5:] if t > ts[i] - 20]
+        exp.append((sum(win), len(win)))
+    assert len(rows) == len(exp)
+    for (s, c), (es, ec) in zip(rows, exp):
+        assert c == ec
+        assert s == pytest.approx(es, rel=1e-4)
+
+
+def test_external_time_batch_oracle():
+    # external timestamps drive the tumbling boundary, not event time
+    ids, prices, _ = make(40)
+    ext = (5000 + np.cumsum(np.random.default_rng(9).integers(1, 15, 40)))
+    ts = (1000 + np.arange(40)).tolist()  # event time: dense
+    schema = SCHEMA
+    cql = (
+        "from S#window.externalTimeBatch(timestamp, 30 ms) "
+        "select sum(price) as s, count() as c insert into o"
+    )
+    # feed ext values through the `timestamp` attribute
+    n = 40
+    batches = [
+        EventBatch(
+            "S", schema,
+            {
+                "id": np.asarray(ids[s:s + 8], np.int32),
+                "price": np.asarray(prices[s:s + 8], np.float64),
+                "timestamp": np.asarray(ext[s:s + 8], np.int64),
+            },
+            np.asarray(ts[s:s + 8], np.int64),
+        )
+        for s in range(0, n, 8)
+    ]
+    plan = compile_plan(cql, {"S": schema})
+    job = Job(
+        [plan], [BatchSource("S", schema, iter(batches))],
+        batch_size=8, time_mode="processing",
+    )
+    job.run()
+    rows = job.results("o")
+    # oracle: tumbling 30ms windows of the EXTERNAL ts, first event
+    # anchors t0; incomplete final window flushes at stream end
+    t0 = int(ext[0])
+    buckets = {}
+    for i in range(n):
+        b = (int(ext[i]) - t0) // 30
+        buckets.setdefault(b, []).append(prices[i])
+    exp = [
+        (sum(v), len(v)) for _, v in sorted(buckets.items())
+    ]
+    assert len(rows) == len(exp)
+    for (s, c), (es, ec) in zip(rows, exp):
+        assert c == ec
+        assert s == pytest.approx(es, rel=1e-4)
+
+
+def test_sort_window_oracle_asc():
+    ids, prices, ts = make(50)
+    cql = (
+        "from S#window.sort(3, price) "
+        "select sum(price) as s, count() as c, min(price) as mn "
+        "insert into o"
+    )
+    job = run(cql, ids, prices, ts)
+    rows = job.results("o")
+    kept = []
+    exp = []
+    for p in prices:
+        kept = sorted(kept + [p])[:3]  # asc: keep 3 smallest
+        exp.append((sum(kept), len(kept), min(kept)))
+    assert len(rows) == len(exp)
+    for (s, c, mn), (es, ec, emn) in zip(rows, exp):
+        assert c == ec
+        assert s == pytest.approx(es, rel=1e-4)
+        assert mn == pytest.approx(emn, rel=1e-4)
+
+
+def test_sort_window_oracle_desc():
+    ids, prices, ts = make(50, seed=5)
+    cql = (
+        "from S#window.sort(4, price, 'desc') "
+        "select max(price) as mx, count() as c insert into o"
+    )
+    job = run(cql, ids, prices, ts)
+    rows = job.results("o")
+    kept = []
+    exp = []
+    for p in prices:
+        kept = sorted(kept + [p], reverse=True)[:4]  # keep 4 largest
+        exp.append((max(kept), len(kept)))
+    for (mx, c), (emx, ec) in zip(rows, exp):
+        assert c == ec
+        assert mx == pytest.approx(emx, rel=1e-4)
+
+
+def test_unique_window_oracle():
+    ids, prices, ts = make(60, seed=7)
+    cql = (
+        "from S#window.unique(id) "
+        "select sum(price) as s, count() as c insert into o"
+    )
+    job = run(cql, ids, prices, ts)
+    rows = job.results("o")
+    latest = {}
+    exp = []
+    for i, p in zip(ids, prices):
+        latest[i] = p  # latest event per key replaces the old one
+        exp.append((sum(latest.values()), len(latest)))
+    assert len(rows) == len(exp)
+    for (s, c), (es, ec) in zip(rows, exp):
+        assert c == ec
+        assert s == pytest.approx(es, rel=1e-4)
+
+
+def test_unique_window_grows_past_initial_bucket():
+    n = 400
+    rng = np.random.default_rng(11)
+    ids = rng.integers(0, 300, n).tolist()  # > the 128 initial bucket
+    prices = [1.0] * n
+    ts = (1000 + np.arange(n)).tolist()
+    cql = "from S#window.unique(id) select count() as c insert into o"
+    job = run(cql, ids, prices, ts, batch=64)
+    rows = job.results("o")
+    seen = set()
+    exp = []
+    for i in ids:
+        seen.add(i)
+        exp.append(len(seen))
+    assert [r[0] for r in rows] == exp
+
+
+def test_sort_window_rejects_stddev_loudly():
+    with pytest.raises(SiddhiQLError):
+        compile_plan(
+            "from S#window.sort(3, price) select stddev(price) as s "
+            "insert into o",
+            {"S": SCHEMA},
+        )
