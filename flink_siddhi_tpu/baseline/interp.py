@@ -151,7 +151,9 @@ class _LengthWindowGroupBy:
         inp = q.input
         self.filters = [_compile_scalar(f) for f in inp.filters]
         self.cap = capacity
-        self.group_keys = list(q.selector.group_by)
+        self.group_keys = [
+            k.split(".", 1)[-1] for k in q.selector.group_by
+        ]
         self.ring: deque = deque()
         self.sums: Dict[Any, float] = {}
         self.counts: Dict[Any, int] = {}

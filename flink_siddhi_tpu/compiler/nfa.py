@@ -342,6 +342,13 @@ def _rewrite_sequence_absence(inp: ast.PatternInput) -> ast.PatternInput:
             # every guard of the run applies to THIS (the next
             # non-absent) element's event — folding one absent filter
             # into another absent element would negate it twice
+            if (el.min_count, el.max_count) != (1, 1):
+                raise SiddhiQLError(
+                    "absence before a QUANTIFIED sequence element is "
+                    "not supported (the guard applies to the first "
+                    "occurrence only, which the folded form cannot "
+                    "express)"
+                )
             nxt = el
             for ab in pending:
                 if ab.stream_id != nxt.stream_id:
@@ -378,26 +385,14 @@ def _rebind_alias(expr: ast.Expr, old: str, new: str) -> ast.Expr:
     guard evaluates against the NEXT element's event)."""
     import dataclasses
 
-    if isinstance(expr, ast.Attr):
-        if expr.qualifier == old:
-            return dataclasses.replace(expr, qualifier=new)
-        return expr
-    if isinstance(expr, ast.Unary):
-        return dataclasses.replace(
-            expr, operand=_rebind_alias(expr.operand, old, new)
-        )
-    if isinstance(expr, ast.Binary):
-        return dataclasses.replace(
-            expr,
-            left=_rebind_alias(expr.left, old, new),
-            right=_rebind_alias(expr.right, old, new),
-        )
-    if isinstance(expr, ast.Call):
-        return dataclasses.replace(
-            expr,
-            args=tuple(_rebind_alias(a, old, new) for a in expr.args),
-        )
-    return expr
+    return ast.map_expr(
+        expr,
+        lambda a: (
+            dataclasses.replace(a, qualifier=new)
+            if a.qualifier == old
+            else a
+        ),
+    )
 
 
 def _build_spec(

@@ -65,6 +65,8 @@ class CompiledPlan:
     # projection, which changes the wire format itself)
     source_text: str = ""
     extensions: object = None
+    # output rate limiting per output stream (host emission layer)
+    output_rates: Dict[str, object] = field(default_factory=dict)
     # compile-window cap: XLA compile time grows with tape width, and a
     # wide multi-query stack at a 512k tape compiles for many MINUTES.
     # When set, the executor steps oversized micro-batches in chunks of
@@ -417,6 +419,17 @@ def _synthetic_tape(out, ci: ChainedInput):
             else:
                 row = row.astype(dt)
             col_vals.append(row)
+    # producer emission buffers are in SLOT order; the consumer must see
+    # stream time (order-sensitive consumers — per-event cumulative
+    # prefixes — would otherwise accumulate in buffer order). Stable
+    # sort keeps emission order within a timestamp.
+    ts = jnp.asarray(ts).astype(jnp.int32)
+    order = jnp.argsort(
+        jnp.where(valid, ts, jnp.int32(2 ** 31 - 1)), stable=True
+    )
+    ts = ts[order]
+    valid = valid[order]
+    col_vals = [v[order] for v in col_vals]
     stream = jnp.where(
         valid, jnp.int32(ci.code), jnp.int32(-1)
     )
@@ -424,7 +437,7 @@ def _synthetic_tape(out, ci: ChainedInput):
         f"{ci.stream_id}.{f.name}": v
         for f, v in zip(ci.fields, col_vals)
     }
-    return Tape(jnp.asarray(ts), stream, valid, cols_map)
+    return Tape(ts, stream, valid, cols_map)
 
 
 def compile_plan(
@@ -476,6 +489,13 @@ def compile_plan(
     if not parsed.queries:
         raise SiddhiQLError("execution plan contains no queries")
 
+    # direct `group by` / `having` / aggregation ON a join query: legal
+    # SiddhiQL the engine serves by auto-rewriting into the chaining
+    # form it already runs — join into a synthesized intermediate
+    # stream, aggregate that (same device step, batch-granular hop)
+    parsed = _rewrite_aggregated_joins(parsed, table_schemas, all_schemas)
+    parsed = _rewrite_windowed_mutations(parsed, table_schemas)
+
     # fail fast on undefined inputs (UndefinedStreamException parity,
     # SiddhiCEP.java:134-140). A stream produced by an EARLIER query's
     # `insert into` is a valid chained input (query composition): the
@@ -522,13 +542,18 @@ def compile_plan(
     internal_codes = {
         sid: len(input_ids) + j for j, sid in enumerate(internal_ids)
     }
-    # materialize every field of every input stream (simple and correct;
-    # column pruning to referenced fields is a later optimization)
+    # materialize only fields some query REFERENCES (by field name,
+    # conservatively across streams): on a tunneled device every
+    # unreferenced column shipped is pure wire waste. ``select *``
+    # anywhere disables pruning (the set is unknowable).
+    referenced = _referenced_field_names(parsed)
     columns = []
     column_types = {}
     for sid in input_ids:
         sch = all_schemas[sid]
         for fname, ftype in zip(sch.field_names, sch.field_types):
+            if referenced is not None and fname not in referenced:
+                continue
             key = f"{sid}.{fname}"
             columns.append(key)
             column_types[key] = ftype
@@ -552,17 +577,19 @@ def compile_plan(
             isinstance(inp, ast.StreamInput)
             and inp.stream_id in internal_codes
         ):
+            new_enc = []
             for enc in getattr(art, "encoded_columns", ()):
                 if any(
                     k.split(".", 1)[0] == inp.stream_id
                     for k in enc.in_keys
                 ):
-                    raise SiddhiQLError(
-                        f"group by over chained stream {inp.stream_id!r} "
-                        "is not supported yet (group keys are interned "
-                        "host-side but intermediate values exist only on "
-                        "device); group in the upstream query instead"
+                    enc = _rewire_chained_group(
+                        art, enc, q, inp.stream_id, all_schemas,
+                        merged_codes,
                     )
+                new_enc.append(enc)
+            if new_enc:
+                art.encoded_columns = tuple(new_enc)
             producer = artifacts[producer_of[inp.stream_id]]
             if getattr(producer, "_nullable", False):
                 raise SiddhiQLError(
@@ -690,6 +717,44 @@ def compile_plan(
                 cap_limit = 131072
                 break
 
+    output_rates = {}
+    writers: Dict[str, int] = {}
+    for q in parsed.queries:
+        writers[q.output_stream] = writers.get(q.output_stream, 0) + 1
+    for q in parsed.queries:
+        r = q.output_rate
+        if r is None:
+            continue
+        if r.mode == "snapshot":
+            raise SiddhiQLError(
+                "'output snapshot every ...' is not supported yet; use "
+                "'output last every ...' for thinned emission"
+            )
+        if writers[q.output_stream] > 1:
+            # the host limiter is keyed by stream; interleaving a second
+            # writer through one query's limiter would silently throttle
+            # it (Siddhi limiters are per-query)
+            raise SiddhiQLError(
+                f"output rate limiting on {q.output_stream!r} with "
+                "multiple writer queries is not supported yet"
+            )
+        if q.output_stream in internal_codes:
+            # chained consumers read producer emissions ON DEVICE; the
+            # host emission limiter cannot thin that path — refusing
+            # beats silently computing a different answer
+            raise SiddhiQLError(
+                f"output rate limiting on chained stream "
+                f"{q.output_stream!r} is not supported (the downstream "
+                "query consumes the unthinned device emissions)"
+            )
+        if q.output_stream in table_schemas:
+            # table writes apply on device; the host limiter cannot
+            # throttle them — refuse rather than silently ignore
+            raise SiddhiQLError(
+                "output rate limiting on a table write is not supported"
+            )
+        output_rates[q.output_stream] = r
+
     return CompiledPlan(
         plan_id=plan_id,
         spec=spec,
@@ -704,6 +769,7 @@ def compile_plan(
         source_text=plan_text,
         extensions=extensions,
         tape_capacity_limit=cap_limit,
+        output_rates=output_rates,
     )
 
 
@@ -759,12 +825,15 @@ def _rewrite_partitioned(q: ast.Query, schemas) -> ast.Query:
                 # plain windowed projection emits arriving CURRENT
                 # events unchanged; partitioning changes nothing
                 return dataclasses.replace(q, partition_with=())
-            if attr not in sel.group_by:
+            bare = tuple(ast.bare_group_key(n) for n in sel.group_by)
+            if attr not in bare:
                 sel = dataclasses.replace(
                     sel, group_by=tuple(sel.group_by) + (attr,)
                 )
             return dataclasses.replace(q, selector=sel)
-        if has_agg and attr not in sel.group_by:
+        if has_agg and attr not in tuple(
+            ast.bare_group_key(n) for n in sel.group_by
+        ):
             sel = dataclasses.replace(
                 sel, group_by=tuple(sel.group_by) + (attr,)
             )
@@ -921,3 +990,293 @@ def _compile_query(
             q, name, schemas, stream_codes, extensions, config
         )
     raise SiddhiQLError(f"unsupported input clause {type(inp).__name__}")
+
+
+def _rewrite_aggregated_joins(parsed, table_schemas, all_schemas):
+    """Expand ``from A join B ... select sum(x) group by k`` into the
+    two-query chaining form: the join projects every referenced raw
+    column into a synthesized intermediate stream; the aggregation runs
+    over that stream. The reference composes multi-query plans the same
+    way (package-info.java:19-51); this makes the single-query spelling
+    — legal SiddhiQL — compile instead of raising a chaining hint."""
+    import dataclasses
+
+    out = []
+    changed = False
+    for q in parsed.queries:
+        inp = q.input
+        is_stream_join = isinstance(inp, ast.JoinInput) and not (
+            inp.left.stream_id in table_schemas
+            or inp.right.stream_id in table_schemas
+        )
+        sel = q.selector
+        has_agg = any(
+            ast.contains_aggregate(i.expr) for i in sel.items
+        ) or bool(sel.group_by) or sel.having is not None
+        if not (is_stream_join and has_agg) or q.output_action != "insert":
+            out.append(q)
+            continue
+        if sel.is_star:
+            raise SiddhiQLError(
+                "select * with aggregation over a join is ambiguous; "
+                "name the columns"
+            )
+        changed = True
+        mid = f"@j:{q.output_stream}:{len(out)}"
+        side_of = {
+            inp.left.ref_name: inp.left.stream_id,
+            inp.left.stream_id: inp.left.stream_id,
+            inp.right.ref_name: inp.right.stream_id,
+            inp.right.stream_id: inp.right.stream_id,
+        }
+        group_sources: Dict[str, str] = {}
+
+        # every raw attr the outer selector/having reads gets a flat
+        # alias on the intermediate stream
+        mangled: Dict[Tuple, str] = {}
+        join_items: List[ast.SelectItem] = []
+
+        def flat(attr: ast.Attr) -> str:
+            key = (attr.qualifier, attr.name)
+            name = mangled.get(key)
+            if name is None:
+                name = (
+                    f"{attr.qualifier}_{attr.name}"
+                    if attr.qualifier
+                    else attr.name
+                )
+                # collisions (e.g. `a_b` vs qualifier a, name b): suffix
+                while any(i.alias == name for i in join_items):
+                    name += "_"
+                mangled[key] = name
+                join_items.append(ast.SelectItem(attr, name))
+                # provenance: which SOURCE column this flat field carries
+                if attr.qualifier is not None:
+                    sid = side_of.get(attr.qualifier)
+                    if sid is not None:
+                        group_sources[name] = f"{sid}.{attr.name}"
+                else:
+                    hits = [
+                        sid
+                        for sid in (
+                            inp.left.stream_id, inp.right.stream_id
+                        )
+                        if sid in all_schemas
+                        and attr.name in all_schemas[sid]
+                    ]
+                    if len(set(hits)) == 1:
+                        group_sources[name] = f"{hits[0]}.{attr.name}"
+            return name
+
+        def _flat_attr(a: ast.Attr) -> ast.Attr:
+            if a.index is not None:
+                raise SiddhiQLError(
+                    "indexed references are not valid on join queries"
+                )
+            return ast.Attr(flat(a))
+
+        def rewrite(e: ast.Expr) -> ast.Expr:
+            return ast.map_expr(e, _flat_attr)
+
+        new_items = tuple(
+            ast.SelectItem(rewrite(i.expr), i.output_name())
+            for i in sel.items
+        )
+        out_aliases = {i.output_name() for i in sel.items}
+
+        def rewrite_having(e: ast.Expr) -> ast.Expr:
+            # having may reference SELECT aliases — those resolve
+            # downstream against the aggregation's own output slots,
+            # not against the join's raw columns
+            return ast.map_expr(
+                e,
+                lambda a: (
+                    a
+                    if a.qualifier is None and a.name in out_aliases
+                    else _flat_attr(a)
+                ),
+            )
+
+        new_having = (
+            rewrite_having(sel.having) if sel.having is not None else None
+        )
+        # group keys carry onto the intermediate stream under their
+        # flattened alias (qualified keys keep their side)
+        new_group = tuple(
+            flat(ast.split_group_key(g)) for g in sel.group_by
+        )
+
+        join_q = dataclasses.replace(
+            q,
+            selector=ast.Selector(tuple(join_items)),
+            output_stream=mid,
+            name=(f"{q.name}@join" if q.name else None),
+            output_rate=None,
+        )
+        agg_q = dataclasses.replace(
+            q,
+            input=ast.StreamInput(mid),
+            selector=ast.Selector(new_items, new_group, new_having),
+            group_sources=tuple(sorted(group_sources.items())),
+        )
+        out.extend([join_q, agg_q])
+    if not changed:
+        return parsed
+    return dataclasses.replace(parsed, queries=tuple(out))
+
+
+def _rewire_chained_group(art, enc, q, mid_sid, all_schemas, codes):
+    """Group-by over a CHAINED stream: the group values exist only on
+    device, so the host cannot build the code column. When the key's
+    SOURCE column is known (synthesized join rewrites record it) and
+    numeric, rewire: intern over the source column (intern-only, no wire
+    column) and have the artifact map values -> codes on device from
+    the synced sorted table."""
+    import dataclasses as _dc
+
+    from .window import CumulativeAggArtifact
+
+    unsupported = SiddhiQLError(
+        f"group by over chained stream {mid_sid!r} is not supported "
+        "for this query shape (group keys are interned host-side but "
+        "intermediate values exist only on device); group in the "
+        "upstream query instead"
+    )
+    sources = dict(q.group_sources)
+    if (
+        not isinstance(art, CumulativeAggArtifact)
+        or len(enc.in_keys) != 1
+    ):
+        raise unsupported
+    mid_field = enc.in_keys[0].split(".", 1)[1]
+    src_key = sources.get(mid_field)
+    if src_key is None:
+        raise unsupported
+    src_sid, src_field = src_key.split(".", 1)
+    atype = all_schemas[src_sid].field_type(src_field)
+    if not atype.is_numeric:
+        raise unsupported  # string keys: host codes, device raw — no map
+    art.chained_group_src = enc.in_keys[0]
+    art.chained_group_dtype = atype.device_dtype
+    return _dc.replace(
+        enc,
+        in_keys=(src_key,),
+        stream_code=codes[src_sid],
+        select_fn=None,  # intern the source superset
+        materialize=False,
+    )
+
+
+def _rewrite_windowed_mutations(parsed, table_schemas):
+    """``from S#window.x(...) select ... update T on ...`` (and delete):
+    siddhi-core evaluates the window chain before the table mutation.
+    Re-expressed through chaining: the windowed/aggregated selection
+    emits into a synthesized intermediate stream; a plain mutate query
+    consumes it (same device step)."""
+    import dataclasses
+
+    out = []
+    changed = False
+    for q in parsed.queries:
+        inp = q.input
+        windowed = (
+            q.output_action in ("update", "delete")
+            and q.output_stream in table_schemas
+            and isinstance(inp, ast.StreamInput)
+            and (
+                inp.windows
+                or q.selector.group_by
+                or q.selector.having is not None
+                or any(
+                    ast.contains_aggregate(i.expr)
+                    for i in q.selector.items
+                )
+            )
+        )
+        if not windowed:
+            out.append(q)
+            continue
+        changed = True
+        mid = f"@t:{q.output_stream}:{len(out)}"
+        win_q = dataclasses.replace(
+            q,
+            output_stream=mid,
+            output_action="insert",
+            on_condition=None,
+            name=(f"{q.name}@win" if q.name else None),
+            output_rate=None,  # rate-limiting applies to the MUTATION
+        )
+        # the mutate's projection carries only fields the mutation can
+        # use: table columns and on-condition references (the windowed
+        # query may also emit having-only fields like a count alias)
+        tcols = set(table_schemas[q.output_stream].field_names)
+        on_names = {
+            a.name
+            for a in ast.iter_attrs(q.on_condition)
+            if q.on_condition is not None
+        } if q.on_condition is not None else set()
+        kept = tuple(
+            ast.SelectItem(ast.Attr(i.output_name()), i.output_name())
+            for i in q.selector.items
+            if i.output_name() in tcols or i.output_name() in on_names
+        )
+        if not kept:
+            raise SiddhiQLError(
+                f"windowed {q.output_action} into {q.output_stream!r} "
+                "selects no table column or on-condition field"
+            )
+        mut_q = dataclasses.replace(
+            q,
+            input=ast.StreamInput(mid),
+            selector=ast.Selector(kept),
+        )
+        out.extend([win_q, mut_q])
+    if not changed:
+        return parsed
+    return dataclasses.replace(parsed, queries=tuple(out))
+
+
+def _referenced_field_names(parsed):
+    """Field names any query can read, or None when unknowable
+    (``select *``). Name-level (not stream-qualified) and therefore
+    conservative: a name used on ANY stream keeps that column on every
+    stream carrying it."""
+    names = set()
+
+    def add_expr(e):
+        if e is None:
+            return
+        for a in ast.iter_attrs(e):
+            names.add(a.name)
+
+    for q in parsed.queries:
+        sel = q.selector
+        if sel.is_star:
+            return None
+        for item in sel.items:
+            add_expr(item.expr)
+        for g in sel.group_by:
+            names.add(ast.bare_group_key(g))
+        add_expr(sel.having)
+        add_expr(q.on_condition)
+        for _sid, attr in q.partition_with:
+            names.add(attr)
+        for _f, src in q.group_sources:
+            names.add(src.split(".", 1)[1])
+        inp = q.input
+        sides = []
+        if isinstance(inp, ast.StreamInput):
+            sides = [inp]
+        elif isinstance(inp, ast.JoinInput):
+            sides = [inp.left, inp.right]
+            add_expr(inp.on)
+        elif isinstance(inp, ast.PatternInput):
+            for el in inp.elements:
+                add_expr(el.filter)
+        for side in sides:
+            for f in side.filters:
+                add_expr(f)
+            for w in side.windows:
+                for arg in w.args:
+                    add_expr(arg)
+    return names

@@ -564,11 +564,16 @@ def compile_table_join(
         )
     if table_outer:
         raise SiddhiQLError(
-            "outer join preserving the table side is not supported "
-            "(tables have no arrival events to emit unmatched rows on)"
+            "outer join preserving the table side is not supported: a "
+            "table has no arrival events to emit unmatched rows on "
+            "(siddhi-core likewise only emits on stream triggers)"
         )
     if sside.stream_id in table_schemas:
-        raise SiddhiQLError("table-table joins are not supported")
+        raise SiddhiQLError(
+            "table-table joins are not supported: a join needs a stream "
+            "side to trigger on (siddhi 4.x rejects two static sides "
+            "the same way)"
+        )
     if tside.windows:
         raise SiddhiQLError("windows are not valid on a table join side")
     tid = tside.stream_id

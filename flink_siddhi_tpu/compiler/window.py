@@ -1012,9 +1012,52 @@ class CumulativeAggArtifact:
     proj_fns: List
     having_fn: Optional[Callable]
     output_mode: str = "aligned"
+    # chained-input group-by: the group VALUES exist only on device (the
+    # producer's emissions), so instead of a host-built code column the
+    # device maps values -> codes through a sorted intern table synced
+    # from the (intern-only) host encoder each cycle
+    chained_group_src: Optional[str] = None
+    chained_group_dtype: object = None
 
     def _stats(self) -> Dict[int, set]:
         return _acc_stats_for(self.aggs)
+
+    def _chained_tables(self, G: int):
+        """(sorted values, codes) arrays for the device value->code map.
+        Cached on (encoder size, G): grow_state calls this every cycle
+        and the rebuild is O(G) host work + two uploads."""
+        cached = getattr(self, "_ct_cache", None)
+        if cached is not None and cached[0] == (len(self.encoder), G):
+            # fresh device buffers each call: the jitted step DONATES
+            # its state inputs, so a cached jax array would be a deleted
+            # buffer by the second micro-batch
+            return jnp.asarray(cached[1]), jnp.asarray(cached[2])
+        vals = np.asarray(
+            [self.encoder.value(i)[0] for i in range(len(self.encoder))],
+            dtype=self.chained_group_dtype,
+        )
+        order = np.argsort(vals, kind="stable")
+        gv = np.full(G, np.inf if np.issubdtype(
+            np.dtype(self.chained_group_dtype), np.floating
+        ) else np.iinfo(np.dtype(self.chained_group_dtype)).max,
+            dtype=self.chained_group_dtype)
+        gc = np.zeros(G, np.int32)
+        gv[: len(vals)] = vals[order]
+        gc[: len(vals)] = order.astype(np.int32)
+        self._ct_cache = ((len(self.encoder), G), gv, gc)
+        return jnp.asarray(gv), jnp.asarray(gc)
+
+    def _group_codes(self, env, state):
+        """Group code per tape position: the host-built code column, or
+        the on-device sorted-table lookup for chained inputs."""
+        if self.chained_group_src is None:
+            return env[self.code_key].astype(jnp.int32)
+        vals = env[self.chained_group_src].astype(state["@gv"].dtype)
+        pos = jnp.clip(
+            jnp.searchsorted(state["@gv"], vals, side="left"),
+            0, state["@gv"].shape[0] - 1,
+        )
+        return state["@gc"][pos]
 
     def init_state(self) -> Dict:
         G = (
@@ -1023,6 +1066,8 @@ class CumulativeAggArtifact:
             else 1
         )
         st = {"enabled": jnp.asarray(True), "cnt": jnp.zeros(G, jnp.int32)}
+        if self.chained_group_src is not None:
+            st["@gv"], st["@gc"] = self._chained_tables(G)
         for arg_idx, stats in self._stats().items():
             dt = self.arg_types[arg_idx].device_dtype
             for s in stats:
@@ -1052,10 +1097,14 @@ class CumulativeAggArtifact:
         G = state["cnt"].shape[0]
         need = _bucket(len(self.encoder), MIN_GROUP_CAPACITY)
         if need <= G:
+            if self.chained_group_src is not None:
+                out = dict(state)
+                out["@gv"], out["@gc"] = self._chained_tables(G)
+                return out
             return state
         out = dict(state)
         for k, v in state.items():
-            if k == "enabled":
+            if k == "enabled" or k.startswith("@g"):
                 continue
             pad_val = (
                 _identity(k[:3], v.dtype)
@@ -1065,6 +1114,8 @@ class CumulativeAggArtifact:
             out[k] = jnp.concatenate(
                 [v, jnp.full(need - G, pad_val, v.dtype)]
             )
+        if self.chained_group_src is not None:
+            out["@gv"], out["@gc"] = self._chained_tables(need)
         return out
 
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
@@ -1077,7 +1128,7 @@ class CumulativeAggArtifact:
         G = state["cnt"].shape[0]
 
         if self.code_key is not None:
-            g = env[self.code_key].astype(jnp.int32)
+            g = self._group_codes(env, state)
         else:
             g = jnp.zeros(E, jnp.int32)
         segkey = jnp.where(mask, g, G)
@@ -1752,7 +1803,9 @@ def compile_window_query(
     out_schema = OutputSchema(q.output_stream, tuple(out_fields))
     sc = stream_codes[inp.stream_id]
 
-    group_resolved = [resolver.resolve(ast.Attr(n)) for n in group_names]
+    group_resolved = [
+        resolver.resolve(ast.split_group_key(n)) for n in group_names
+    ]
 
     if window is not None and window[0] in ("sort", "unique"):
         if q.partition_with:
@@ -1775,7 +1828,7 @@ def compile_window_query(
                 "supported yet (length windows only)"
             )
         attr = dict(q.partition_with).get(inp.stream_id)
-        if tuple(group_names) != (attr,):
+        if tuple(ast.bare_group_key(n) for n in group_names) != (attr,):
             raise SiddhiQLError(
                 "additional 'group by' inside a partitioned window "
                 "query is not supported yet (the partition key is the "
@@ -1896,12 +1949,23 @@ def compile_window_query(
     code_key, encoder, encoded = _group_encoding(
         name, group_resolved, sc, filter_fns
     )
-    # non-aggregate projection inputs need per-cell "last event" values
+    # non-aggregate projection inputs need per-cell "last event" values.
+    # having may reference SELECT ALIASES (resolved later against the
+    # output slots), which are not tape columns — skip them here.
     last_types_map: Dict[str, AttributeType] = {}
     for item in rewritten:
         _referenced_keys(item.expr, resolver, last_types_map)
     if having_re is not None:
-        _referenced_keys(having_re, resolver, last_types_map)
+        aliases = {
+            i.alias for i in rewritten if i.alias is not None
+        }
+        for attr in ast.iter_attrs(having_re):
+            if attr.name.startswith("@") or (
+                attr.qualifier is None and attr.name in aliases
+            ):
+                continue  # slots / select aliases resolve downstream
+            r = resolver.resolve(attr)
+            last_types_map[r.key] = r.atype
     last_keys = sorted(last_types_map)
     art = BatchWindowArtifact(
         name=name,

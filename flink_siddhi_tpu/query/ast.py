@@ -97,6 +97,42 @@ def contains_aggregate(e: Expr) -> bool:
     return False
 
 
+def map_expr(e: Expr, leaf_fn) -> Expr:
+    """Rebuild an expression tree with ``leaf_fn`` applied to every Attr
+    node (THE tree-rewrite helper; each hand-rolled copy of this
+    recursion has to be fixed in lockstep otherwise)."""
+    import dataclasses
+
+    if isinstance(e, Attr):
+        return leaf_fn(e)
+    if isinstance(e, Unary):
+        return dataclasses.replace(e, operand=map_expr(e.operand, leaf_fn))
+    if isinstance(e, Binary):
+        return dataclasses.replace(
+            e,
+            left=map_expr(e.left, leaf_fn),
+            right=map_expr(e.right, leaf_fn),
+        )
+    if isinstance(e, Call):
+        return dataclasses.replace(
+            e, args=tuple(map_expr(a, leaf_fn) for a in e.args)
+        )
+    return e
+
+
+def split_group_key(name: str) -> "Attr":
+    """Group-by keys keep their stream qualifier as ``q.name`` text;
+    turn one back into an Attr."""
+    if "." in name:
+        q, n = name.split(".", 1)
+        return Attr(n, q)
+    return Attr(name)
+
+
+def bare_group_key(name: str) -> str:
+    return name.split(".", 1)[-1]
+
+
 def iter_attrs(e: Expr):
     """Yield every Attr node in an expression tree."""
     if isinstance(e, Attr):
@@ -236,6 +272,16 @@ class TableDef:
 
 
 @dataclass(frozen=True)
+class OutputRate:
+    """``output [all|last|first] every N events | <duration>`` — thins or
+    batches a query's OUTPUT stream (siddhi-core rate limiters)."""
+    mode: str  # 'events' | 'time' | 'snapshot'
+    which: str = "all"  # all | last | first
+    n_events: int = 0
+    ms: int = 0
+
+
+@dataclass(frozen=True)
 class Query:
     input: InputClause
     selector: Selector
@@ -251,6 +297,12 @@ class Query:
     # ``insert expired events into O`` emits events as they LEAVE the
     # window, not as they arrive
     output_events: str = "current"
+    # output rate limiting (None = every output event)
+    output_rate: Optional["OutputRate"] = None
+    # chained-group provenance (synthesized queries only): flattened
+    # intermediate field -> source tape key ("stream.field"), letting a
+    # downstream group-by intern its keys from the SOURCE column
+    group_sources: Tuple[Tuple[str, str], ...] = ()
 
     def input_stream_ids(self) -> Tuple[str, ...]:
         inp = self.input

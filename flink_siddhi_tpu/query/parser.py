@@ -158,10 +158,11 @@ def _parse_query(ts: TokenStream, name: Optional[str] = None) -> ast.Query:
     ts.expect_keyword("from")
     input_clause = _parse_input(ts)
     selector = _parse_selector(ts)
+    rate = _parse_output_rate(ts)
     action, out, on, events = _parse_output(ts)
     return ast.Query(
         input_clause, selector, out, action, name, on,
-        output_events=events,
+        output_events=events, output_rate=rate,
     )
 
 
@@ -483,7 +484,9 @@ def _parse_selector(ts: TokenStream) -> ast.Selector:
 def _parse_group_key(ts: TokenStream) -> str:
     name = ts.expect_id().text
     if ts.accept_op("."):
-        name = ts.expect_id().text
+        # preserve the qualifier: on a join, `group by S.id` vs `T.id`
+        # name different columns (ast.split_group_key undoes this)
+        name = f"{name}.{ts.expect_id().text}"
     return name
 
 
@@ -493,6 +496,31 @@ def _parse_select_item(ts: TokenStream) -> ast.SelectItem:
     if ts.accept_keyword("as"):
         alias = ts.expect_id().text
     return ast.SelectItem(expr, alias)
+
+
+def _parse_output_rate(ts: TokenStream):
+    """``output [all|last|first] every N events | <duration>`` or
+    ``output snapshot every <duration>`` (rate-limited emission)."""
+    if not ts.at_keyword("output"):
+        return None
+    ts.advance()
+    if ts.at_keyword("snapshot"):
+        ts.advance()
+        ts.expect_keyword("every")
+        ms = _parse_time_duration(ts)
+        return ast.OutputRate("snapshot", "all", 0, ms)
+    which = "all"
+    if ts.at_keyword("all", "last", "first"):
+        which = ts.advance().text.lower()
+    ts.expect_keyword("every")
+    if ts.current.kind == "INT" and ts.peek().kind == "ID" and (
+        ts.peek().text.lower() in ("events", "event")
+    ):
+        n = int(ts.advance().text.rstrip("lL"))
+        ts.advance()  # 'events'
+        return ast.OutputRate("events", which, n, 0)
+    ms = _parse_time_duration(ts)
+    return ast.OutputRate("time", which, 0, ms)
 
 
 def _parse_output(ts: TokenStream) -> Tuple[str, str, object, str]:

@@ -134,7 +134,9 @@ def infer_stream_partitions(
 
     for q in queries:
         inp = q.input
-        group_keys = q.selector.group_by
+        group_keys = tuple(
+            ast.bare_group_key(n) for n in q.selector.group_by
+        )
         if isinstance(inp, ast.StreamInput):
             if q.partition_with:
                 # `partition with (key of S)`: per-key state (windows,

@@ -132,6 +132,80 @@ class _LazyRing:
             return out
 
 
+class _OutputRateLimiter:
+    """Host emission-layer rate limiter (``output [all|last|first] every
+    N events | <duration>``) — the role of siddhi-core's output rate
+    limiters, applied where rows surface to collectors/sinks so thinned
+    streams also skip the retention/callback cost."""
+
+    def __init__(self, rate) -> None:
+        self.mode = rate.mode  # 'events' | 'time'
+        self.which = rate.which  # all | last | first
+        self.n = max(int(rate.n_events), 1)
+        self.ms = float(rate.ms)
+        self.count = 0  # events-mode position within the chunk
+        self.buf: List = []
+        self.deadline: Optional[float] = None
+
+    def feed(self, rows: List) -> List:
+        if self.mode == "events":
+            out: List = []
+            for r in rows:
+                pos = self.count % self.n
+                self.count += 1
+                if self.which == "first":
+                    if pos == 0:
+                        out.append(r)
+                elif self.which == "last":
+                    self.buf = [r]
+                    if pos == self.n - 1:
+                        out.append(r)
+                        self.buf = []
+                else:  # all: release the chunk when it completes
+                    self.buf.append(r)
+                    if pos == self.n - 1:
+                        out.extend(self.buf)
+                        self.buf = []
+            return out
+        # time mode (processing time): roll the interval over BEFORE
+        # processing — rows arriving after a deadline belong to the NEW
+        # interval (processing them first would drop the new interval's
+        # first event / misattribute late rows to the old interval)
+        now = time.monotonic()
+        if self.deadline is None:
+            self.deadline = now + self.ms / 1e3
+        flushed: List = []
+        if now >= self.deadline:
+            if self.which != "first":
+                flushed = (
+                    self.buf if self.which == "all" else self.buf[-1:]
+                )
+            self.buf = []
+            self.deadline = now + self.ms / 1e3
+        if self.which == "first":
+            out = list(flushed)
+            for r in rows:
+                if not self.buf:
+                    self.buf = [r]  # first of the interval
+                    out.append(r)
+            return out
+        self.buf.extend(rows)
+        return flushed
+
+    def flush(self) -> List:
+        """End of stream: pending buffered output surfaces."""
+        if self.which == "first":
+            self.buf = []
+            return []
+        out = (
+            self.buf
+            if self.which == "all"
+            else self.buf[-1:]
+        )
+        self.buf = []
+        return out
+
+
 class Job:
     """One running pipeline: sources -> compiled plan(s) -> collectors/sinks."""
 
@@ -172,6 +246,9 @@ class Job:
         self._folded: Dict[str, Tuple[str, int]] = {}
         self._folded_enabled: Dict[str, bool] = {}  # host-side mirror
         self._dynamic_cql: Dict[str, str] = {}  # for checkpoint replay
+        # output rate limiting: stream_id -> limiter (from plan
+        # ``output ... every ...`` clauses, applied at emission)
+        self._rate_limiters: Dict[str, _OutputRateLimiter] = {}
         for p in plans:
             self.add_plan(p)
         # output_stream -> list[(ts, row_tuple)] and field names
@@ -210,6 +287,7 @@ class Job:
         # with no row consumers, where match latency can't be sampled)
         self.record_drain_latency = False
         self.drain_latencies: List[float] = []
+
 
     # -- plan management (dynamic control plane hooks) ----------------------
     # Parity: AbstractSiddhiOperator.onEventReceived (:399-467) — add/update/
@@ -295,6 +373,8 @@ class Job:
             None,
         )
         self._plans[plan.plan_id] = rt
+        for sid, rate in plan.output_rates.items():
+            self._rate_limiters[sid] = _OutputRateLimiter(rate)
 
     # -- dynamic chain groups (recompile-free runtime adds) -----------------
     def _group_string_tables(self, plan, tpl) -> Dict:
@@ -588,6 +668,17 @@ class Job:
                         else None
                     ),
                 )
+        # stream end: rate-limited output still buffered surfaces now
+        for sid, limiter in self._rate_limiters.items():
+            pending = limiter.flush()
+            if pending:
+                for rt in self._plans.values():
+                    for schema in rt.plan.output_streams().get(sid, []):
+                        self._emit_rows(schema, pending, rate_limit=False)
+                        break
+                    else:
+                        continue
+                    break
 
     _noop_jit = None
 
@@ -912,11 +1003,17 @@ class Job:
             if limit and done >= limit:
                 return
 
-    def _emit_rows(self, schema, rows) -> None:
+    def _emit_rows(self, schema, rows, rate_limit: bool = True) -> None:
         """Shared append-to-collectors/sinks tail for all decode paths."""
         if not rows:
             return
         sid = schema.stream_id
+        if rate_limit:
+            limiter = self._rate_limiters.get(sid)
+            if limiter is not None:
+                rows = limiter.feed(rows)
+                if not rows:
+                    return
         self.output_fields.setdefault(sid, schema.field_names)
         epoch = self._epoch_ms or 0
         sinks = self._sinks.get(sid)

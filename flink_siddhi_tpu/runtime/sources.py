@@ -389,3 +389,121 @@ class CsvSource(_DecodedLinesSource):
         super().load_state_dict(d)
         if d.get("pos"):  # resuming mid-file: the header is behind us
             self._skip_header = False
+
+
+class SocketLineSource(_DecodedLinesSource):
+    """TCP line ingest: listen on (host, port); every connected client
+    streams newline-delimited JSON (``fmt='json'``) or CSV
+    (``fmt='csv'``) events. This is the in-repo analog of the
+    reference's experimental Kafka source (CEPPipeline.scala:33-78) with
+    no external broker: ``nc host port < events.jsonl`` deploys it.
+
+    A background acceptor + one reader thread per client append
+    complete lines to a bounded byte queue that backs the parent's
+    chunk reads; the source is UNBOUNDED — the job finishes only after
+    ``close()`` drains what is buffered."""
+
+    def __init__(
+        self,
+        stream_id: str,
+        schema: StreamSchema,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        fmt: str = "json",
+        delim: str = ",",
+        max_buffer_bytes: int = 64 << 20,
+        **kw,
+    ) -> None:
+        import socket
+        import threading
+
+        if fmt not in ("json", "csv"):
+            raise ValueError(fmt)
+        self._fmt = fmt
+        self._delim = delim
+        self._q: list = []
+        self._q_bytes = 0
+        self._max_buffer = max_buffer_bytes
+        self.dropped_bytes = 0
+        self._qlock = threading.Lock()
+        self._closed = False
+
+        src = self
+
+        class _QueueFile:
+            def read(self, n):
+                with src._qlock:
+                    if not src._q:
+                        return b""
+                    data = b"".join(src._q)
+                    src._q.clear()
+                    src._q_bytes = 0
+                return data
+
+        super().__init__(stream_id, schema, _QueueFile(), **kw)
+        self._server = socket.create_server((host, port))
+        self._server.settimeout(0.2)
+        self.host, self.port = self._server.getsockname()[:2]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        import socket
+        import threading
+
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(
+                target=self._reader, args=(conn,), daemon=True
+            ).start()
+
+    def _reader(self, conn) -> None:
+        carry = b""
+        try:
+            while not self._closed:
+                chunk = conn.recv(1 << 16)
+                if not chunk:
+                    break
+                carry += chunk
+                cut = carry.rfind(b"\n")
+                if cut < 0:
+                    continue
+                complete, carry = carry[: cut + 1], carry[cut + 1:]
+                with self._qlock:
+                    if self._q_bytes + len(complete) > self._max_buffer:
+                        # bounded-memory policy: shed newest, count it
+                        self.dropped_bytes += len(complete)
+                    else:
+                        self._q.append(complete)
+                        self._q_bytes += len(complete)
+        finally:
+            if carry.strip():
+                with self._qlock:
+                    self._q.append(carry + b"\n")
+                    self._q_bytes += len(carry) + 1
+            conn.close()
+
+    def close(self) -> None:
+        """Stop accepting; the job drains what is buffered and ends."""
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+    def _decode(self, data: bytes, max_rows: int):
+        if self._fmt == "json":
+            return self._decoder.decode_json(data, max_rows)
+        return self._decoder.decode_csv(data, max_rows, self._delim)
+
+    def poll(self, max_events: int):
+        batch, wm, done = super().poll(max_events)
+        if done and not self._closed:
+            # an empty read is "no data right now", not end-of-stream
+            self._done = False
+            return batch, None, False
+        return batch, wm, done

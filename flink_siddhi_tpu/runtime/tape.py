@@ -46,6 +46,10 @@ class EncodedColumn:
     stream_code: int
     encoder: object  # GroupEncoder
     select_fn: object = None
+    # False = intern only (discover codes host-side) without building /
+    # shipping the code column — chained-group consumers map values to
+    # codes ON DEVICE from the synced sorted table instead
+    materialize: bool = True
 
 
 @dataclass(frozen=True)
@@ -471,6 +475,8 @@ def build_tape(
         codes = enc.encoder.intern_rows(
             [cols[k][:total] for k in enc.in_keys], select
         )
+        if not enc.materialize:
+            continue  # interning side effect only
         col = np.zeros(cap, dtype=np.int32)
         col[:total] = codes
         cols[enc.out_key] = col

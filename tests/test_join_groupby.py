@@ -1,0 +1,111 @@
+"""Direct group by / having / aggregation ON a join query (round-3
+missing item 5: the chaining form worked, the single-query spelling —
+legal SiddhiQL — raised)."""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.sources import BatchSource
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+S = StreamSchema(
+    [("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+     ("timestamp", AttributeType.LONG)]
+)
+T = StreamSchema(
+    [("id", AttributeType.INT), ("qty", AttributeType.INT),
+     ("timestamp", AttributeType.LONG)]
+)
+
+
+def run(cql, n=40, batch=24):
+    rng = np.random.default_rng(13)
+    ids_s = rng.integers(0, 3, n).astype(np.int32)
+    prices = np.round(rng.random(n) * 10, 2)
+    ts_s = (1000 + 2 * np.arange(n)).astype(np.int64)
+    ids_t = rng.integers(0, 3, n).astype(np.int32)
+    qty = rng.integers(1, 5, n).astype(np.int32)
+    ts_t = (1001 + 2 * np.arange(n)).astype(np.int64)
+    plan = compile_plan(cql, {"S": S, "T": T})
+    # MULTIPLE micro-batches: donated-state bugs (e.g. cached device
+    # arrays fed back into a donating jit) only surface past batch 1
+    def src(sid, sch, cols, ts):
+        return BatchSource(sid, sch, iter([
+            EventBatch(
+                sid, sch,
+                {k: v[i:i + batch] for k, v in cols.items()},
+                ts[i:i + batch],
+            )
+            for i in range(0, n, batch)
+        ]))
+    job = Job(
+        [plan],
+        [src("S", S, {"id": ids_s, "price": prices,
+                      "timestamp": ts_s}, ts_s),
+         src("T", T, {"id": ids_t, "qty": qty,
+                      "timestamp": ts_t}, ts_t)],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return job, (ids_s, prices, ts_s, ids_t, qty, ts_t)
+
+
+def _join_rows(data, win=4):
+    ids_s, prices, ts_s, ids_t, qty, ts_t = data
+    events = sorted(
+        [(int(t), "S", int(i), float(p))
+         for t, i, p in zip(ts_s, ids_s, prices)]
+        + [(int(t), "T", int(i), int(k))
+           for t, i, k in zip(ts_t, ids_t, qty)]
+    )
+    ring = {"S": [], "T": []}
+    rows = []
+    for t, side, k, v in events:
+        other = "T" if side == "S" else "S"
+        for (ot, ok, ov) in ring[other][-win:]:
+            if ok == k:
+                if side == "S":
+                    rows.append((t, k, v, ov))
+                else:
+                    rows.append((t, k, ov, v))
+        ring[side].append((t, k, v))
+    return rows  # (emit_ts, id, price, qty) in emission order
+
+
+def test_join_direct_groupby_sum():
+    cql = (
+        "from S#window.length(4) join T#window.length(4) on S.id == T.id "
+        "select S.id as k, sum(T.qty) as total "
+        "group by S.id insert into o"
+    )
+    job, data = run(cql)
+    rows = job.results("o")
+    # oracle: per join emission, cumulative per-group sum of qty
+    sums = {}
+    exp = []
+    for _, k, _p, q_ in _join_rows(data):
+        sums[k] = sums.get(k, 0) + q_
+        exp.append((k, sums[k]))
+    assert len(rows) == len(exp) > 0
+    assert rows == exp
+
+
+def test_join_direct_having():
+    cql = (
+        "from S#window.length(4) join T#window.length(4) on S.id == T.id "
+        "select S.id as k, count() as c group by S.id "
+        "having c > 5 insert into o"
+    )
+    job, data = run(cql)
+    rows = job.results("o")
+    cnt = {}
+    exp = []
+    for _, k, _p, _q in _join_rows(data):
+        cnt[k] = cnt.get(k, 0) + 1
+        if cnt[k] > 5:
+            exp.append((k, cnt[k]))
+    assert rows == exp and len(rows) > 0
