@@ -17,7 +17,8 @@ values recorded in MEASURED_BASELINE below and in BASELINE.md.
 the in-JVM Siddhi runtime as a second denominator for continuity (the
 north star "vs 20x" was stated against it).
 
-Env knobs: BENCH_EVENTS (default 10_000_000), BENCH_BATCH (default 524288),
+Env knobs: BENCH_EVENTS (default 10_000_000), BENCH_BATCH (default
+1048576 — the tunnel's per-cycle fixed costs amortize best there),
 BENCH_CONFIG (headline | filter | pattern2 | window_groupby | multiquery64).
 """
 
@@ -78,7 +79,7 @@ def run_baseline(config, n_events):
     )
     cql = _config_cql(config)
     n_ids = 1000 if config == "window_groupby" else 50
-    batch = int(os.environ.get("BENCH_BATCH", 524_288))
+    batch = int(os.environ.get("BENCH_BATCH", 1_048_576))
     batches = make_batches(n_events, batch, schema, "inputStream", n_ids)
     ids = np.concatenate([b.columns["id"] for b in batches]).tolist()
     prices = np.concatenate(
@@ -228,7 +229,7 @@ def build_job(config, n_events, batch):
 def main():
     config = os.environ.get("BENCH_CONFIG", "headline")
     n_events = int(os.environ.get("BENCH_EVENTS", 10_000_000))
-    batch = int(os.environ.get("BENCH_BATCH", 524_288))
+    batch = int(os.environ.get("BENCH_BATCH", 1_048_576))
     if "--baseline" in sys.argv:
         run_baseline(
             config, int(os.environ.get("BENCH_BASELINE_EVENTS", 1_000_000))
