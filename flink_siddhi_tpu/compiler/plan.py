@@ -646,6 +646,11 @@ def compile_plan(
             res = chain_wire_opts(artifacts[0], config)
         elif isinstance(artifacts[0], SelectArtifact):
             res = select_wire_opts(artifacts[0], config)
+        else:
+            from .window import SlidingWindowArtifact, window_wire_opts
+
+            if isinstance(artifacts[0], SlidingWindowArtifact):
+                res = window_wire_opts(artifacts[0], config)
         if res is not None:
             needed, host_preds = res
             device_columns = tuple(

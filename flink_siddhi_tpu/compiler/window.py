@@ -293,6 +293,16 @@ class SlidingWindowArtifact:
     # path one-hot groups onto the MXU instead of argsorting the tape
     code_key: Optional[str] = None
     encoder: Optional[GroupEncoder] = None
+    # wire-opt metadata (window_wire_opts): per select item, the tape
+    # key when it is a plain attribute reference; every key it reads;
+    # and — once activated — the GROUP-KEY INDEX whose code the item
+    # emits instead of the raw column (decode maps codes back through
+    # the encoder, so the raw group column never ships)
+    proj_srcs: Tuple = ()
+    proj_refs: Tuple = ()
+    filter_keys: frozenset = frozenset()
+    group_keys_: Tuple = ()
+    group_code_proj: Tuple = ()
 
     def init_state(self) -> Dict:
         C = self.capacity
@@ -386,6 +396,44 @@ class SlidingWindowArtifact:
         if self._prefixable():
             return self._step_prefix(state, tape)
         return self._step_matrix(state, tape)
+
+    def decode_packed(self, n: int, block: "np.ndarray"):
+        """Group-coded projection columns decode back through the
+        encoder (the raw group column never shipped)."""
+        schema = self.output_schema
+        gcp = self.group_code_proj
+        if not gcp or all(g is None for g in gcp):
+            return [(schema, schema.decode_packed_block(n, block))]
+        from .output import emission_order
+
+        order = emission_order(block[0], n)
+        ts_list = (
+            np.asarray(block[0, :n])[order].astype(np.int64).tolist()
+        )
+        col_lists = []
+        for c, f in enumerate(schema.fields):
+            raw = np.asarray(block[1 + c, :n])[order]
+            gi = gcp[c]
+            if gi is not None:
+                # append-only encoder: extend the cached LUT instead of
+                # rebuilding O(groups) decodes per drain
+                cache = getattr(self, "_lut_cache", None)
+                if cache is None:
+                    cache = self._lut_cache = {}
+                lut = cache.setdefault(c, [])
+                for i in range(len(lut), len(self.encoder)):
+                    lut.append(f.decode(self.encoder.value(i)[gi]))
+                col_lists.append([lut[int(v)] for v in raw.tolist()])
+            else:
+                if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
+                    raw = raw.view(np.float32)
+                col_lists.append(f.decode_column(raw))
+        rows = (
+            list(zip(ts_list, map(tuple, zip(*col_lists))))
+            if col_lists
+            else [(t, ()) for t in ts_list]
+        )
+        return [(schema, rows)]
 
     # -- blocked (sort-free) sliding aggregation ---------------------------
     def _step_blocked(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
@@ -614,9 +662,15 @@ class SlidingWindowArtifact:
                 )
             env[agg.slot] = unsort(rows, agg.out_type.device_dtype)
 
+        gcp = self.group_code_proj or (None,) * len(self.proj_fns)
         cols = tuple(
-            jnp.broadcast_to(jnp.asarray(p(env)), (E,))
-            for p in self.proj_fns
+            jnp.broadcast_to(
+                jnp.asarray(
+                    env[self.code_key] if gi is not None else p(env)
+                ),
+                (E,),
+            )
+            for p, gi in zip(self.proj_fns, gcp)
         )
         out_mask = mask
         if self.having_fn is not None:
@@ -1905,6 +1959,29 @@ def compile_window_query(
         code_key, encoder, encoded = _group_encoding(
             name, group_resolved, sc, filter_fns
         )
+        # wire-opt metadata from the ORIGINAL (pre-rewrite) selector:
+        # plain-ref sources, full per-item refs (incl. aggregate args),
+        # filter refs
+        w_proj_srcs = []
+        w_proj_refs = []
+        for item in items:
+            w_proj_srcs.append(
+                resolver.resolve(item.expr).key
+                if isinstance(item.expr, ast.Attr)
+                and item.expr.index is None
+                else None
+            )
+            w_proj_refs.append(
+                frozenset(
+                    resolver.resolve(a).key
+                    for a in ast.iter_attrs(item.expr)
+                )
+            )
+        w_filter_keys = frozenset(
+            resolver.resolve(a).key
+            for f in inp.filters
+            for a in ast.iter_attrs(f)
+        )
         art = SlidingWindowArtifact(
             name=name,
             output_schema=out_schema,
@@ -1924,6 +2001,10 @@ def compile_window_query(
             having_fn=having_fn,
             code_key=code_key,
             encoder=encoder,
+            proj_srcs=tuple(w_proj_srcs),
+            proj_refs=tuple(w_proj_refs),
+            filter_keys=w_filter_keys,
+            group_keys_=tuple(r.key for r in group_resolved),
         )
         if art._blocked():
             # the sort-free tiled path consumes dense host-interned
@@ -2470,3 +2551,42 @@ class PerKeyWindowArtifact:
                 henv[f"@out:{f.name}"] = c
             out_mask = out_mask & self.having_fn(henv)
         return new_state, (out_mask, tape.ts, cols)
+
+
+def window_wire_opts(artifact: "SlidingWindowArtifact", config):
+    """Wire optimization for blocked sliding windows: select items that
+    are PLAIN references to group-by columns emit the @group CODE (which
+    already travels for the grouping) and decode back through the
+    encoder — the raw group column drops off the wire entirely. Returns
+    (needed_device_columns, ()) or None."""
+    if not config.lazy_projection:
+        # this IS late materialization (values resolve host-side at
+        # decode); keep the same opt-in contract as the select/chain
+        # wire opts
+        return None
+    if not artifact._blocked() or artifact.code_key is None:
+        return None
+    if artifact.having_fn is not None:
+        return None  # having may read the coded output alias
+    if not artifact.proj_srcs:
+        return None
+    gkeys = tuple(artifact.group_keys_)
+    gcp = []
+    for src in artifact.proj_srcs:
+        gcp.append(
+            gkeys.index(src)
+            if src is not None and src in gkeys
+            else None
+        )
+    if all(g is None for g in gcp):
+        return None
+    needed = set(artifact.filter_keys)
+    if artifact.ts_key is not None:
+        needed.add(artifact.ts_key)
+    for src, refs, gi in zip(
+        artifact.proj_srcs, artifact.proj_refs, gcp
+    ):
+        if gi is None:
+            needed |= set(refs)
+    artifact.group_code_proj = tuple(gcp)
+    return needed, ()

@@ -1082,8 +1082,11 @@ class Job:
                 )
                 if self.target_p99_ms:
                     budget_s = self.target_p99_ms / 2000.0
+                    # depth 1 is legitimate under a latency target when
+                    # a single cycle already eats the budget (a paced
+                    # load doesn't need pipelining to stay fed)
                     self.max_inflight_cycles = max(
-                        2,
+                        1,
                         min(
                             8,
                             int(budget_s / max(self._cycle_ema, 1e-3)),

@@ -472,9 +472,25 @@ def build_tape(
         if enc.select_fn is not None:
             view = {k: v[:total] for k, v in cols.items()}
             select = select & np.asarray(enc.select_fn(view))
-        codes = enc.encoder.intern_rows(
-            [cols[k][:total] for k in enc.in_keys], select
-        )
+        in_cols = []
+        for k in enc.in_keys:
+            col = cols.get(k)
+            if col is not None:
+                col = col[:total]
+            else:
+                # the raw column was pruned off the wire (group values
+                # travel as codes); intern from the host batches
+                sid_k, fld_k = k.split(".", 1)
+                col = _merged_stream_values(
+                    batches, sid_k, fld_k, total, order, identity,
+                    spec.column_types[k].device_dtype
+                    if k in spec.column_types
+                    else None,
+                )
+                if col is None:
+                    col = np.zeros(total, dtype=np.int64)
+            in_cols.append(col)
+        codes = enc.encoder.intern_rows(in_cols, select)
         if not enc.materialize:
             continue  # interning side effect only
         col = np.zeros(cap, dtype=np.int32)

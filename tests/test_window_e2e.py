@@ -495,3 +495,58 @@ def test_cumulative_f32_sum_compensated_drift():
     job.run()
     oracle = 1000.0 * n  # exact in f64
     assert last["v"] == pytest.approx(oracle, rel=1e-6)
+
+
+def test_blocked_window_group_code_projection_matches_eager():
+    """Round-4 wire opt: plain group-key projections ship as @group
+    CODES and decode back through the encoder — results must match the
+    eager (raw column) path exactly."""
+    import numpy as np
+
+    from flink_siddhi_tpu.compiler.config import EngineConfig
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.batch import EventBatch
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+         ("timestamp", AttributeType.LONG)]
+    )
+    cql = (
+        "from S#window.length(50) select id, sum(price) as s, "
+        "count() as c group by id insert into o"
+    )
+    rng = np.random.default_rng(17)
+    n = 600
+    ids = rng.integers(0, 9, n).astype(np.int32)
+    prices = np.round(rng.random(n) * 10, 2)
+    ts = 1000 + np.arange(n, dtype=np.int64)
+
+    def run(cfg):
+        batches = [
+            EventBatch(
+                "S", schema,
+                {"id": ids[s:s + 64], "price": prices[s:s + 64],
+                 "timestamp": ts[s:s + 64]},
+                ts[s:s + 64],
+            )
+            for s in range(0, n, 64)
+        ]
+        plan = compile_plan(cql, {"S": schema}, config=cfg)
+        job = Job([plan], [BatchSource("S", schema, iter(batches))],
+                  batch_size=64, time_mode="processing")
+        job.run()
+        return plan, job.results("o")
+
+    plan_e, eager = run(EngineConfig())
+    plan_l, opt = run(EngineConfig(lazy_projection=True))
+    # the raw group column dropped off the wire
+    assert "S.id" not in (plan_l.spec.device_columns or ("S.id",))
+    assert plan_l.artifacts[0].group_code_proj[0] is not None
+    assert len(eager) == len(opt) == n
+    for (ke, se, ce), (ko, so, co) in zip(eager, opt):
+        assert (ke, ce) == (ko, co)
+        assert so == pytest.approx(se, rel=1e-5)
