@@ -931,6 +931,21 @@ def _compile_query(
             config,
         )
     inp = q.input
+    if (
+        isinstance(inp, ast.StreamInput)
+        and inp.stream_id not in table_schemas  # table reads reject below
+        and len(inp.windows) == 1
+        and inp.windows[0].name.split(".")[-1].lower() == "delay"
+        and q.output_events == "current"
+    ):
+        from .window import compile_delay_window
+
+        # #window.delay(t): events pass through t ms late — the exact
+        # emission schedule of a time-window's EXPIRED stream (entry ts
+        # + span), reusing that machinery wholesale
+        return compile_delay_window(
+            q, name, schemas, stream_codes, extensions, config
+        )
     if q.output_events != "current":
         from .window import compile_expired_window
 

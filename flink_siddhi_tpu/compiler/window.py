@@ -1747,6 +1747,10 @@ def _window_of(inp: ast.StreamInput):
                 "#window.externalTimeBatch needs (tsAttribute, duration)"
             )
         return ("externalTimeBatch", (w.args[0], _time_arg(w.args[1])))
+    if lname == "delay":
+        if len(w.args) != 1:
+            raise SiddhiQLError("#window.delay needs one time argument")
+        return ("delay", _time_arg(w.args[0]))
     if lname == "timelength":
         if len(w.args) != 2 or not isinstance(w.args[1], ast.Literal):
             raise SiddhiQLError(
@@ -2590,3 +2594,37 @@ def window_wire_opts(artifact: "SlidingWindowArtifact", config):
             needed |= set(refs)
     artifact.group_code_proj = tuple(gcp)
     return needed, ()
+
+
+def compile_delay_window(
+    q: ast.Query,
+    name: str,
+    schemas,
+    stream_codes: Dict[str, int],
+    extensions,
+    config=None,
+):
+    """``#window.delay(t)``: pass events through t ms late. Identical
+    emission schedule to a time window's expired stream (entry ts +
+    span), so it IS an ExpiredWindowArtifact with a rewritten window
+    (siddhi-core 4.2.40 DelayWindowProcessor parity)."""
+    import dataclasses
+
+    inp = q.input
+    if q.selector.group_by or q.selector.having is not None or any(
+        ast.contains_aggregate(i.expr) for i in q.selector.items
+    ):
+        raise SiddhiQLError(
+            "aggregations over #window.delay are not supported; delay "
+            "the aggregated stream instead (chain the queries)"
+        )
+    delay_ms = _window_of(inp)[1]
+    rewritten_inp = dataclasses.replace(
+        inp, windows=(ast.Window("time", (ast.TimeLiteral(delay_ms),)),)
+    )
+    q2 = dataclasses.replace(
+        q, input=rewritten_inp, output_events="expired"
+    )
+    return compile_expired_window(
+        q2, name, schemas, stream_codes, extensions, config
+    )

@@ -201,3 +201,15 @@ def test_sort_window_rejects_stddev_loudly():
             "insert into o",
             {"S": SCHEMA},
         )
+
+
+def test_delay_window_oracle():
+    # events pass through 10 ms late (emission ts = arrival + delay);
+    # stream end flushes the remainder
+    cql = "from S#window.delay(10 ms) select id insert into o"
+    ids = [0, 1, 2, 3, 4]
+    ts = [1000, 1002, 1020, 1021, 1040]
+    job = run(cql, ids, [0.0] * 5, ts, batch=2)
+    rows = job.results_with_ts("o")
+    assert [r[0] for _, r in rows] == ids
+    assert [t for t, _ in rows] == [1010, 1012, 1030, 1031, 1050]
