@@ -1747,6 +1747,19 @@ def _window_of(inp: ast.StreamInput):
                 "#window.externalTimeBatch needs (tsAttribute, duration)"
             )
         return ("externalTimeBatch", (w.args[0], _time_arg(w.args[1])))
+    if lname == "session":
+        if not w.args or len(w.args) > 2:
+            raise SiddhiQLError(
+                "#window.session needs (gap[, keyAttribute])"
+            )
+        key = None
+        if len(w.args) == 2:
+            if not isinstance(w.args[1], ast.Attr):
+                raise SiddhiQLError(
+                    "#window.session key must be an attribute"
+                )
+            key = w.args[1]
+        return ("session", (_time_arg(w.args[0]), key))
     if lname == "delay":
         if len(w.args) != 1:
             raise SiddhiQLError("#window.delay needs one time argument")
@@ -1865,7 +1878,7 @@ def compile_window_query(
         resolver.resolve(ast.split_group_key(n)) for n in group_names
     ]
 
-    if window is not None and window[0] in ("sort", "unique"):
+    if window is not None and window[0] in ("sort", "unique", "session"):
         if q.partition_with:
             raise SiddhiQLError(
                 f"#window.{window[0]} inside 'partition with' is not "

@@ -213,3 +213,48 @@ def test_delay_window_oracle():
     rows = job.results_with_ts("o")
     assert [r[0] for _, r in rows] == ids
     assert [t for t, _ in rows] == [1010, 1012, 1030, 1031, 1050]
+
+
+def test_session_window_oracle():
+    # per-key sessions close after a 10ms gap; aggregates emit on the
+    # key's next arrival past the gap or at stream end
+    cql = (
+        "from S#window.session(10 ms, id) "
+        "select id, sum(price) as s, count() as c insert into o"
+    )
+    ids = [1, 2, 1, 1, 2, 1, 2]
+    prices = [1.0, 10.0, 2.0, 4.0, 20.0, 8.0, 40.0]
+    ts = [1000, 1001, 1005, 1008, 1030, 1040, 1041]
+    job = run(cql, ids, prices, ts, batch=3)
+    rows = sorted(job.results("o"))
+    # oracle: key 1 sessions [1000,1005,1008] (sum 7, closes via ev@1040)
+    #         then [1040] (sum 8, flush); key 2: [1001] (closes @1030),
+    #         [1030, 1041]? gap 10: 1041-1030=11 > 10 -> separate:
+    #         [1030] closes via ev@1041, [1041] flushes
+    exp = sorted([
+        (1, 7.0, 3), (1, 8.0, 1),
+        (2, 10.0, 1), (2, 20.0, 1), (2, 40.0, 1),
+    ])
+    assert len(rows) == len(exp)
+    for (k, s_, c), (ek, es, ec) in zip(rows, exp):
+        assert (k, c) == (ek, ec)
+        assert s_ == pytest.approx(es, rel=1e-5)
+
+
+def test_session_window_plain_select_passes_through():
+    # like every window's CURRENT-event path, a session window without
+    # aggregation passes arriving events through unchanged
+    cql = (
+        "from S#window.session(10 ms, id) select price insert into o"
+    )
+    job = run(cql, [1, 2, 1], [1.0, 2.0, 3.0], [1000, 1001, 1002])
+    assert [r[0] for r in job.results("o")] == [1.0, 2.0, 3.0]
+
+
+def test_session_window_rejects_mixed_plain_attr_with_aggs():
+    with pytest.raises(SiddhiQLError):
+        compile_plan(
+            "from S#window.session(10 ms, id) select price, "
+            "count() as c insert into o",
+            {"S": SCHEMA},
+        )
