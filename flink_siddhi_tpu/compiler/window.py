@@ -1772,6 +1772,25 @@ def _window_of(inp: ast.StreamInput):
         return ("timeLength", (_time_arg(w.args[0]), int(w.args[1].value)))
     if lname in ("sort", "unique"):
         return (lname, tuple(w.args))
+    if lname == "frequent":
+        if not w.args or not isinstance(w.args[0], ast.Literal):
+            raise SiddhiQLError(
+                "#window.frequent needs (count[, attributes...])"
+            )
+        return ("frequent", tuple(w.args))
+    if lname == "lossyfrequent":
+        if not w.args or not isinstance(w.args[0], ast.Literal):
+            raise SiddhiQLError(
+                "#window.lossyFrequent needs "
+                "(supportThreshold[, errorBound][, attributes...])"
+            )
+        return ("lossyFrequent", tuple(w.args))
+    if lname == "cron":
+        if len(w.args) != 1 or not isinstance(w.args[0], ast.Literal):
+            raise SiddhiQLError(
+                "#window.cron needs one cron-expression string"
+            )
+        return ("cron", str(w.args[0].value))
     raise SiddhiQLError(f"unsupported window #window.{w.name}")
 
 
@@ -1878,12 +1897,9 @@ def compile_window_query(
         resolver.resolve(ast.split_group_key(n)) for n in group_names
     ]
 
-    if window is not None and window[0] in ("sort", "unique", "session"):
-        if q.partition_with:
-            raise SiddhiQLError(
-                f"#window.{window[0]} inside 'partition with' is not "
-                "supported yet"
-            )
+    if window is not None and window[0] in (
+        "sort", "unique", "session", "frequent", "lossyFrequent",
+    ):
         from .scan_windows import compile_scan_window
 
         return compile_scan_window(
@@ -1891,12 +1907,21 @@ def compile_window_query(
             config, filter_fns, rewritten, collector, having_re,
         )
 
-    if q.partition_with and window is not None:
+    if q.partition_with and window is not None and window[0] == "time":
+        # per-key TIME window == shared time window + group-by on the
+        # key: wall-clock expiry is key-independent (an event leaves
+        # the window T ms after arrival whoever else arrived), so each
+        # key's member set is identical either way — unlike length
+        # windows (global last-C vs per-key last-C) or externalTime
+        # (stream time advances with the partition's own events).
+        # _rewrite_partitioned already added the key to group_by.
+        pass
+    elif q.partition_with and window is not None:
         # per-partition window: each key's OWN last-C window
         if window[0] != "length":
             raise SiddhiQLError(
                 f"#window.{window[0]} inside 'partition with' is not "
-                "supported yet (length windows only)"
+                "supported yet (length and time windows only)"
             )
         attr = dict(q.partition_with).get(inp.stream_id)
         if tuple(ast.bare_group_key(n) for n in group_names) != (attr,):
@@ -2037,6 +2062,10 @@ def compile_window_query(
 
     # batch windows
     mode, arg = window
+    if mode == "cron":
+        raise SiddhiQLError(
+            "#window.cron is not implemented yet"
+        )
     batch_ts_key = None
     if mode == "externalTimeBatch":
         # same tumbling machinery as timeBatch, but stream time advances

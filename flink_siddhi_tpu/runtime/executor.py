@@ -61,6 +61,10 @@ class _PlanRuntime:
     # async drain pipeline: swapped-out accumulators whose meta/data
     # fetches are in flight (see Job._drain_request/_drain_poll)
     drain_q: deque = field(default_factory=deque)
+    # False while the live accumulator is provably empty (freshly
+    # swapped, no step since): a drain request then skips entirely —
+    # each needless drain costs a d2h round trip on a tunneled device
+    acc_dirty: bool = False
     # predicted drain width (bucketed): the data slice is dispatched at
     # request time at this width so its compute is done before the fetch
     # thread reads it — a misprediction pays one extra slice round trip
@@ -839,8 +843,11 @@ class Job:
         never a block-on-unfinished-compute stall."""
         if rt.acc is None or not rt.plan.artifacts:
             return
+        if not rt.acc_dirty:
+            return  # provably empty: nothing to swap or fetch
         old = rt.acc
         rt.acc = rt.jitted_init_acc()
+        rt.acc_dirty = False
         if not self._has_consumers(rt):
             # no-consumer fast path: nobody observes the rows (no sinks,
             # retention off), so only the counts cross the wire — the
@@ -1193,34 +1200,46 @@ class Job:
                 del self._pending[sid]
         return ready
 
-    def _step_plan(
+    def _plan_windows(
         self, rt: _PlanRuntime, ready: List[EventBatch]
-    ) -> None:
+    ) -> List[List[EventBatch]]:
+        """Split a ready set into the tape windows this plan will step.
+
+        Compile-window cap (wide multi-query stacks): oversized
+        micro-batches step in chunks so the compiled program stays at a
+        tractable tape width. Single-input plans only — chunking a
+        multi-stream merge would need a time-aligned cut per stream
+        (stacked groups are single-stream by construction)."""
         plan = rt.plan
         involved = [
             b for b in ready if b.stream_id in plan.spec.stream_codes
         ]
         if not involved:
-            return
+            return []
         total = sum(len(b) for b in involved)
-        # compile-window cap (wide multi-query stacks): step oversized
-        # micro-batches in chunks so the compiled program stays at a
-        # tractable tape width. Single-input plans only — chunking a
-        # multi-stream merge would need a time-aligned cut per stream
-        # (stacked groups are single-stream by construction).
         limit = plan.tape_capacity_limit
         if limit and total > limit and len(involved) == 1:
             b = involved[0]
-            for s in range(0, len(b), limit):
-                self._step_plan_window(
-                    rt, [b.slice(s, min(s + limit, len(b)))]
-                )
-            return
-        self._step_plan_window(rt, involved)
+            return [
+                [b.slice(s, min(s + limit, len(b)))]
+                for s in range(0, len(b), limit)
+            ]
+        return [involved]
 
-    def _step_plan_window(
-        self, rt: _PlanRuntime, involved: List[EventBatch]
+    def _step_plan(
+        self, rt: _PlanRuntime, ready: List[EventBatch]
     ) -> None:
+        for involved in self._plan_windows(rt, ready):
+            self._step_plan_window(rt, involved)
+
+    def _stage_tape(
+        self, rt: _PlanRuntime, involved: List[EventBatch]
+    ):
+        """Host half of one step: build the wire tape (interning group
+        keys as a side effect) and retain lazy-projection columns in the
+        ring. Shared by the streaming dispatch path below and the
+        bounded-replay pre-stager (runtime/replay.py). The caller is
+        responsible for ``plan.grow_state`` before the jitted step."""
         plan = rt.plan
         total = sum(len(b) for b in involved)
         rt.tape_capacity = max(rt.tape_capacity, bucket_size(total))
@@ -1298,12 +1317,20 @@ class Job:
                     lcols["@ts"] = tcol
             rt.lazy.push(rt.lazy_base, lcols)
             rt.lazy_base += total
+        return tape
+
+    def _step_plan_window(
+        self, rt: _PlanRuntime, involved: List[EventBatch]
+    ) -> None:
+        plan = rt.plan
+        tape = self._stage_tape(rt, involved)
         # host interning may have discovered new group keys: re-bucket state
         # tables before the jit call (shape change -> one-off retrace)
         rt.states = plan.grow_state(rt.states)
         # NO device->host fetch here: emissions append to the on-device
         # accumulator and are drained in bulk (flush/results/periodic check)
         rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, tape)
+        rt.acc_dirty = True
         # sliding-window backpressure: a tiny non-donated "ticket" is
         # derived from the new state each cycle; completed tickets retire
         # via is_ready polling (free), and only when the device is a full

@@ -1,0 +1,57 @@
+"""Attribute resident-replay wall time: per-segment scan execution,
+drain request/poll, final drain, flush. Run on the real chip."""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+import jax
+
+from bench import build_job
+from flink_siddhi_tpu.runtime.replay import ResidentReplay
+
+
+def main():
+    config = os.environ.get("BENCH_CONFIG", "headline")
+    n = int(os.environ.get("BENCH_EVENTS", 10_485_760))
+    batch = int(os.environ.get("BENCH_BATCH", 1_048_576))
+    seg = os.environ.get("BENCH_SEGMENT_CYCLES")
+    job = build_job(config, n, batch)
+    rep = ResidentReplay(job, segment_cycles=int(seg) if seg else None)
+    t0 = time.perf_counter()
+    rep.stage()
+    print(f"stage: {time.perf_counter()-t0:.2f}s "
+          f"(events={rep.total_events})")
+    for pid, st in rep._staged.items():
+        rt = job._plans[pid]
+        print(f"plan {pid}: {len(st['segments'])} segments")
+        for i, s in enumerate(st["segments"]):
+            t0 = time.perf_counter()
+            rt.states, rt.acc = st["scan"](rt.states, rt.acc, s)
+            t_disp = time.perf_counter() - t0
+            jax.block_until_ready(rt.states)
+            t_exec = time.perf_counter() - t0
+            rt.acc_dirty = True
+            t0 = time.perf_counter()
+            job._drain_request(rt)
+            job._drain_poll(rt)
+            t_drain = time.perf_counter() - t0
+            print(f"  seg {i}: dispatch {t_disp*1e3:7.1f}ms  "
+                  f"exec {t_exec*1e3:7.1f}ms  drainreq {t_drain*1e3:6.1f}ms")
+        t0 = time.perf_counter()
+        job._drain_poll(rt, block=True)
+        print(f"  final drain: {(time.perf_counter()-t0)*1e3:.1f}ms")
+    t0 = time.perf_counter()
+    job.flush()
+    print(f"flush: {(time.perf_counter()-t0)*1e3:.1f}ms")
+
+
+if __name__ == "__main__":
+    main()

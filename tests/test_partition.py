@@ -170,27 +170,33 @@ end
 """,
             {"S": SCHEMA},
         )
-    # round-4: partitioned LENGTH windows are supported (per-key
-    # windows); other window kinds still reject loudly
+    # round-5: length, time, sort, unique, and session windows compile
+    # inside 'partition with'; timeBatch (per-partition t0) still
+    # rejects loudly
     with pytest.raises(SiddhiQLError, match="partition"):
         compile_plan(
             """
 partition with (user of S)
 begin
-  from S#window.time(10 ms) select user, sum(price) as t insert into o;
+  from S#window.timeBatch(10 ms)
+  select user, sum(price) as t insert into o;
 end
 """,
             {"S": SCHEMA},
         )
-    compile_plan(
-        """
+    for w in (
+        "#window.length(10)", "#window.time(10 ms)",
+        "#window.unique(id)",
+    ):
+        compile_plan(
+            f"""
 partition with (user of S)
 begin
-  from S#window.length(10) select user, sum(price) as t insert into o;
+  from S{w} select user, sum(price) as t insert into o;
 end
 """,
-        {"S": SCHEMA},
-    )
+            {"S": SCHEMA},
+        )
 
 
 def test_partitioned_non_every_rejected():
@@ -365,3 +371,161 @@ def test_partitioned_window_sharded_equivalence():
     for (k1, s1, c1), (k2, s2, c2) in zip(a, b):
         assert (k1, c1) == (k2, c2)
         assert s1 == pytest.approx(s2, rel=1e-4)
+
+
+# -- round-5: partitioned time / sort / unique / session windows ---------
+
+def _run_part(cql, schema, batches, batch=64):
+    plan = compile_plan(cql, {"S": schema})
+    job = Job(
+        [plan], [BatchSource("S", schema, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    return job
+
+
+def _kvt_schema():
+    return StreamSchema(
+        [("k", AttributeType.INT), ("v", AttributeType.DOUBLE),
+         ("timestamp", AttributeType.LONG)]
+    )
+
+
+def _kvt_batches(schema, ks, vs, ts, batch=64):
+    return [
+        EventBatch(
+            "S", schema,
+            {"k": ks[s:s + batch].astype(np.int32),
+             "v": vs[s:s + batch], "timestamp": ts[s:s + batch]},
+            ts[s:s + batch],
+        )
+        for s in range(0, len(ks), batch)
+    ]
+
+
+def test_partitioned_time_window_oracle():
+    """Per-key time window == per-key member set of a shared time
+    window (wall-clock expiry is key-independent): each emission
+    aggregates the key's own last-T events."""
+    schema = _kvt_schema()
+    rng = np.random.default_rng(5)
+    n = 400
+    ks = rng.integers(0, 5, n)
+    vs = np.round(rng.random(n) * 10, 2)
+    # irregular spacing so windows cut mid-stream
+    ts = 1000 + np.cumsum(rng.integers(1, 9, n)).astype(np.int64)
+    cql = (
+        "partition with (k of S) begin "
+        "from S#window.time(20 ms) select k, sum(v) as s, count() as c "
+        "insert into o end"
+    )
+    job = _run_part(cql, schema, _kvt_batches(schema, ks, vs, ts))
+    rows = job.results("o")
+    assert len(rows) == n
+    for i, (k, s, c) in enumerate(rows):
+        member = [
+            j for j in range(i + 1)
+            if ks[j] == ks[i] and ts[j] > ts[i] - 20
+        ]
+        assert k == ks[i]
+        assert c == len(member)
+        assert s == pytest.approx(sum(vs[j] for j in member), rel=1e-4)
+
+
+def test_partitioned_unique_window_oracle():
+    """Per-partition unique(id): each key's window holds the latest
+    event per id WITHIN that partition."""
+    schema = StreamSchema(
+        [("k", AttributeType.INT), ("id", AttributeType.INT),
+         ("v", AttributeType.DOUBLE), ("timestamp", AttributeType.LONG)]
+    )
+    rng = np.random.default_rng(11)
+    n = 300
+    ks = rng.integers(0, 4, n)
+    ids = rng.integers(0, 6, n)
+    vs = np.round(rng.random(n) * 10, 2)
+    ts = 1000 + np.arange(n, dtype=np.int64)
+    batches = [
+        EventBatch(
+            "S", schema,
+            {"k": ks[s:s + 64].astype(np.int32),
+             "id": ids[s:s + 64].astype(np.int32),
+             "v": vs[s:s + 64], "timestamp": ts[s:s + 64]},
+            ts[s:s + 64],
+        )
+        for s in range(0, n, 64)
+    ]
+    cql = (
+        "partition with (k of S) begin "
+        "from S#window.unique(id) select k, sum(v) as s, count() as c "
+        "insert into o end"
+    )
+    job = _run_part(cql, schema, batches)
+    rows = job.results("o")
+    assert len(rows) == n
+    for i, (k, s, c) in enumerate(rows):
+        latest = {}
+        for j in range(i + 1):
+            if ks[j] == ks[i]:
+                latest[ids[j]] = vs[j]
+        assert k == ks[i]
+        assert c == len(latest)
+        assert s == pytest.approx(sum(latest.values()), rel=1e-4)
+
+
+def test_partitioned_sort_window_oracle():
+    """Per-partition sort(N, v): each key keeps its own N smallest."""
+    schema = _kvt_schema()
+    rng = np.random.default_rng(13)
+    n = 240
+    ks = rng.integers(0, 3, n)
+    vs = np.round(rng.random(n) * 100, 2)
+    ts = 1000 + np.arange(n, dtype=np.int64)
+    cql = (
+        "partition with (k of S) begin "
+        "from S#window.sort(4, v) select k, min(v) as mn, count() as c "
+        "insert into o end"
+    )
+    job = _run_part(cql, schema, _kvt_batches(schema, ks, vs, ts))
+    rows = job.results("o")
+    assert len(rows) == n
+    kept = {k: [] for k in range(3)}
+    for i, (k, mn, c) in enumerate(rows):
+        b = kept[ks[i]]
+        b.append(vs[i])
+        b.sort()
+        del b[4:]
+        assert k == ks[i]
+        assert c == len(b)
+        assert mn == pytest.approx(min(b), rel=1e-4)
+
+
+def test_partitioned_session_window_oracle():
+    """partition with + #window.session(gap) == keyed sessions on the
+    partition attribute."""
+    schema = _kvt_schema()
+    ks = np.array([0, 1, 0, 0, 1, 0, 1, 1], dtype=np.int64)
+    ts = np.array(
+        [1000, 1002, 1005, 1040, 1041, 1100, 1101, 1150],
+        dtype=np.int64,
+    )
+    vs = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0])
+    cql = (
+        "partition with (k of S) begin "
+        "from S#window.session(10 ms) "
+        "select k, sum(v) as s, count() as c insert into o end"
+    )
+    job = _run_part(cql, schema, _kvt_batches(schema, ks, vs, ts, 4))
+    rows = sorted(job.results("o"))
+    # oracle: per-key sessions split at >10ms gaps
+    # k=0: [1000,1005] sum 4 c2; [1040] sum 4 c1; [1100] sum 6 c1
+    # k=1: [1002] sum 2 c1; [1041] sum 5 c1; [1101] sum 7 c1; [1150] 8 c1
+    expect = sorted([
+        (0, 4.0, 2), (0, 4.0, 1), (0, 6.0, 1),
+        (1, 2.0, 1), (1, 5.0, 1), (1, 7.0, 1), (1, 8.0, 1),
+    ])
+    assert len(rows) == len(expect)
+    for (k, s, c), (ek, es, ec) in zip(rows, expect):
+        assert (k, c) == (ek, ec)
+        assert s == pytest.approx(es, rel=1e-4)

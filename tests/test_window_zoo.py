@@ -258,3 +258,89 @@ def test_session_window_rejects_mixed_plain_attr_with_aggs():
             "count() as c insert into o",
             {"S": SCHEMA},
         )
+
+
+# -- round-5: frequent / lossyFrequent (heavy-hitter sketches) -----------
+
+def test_frequent_window_oracle():
+    """Misra-Gries: tracked-value table of `count` slots; a full table
+    decrements all counters, evicts zeros, and drops the arrival."""
+    ids, prices, ts = make(n=120, seed=9)
+    job = run(
+        "from S#window.frequent(2, id) "
+        "select id, count() as c, sum(price) as s insert into out",
+        ids, prices, ts,
+    )
+    rows = job.results("out")
+
+    # per-event oracle: table of at most 2 tracked ids -> (freq, latest
+    # price); admitted arrivals emit (count of tracked, sum of latest
+    # prices per tracked value)
+    table = {}
+    latest = {}
+    expect = []
+    for i, p in zip(ids, prices):
+        if i in table:
+            table[i] += 1
+            latest[i] = p
+        elif len(table) < 2:
+            table[i] = 1
+            latest[i] = p
+        else:
+            table = {k: v - 1 for k, v in table.items()}
+            for k in [k for k, v in table.items() if v == 0]:
+                del table[k]
+                del latest[k]
+            continue  # the arrival itself is NOT admitted
+        expect.append((i, len(table), sum(latest.values())))
+    assert len(rows) == len(expect)
+    for (i1, c1, s1), (i2, c2, s2) in zip(rows, expect):
+        assert (i1, c1) == (i2, c2)
+        assert s1 == pytest.approx(s2, rel=1e-4)
+
+
+def test_lossy_frequent_window_oracle():
+    """Lossy counting: every arrival tracked (delta = bucket-1);
+    bucket boundaries prune f+delta <= bucket; emission needs
+    f >= (support-error)*N."""
+    ids, prices, ts = make(n=150, seed=4)
+    support, error = 0.3, 0.1
+    job = run(
+        f"from S#window.lossyFrequent({support}, {error}, id) "
+        "select id, count() as c insert into out",
+        ids, prices, ts,
+    )
+    rows = job.results("out")
+
+    width = int(np.ceil(1.0 / error))
+    table = {}  # id -> [freq, delta]
+    n = 0
+    expect = []
+    for i in ids:
+        n += 1
+        b = int(np.ceil(n / width))
+        if i in table:
+            table[i][0] += 1
+        else:
+            table[i] = [1, b - 1]
+        if n % width == 0:
+            for k in [k for k, (f, d) in table.items() if f + d <= b]:
+                del table[k]
+        thresh = (support - error) * n
+        if i in table and table[i][0] >= thresh:
+            member = sum(
+                1 for k, (f, d) in table.items() if f >= thresh
+            )
+            expect.append((i, member))
+    assert len(rows) == len(expect)
+    assert rows == expect
+
+
+def test_frequent_rejects_partition():
+    with pytest.raises(SiddhiQLError, match="partition"):
+        compile_plan(
+            "partition with (id of S) begin "
+            "from S#window.frequent(2, id) select count() as c "
+            "insert into out end",
+            {"S": SCHEMA},
+        )
