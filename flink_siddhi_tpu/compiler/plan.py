@@ -656,6 +656,13 @@ def compile_plan(
             device_columns = tuple(
                 k for k in columns if k in needed
             )
+    # artifact-declared host-computed columns (e.g. #window.cron's
+    # per-event window ids — calendar math stays on the host)
+    host_preds = tuple(host_preds) + tuple(
+        hc
+        for art in artifacts
+        for hc in getattr(art, "host_columns", ())
+    )
 
     spec = TapeSpec(
         stream_codes, tuple(columns), column_types, tuple(encoded),

@@ -1347,6 +1347,12 @@ class BatchWindowArtifact:
     # externalTimeBatch: window boundaries follow this tape column's
     # values instead of event time
     ts_key: Optional[str] = None
+    # cron: window boundaries are host-computed per-event window ids
+    # (utils/cron.py enumerates Quartz fires; "an emission schedule,
+    # not device math"). A window completes when a LATER-window event
+    # exists — the event-driven equivalent of the timer firing, same
+    # deviation documented for session windows.
+    wid_key: Optional[str] = None
 
     def emit_block_width(self, tape_capacity: int, state: Dict) -> int:
         """Widest per-cycle emission block: every window-grid cell can
@@ -1459,13 +1465,23 @@ class BatchWindowArtifact:
                 if self.ts_key is not None
                 else tape.ts
             )
-            first_ts = jnp.where(
-                M > 0,
-                jnp.min(jnp.where(mask, ts, jnp.iinfo(jnp.int32).max)),
-                0,
-            )
-            t0 = jnp.where(state["t0"] >= 0, state["t0"], first_ts)
-            abs_batch = jnp.where(mask, (ts - t0) // T, 0).astype(jnp.int32)
+            if self.wid_key is not None:  # cron window ids, host-made
+                t0 = state["t0"]
+                abs_batch = jnp.where(
+                    mask, env[self.wid_key].astype(jnp.int32), 0
+                ).astype(jnp.int32)
+            else:
+                first_ts = jnp.where(
+                    M > 0,
+                    jnp.min(
+                        jnp.where(mask, ts, jnp.iinfo(jnp.int32).max)
+                    ),
+                    0,
+                )
+                t0 = jnp.where(state["t0"] >= 0, state["t0"], first_ts)
+                abs_batch = jnp.where(
+                    mask, (ts - t0) // T, 0
+                ).astype(jnp.int32)
             # dense-rank distinct windows in this tape; carry window is row 0
             # (merging when the tape still starts in the carried window)
             sortable = jnp.where(mask, abs_batch, jnp.iinfo(jnp.int32).max)
@@ -1495,15 +1511,25 @@ class BatchWindowArtifact:
                 jnp.where(carry_batch >= 0, carry_batch, row_batch[0])
             )
             last_ts = jnp.max(jnp.where(mask, ts, -(2 ** 31) + 1))
-            # a window is complete once an event at/after its end exists
-            completed = (
-                (row_batch > -(2 ** 31) + 1)
-                & (last_ts >= t0 + (row_batch + 1) * T)
-            )
-            new_seen = state["seen"] + M
             max_tape_batch = jnp.max(
                 jnp.where(mask, abs_batch, -(2 ** 31) + 1)
             )
+            if self.wid_key is not None:
+                # cron: a window is complete once a LATER-window event
+                # exists (event-driven fire; wall timers don't run on
+                # device — the engine-wide emission-timing deviation)
+                latest = jnp.maximum(carry_batch, max_tape_batch)
+                completed = (
+                    (row_batch > -(2 ** 31) + 1) & (row_batch < latest)
+                )
+            else:
+                # a window is complete once an event at/after its end
+                # exists
+                completed = (
+                    (row_batch > -(2 ** 31) + 1)
+                    & (last_ts >= t0 + (row_batch + 1) * T)
+                )
+            new_seen = state["seen"] + M
             new_batch = jnp.where(
                 M > 0, jnp.maximum(carry_batch, max_tape_batch), carry_batch
             )
@@ -1647,14 +1673,14 @@ class BatchWindowArtifact:
 
     @property
     def flush_is_noop(self) -> bool:
-        return self.window_mode != "timeBatch"
+        return self.window_mode not in ("timeBatch", "cron")
 
     def flush(self, state: Dict) -> Tuple[Dict, Tuple]:
         """End-of-stream flush of the carried incomplete window (timeBatch
         semantics: the final timer fires; lengthBatch does not flush partial
         windows, matching Siddhi)."""
         G = self._G(state)
-        if self.window_mode != "timeBatch":
+        if self.window_mode not in ("timeBatch", "cron"):
             empty = (
                 jnp.asarray(0, jnp.int32),
                 jnp.zeros(G, jnp.int32),
@@ -2062,9 +2088,23 @@ def compile_window_query(
 
     # batch windows
     mode, arg = window
+    host_cols = ()
+    wid_key = None
     if mode == "cron":
-        raise SiddhiQLError(
-            "#window.cron is not implemented yet"
+        # host-enumerated Quartz fires; per-event window ids ship as a
+        # narrow int column and the device runs the ordinary batch grid
+        from ..runtime.tape import HostPred
+        from ..utils.cron import CronSchedule
+
+        sched = CronSchedule.parse(str(arg))
+        wid_key = f"@cron:{name}"
+        host_cols = (
+            HostPred(
+                wid_key,
+                lambda henv, _s=sched: _s.window_ids(henv["@ts"]),
+                ("@ts",),
+                np.int32,
+            ),
         )
     batch_ts_key = None
     if mode == "externalTimeBatch":
@@ -2113,8 +2153,10 @@ def compile_window_query(
         having_fn=having_fn,
         batch_slots=config.time_batch_slots,
         ts_key=batch_ts_key,
+        wid_key=wid_key,
     )
     art.encoded_columns = encoded
+    art.host_columns = host_cols
     return art
 
 

@@ -156,9 +156,16 @@ class ShardedJob(Job):
         # dynamic-group folding is a single-device optimization; sharded
         # adds keep one runtime per plan (dynamic flag accepted for API
         # parity)
-        if (
-            any(getattr(a, "lazy_pairs", ()) for a in plan.artifacts)
-            or plan.spec.host_preds
+        # artifact-declared host columns (e.g. #window.cron's window
+        # ids) are PURE functions of event data — safe to evaluate
+        # per shard — unlike the pushdown preds the guard below strips
+        art_keys = {
+            hc.out_key
+            for a in plan.artifacts
+            for hc in getattr(a, "host_columns", ())
+        }
+        if any(getattr(a, "lazy_pairs", ()) for a in plan.artifacts) or any(
+            hp.out_key not in art_keys for hp in plan.spec.host_preds
         ):
             # lazy projection / predicate pushdown are single-device
             # (the ordinal ring and the host mask evaluation live on one

@@ -344,3 +344,87 @@ def test_frequent_rejects_partition():
             "insert into out end",
             {"S": SCHEMA},
         )
+
+
+# -- round-5: #window.cron (host-scheduled flush boundaries) -------------
+
+def test_cron_schedule_enumeration():
+    from flink_siddhi_tpu.utils.cron import CronSchedule
+
+    # every 5 seconds
+    s = CronSchedule.parse("0/5 * * * * ?")
+    t0 = 1_700_000_000_000  # some UTC instant
+    f1 = s.next_fire(t0)
+    assert f1 is not None and f1 > t0 and (f1 // 1000) % 5 == 0
+    # every minute at second 30
+    s2 = CronSchedule.parse("30 * * * * ?")
+    f2 = s2.next_fire(t0)
+    assert (f2 // 1000) % 60 == 30
+    # window ids are monotone and advance once per 5s fire
+    ts = np.arange(t0, t0 + 20_000, 700, dtype=np.int64)
+    wids = s.window_ids(ts)
+    assert (np.diff(wids) >= 0).all()
+    assert np.unique(wids).size == 4  # 20s span of a 5s cadence
+    # PURE: a fresh instance maps the same timestamps identically
+    # (window ids are absolute fire counts, no data-dependent anchor)
+    assert (
+        CronSchedule.parse("0/5 * * * * ?").window_ids(ts) == wids
+    ).all()
+    # '0/1' means EVERY second, not just second 0
+    s3 = CronSchedule.parse("0/1 * * * * ?")
+    w3 = s3.window_ids(ts)
+    assert np.unique(w3).size == 20
+    # Quartz day-of-week: 1 == SUN == 'SUN'; 2023-11-19 is a Sunday
+    sun = int(
+        np.datetime64("2023-11-19T12:00:00").astype(
+            "datetime64[ms]"
+        ).astype(np.int64)
+    )
+    for expr in ("0 0 12 ? * SUN", "0 0 12 ? * 1"):
+        sd = CronSchedule.parse(expr)
+        f = sd.next_fire(sun - 1)
+        assert f == sun, (expr, f, sun)
+    # calendar extensions reject loudly
+    with pytest.raises(SiddhiQLError, match="extension"):
+        CronSchedule.parse("0 0 0 L * ?")
+    with pytest.raises(SiddhiQLError, match="6-7 fields"):
+        CronSchedule.parse("*/5 * * * *")
+
+
+def test_cron_window_oracle():
+    """#window.cron('0/2 * * * * ?'): tumbling flush at every fire (2s
+    cadence); matches a per-event oracle bucketing by fires."""
+    from flink_siddhi_tpu.utils.cron import CronSchedule
+
+    rng = np.random.default_rng(8)
+    n = 80
+    ids = rng.integers(0, 3, n).tolist()
+    prices = np.round(rng.random(n) * 10, 2).tolist()
+    # ~350ms spacing from an epoch-aligned start => several 2s windows
+    t0 = 1_700_000_000_137
+    ts = (t0 + np.cumsum(rng.integers(200, 500, n))).tolist()
+    job = run(
+        "from S#window.cron('0/2 * * * * ?') "
+        "select id, sum(price) as s, count() as c "
+        "group by id insert into out",
+        ids, prices, ts, batch=16,
+    )
+    rows = job.results("out")
+
+    sched = CronSchedule.parse("0/2 * * * * ?")
+    wids = sched.window_ids(np.asarray(ts, dtype=np.int64))
+    expect = {}
+    for i, w in enumerate(wids.tolist()):
+        key = (w, ids[i])
+        s, c = expect.get(key, (0.0, 0))
+        expect[key] = (s + prices[i], c + 1)
+    got = {}
+    for idv, s, c in rows:
+        got.setdefault((idv, c, round(s, 2)), 0)
+        got[(idv, c, round(s, 2))] += 1
+    want = {}
+    for (w, idv), (s, c) in expect.items():
+        want.setdefault((idv, c, round(s, 2)), 0)
+        want[(idv, c, round(s, 2))] += 1
+    assert len(rows) == len(expect)
+    assert got == want
