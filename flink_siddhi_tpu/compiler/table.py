@@ -228,6 +228,13 @@ class WindowedTableInsertArtifact:
         # group-by keys still need host interning
         return getattr(self.inner, "encoded_columns", ())
 
+    @property
+    def host_columns(self):
+        # host-computed tape columns (e.g. #window.cron window ids)
+        # must survive the wrapping or the inner step's wid_key column
+        # never reaches the tape
+        return getattr(self.inner, "host_columns", ())
+
     def init_state(self) -> Dict:
         return {
             "win": self.inner.init_state(),

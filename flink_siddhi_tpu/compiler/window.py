@@ -343,9 +343,19 @@ class SlidingWindowArtifact:
         """Sort-free tiled path: per-group running sums over the merged
         arrival/expiry sequence via one-hot / lower-triangular matmuls
         (MXU work) instead of multi-key argsorts (the slow op class on
-        TPU — ~5 sorts of 2(C+E) elements dominated this step). Needs
-        distributive aggregates and float (or count) arguments — int
-        sums keep the exact integer scan path."""
+        TPU — ~5 sorts of 2(C+E) elements dominated this step).
+
+        Integer sum/avg arguments run EXACTLY through the same matmuls
+        by base-2^11 digit decomposition (each digit plane's tile sum
+        stays < 2^21, f32-exact; across-tile accumulation is modular
+        int32, so the recombined sum wraps exactly like native int32).
+        min/max (length windows only — FIFO expiry makes a window's
+        live members the LAST cnt same-group arrivals, a suffix
+        property) ride a sparse-table range query over ONE composite-
+        key argsort. Time windows exclude min/max: the cross-batch
+        straggler defense can early-evict, making the live set
+        non-contiguous. externalTime keeps the matrix path (user
+        timestamps have no ordering guarantee at all)."""
         if not (
             self.window_mode == "length"
             or (self.window_mode == "time" and self.ts_key is None)
@@ -354,47 +364,16 @@ class SlidingWindowArtifact:
         if self.group_fns and self.code_key is None:
             return False
         for a in self.aggs:
-            if a.kind not in ("count", "sum", "avg", "stddev"):
-                return False
-            if a.kind != "count" and not jnp.issubdtype(
-                np.dtype(self.arg_types[a.arg_idx].device_dtype),
-                jnp.floating,
-            ):
+            if a.kind in ("min", "max"):
+                if self.window_mode != "length":
+                    return False
+            elif a.kind not in ("count", "sum", "avg", "stddev"):
                 return False
         return True
-
-    def _prefixable(self) -> bool:
-        """Windows whose aggregates distribute over +/- can use the
-        O((E+C) log) arrival/expiry formulation instead of the O(E*C)
-        window matrix (catastrophic for large windows: a length(1000)
-        window over a 131k batch materializes 131M-element gathers).
-        Length windows expire by position; tape-time windows by
-        searchsorted timestamp over the (sorted) tape order.
-        externalTime windows keep the matrix path: their user-supplied
-        timestamp column has no ordering guarantee, and expiry-by-search
-        over disordered times mis-evicts (an event could even expire
-        before its own arrival)."""
-        if not (
-            self.window_mode == "length"
-            or (self.window_mode == "time" and self.ts_key is None)
-        ):
-            return False
-        allowed = {"count", "sum", "avg", "stddev"}
-        if self.window_mode == "length":
-            # min/max ride a range query over the last-cnt same-group
-            # arrivals — a suffix property only FIFO expiry guarantees.
-            # Length windows are FIFO by construction; time windows may
-            # conservatively early-evict cross-batch timestamp
-            # stragglers (exp_pos defense below), making the live set
-            # non-contiguous, so time-mode min/max keeps the matrix path.
-            allowed |= {"min", "max"}
-        return all(a.kind in allowed for a in self.aggs)
 
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         if self._blocked():
             return self._step_blocked(state, tape)
-        if self._prefixable():
-            return self._step_prefix(state, tape)
         return self._step_matrix(state, tape)
 
     def decode_packed(self, n: int, block: "np.ndarray"):
@@ -439,7 +418,7 @@ class SlidingWindowArtifact:
     def _step_blocked(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         """Windowed per-group sums with ZERO sorts.
 
-        Same semantics as ``_step_prefix`` (window = last C matching
+        Same semantics as ``_step_matrix`` (window = last C matching
         events / time span; aggregates over the emitting event's group),
         new machinery: arrivals compact via scatter (not argsort); the
         arrival(+v)/expiry(-v) sequences are each already sorted by
@@ -470,7 +449,12 @@ class SlidingWindowArtifact:
             return jnp.zeros(E, col.dtype).at[dest].set(col, mode="drop")
 
         # value columns: one per agg arg needing sums, plus squares for
-        # stddev, plus an implicit count column
+        # stddev, plus an implicit count column. INTEGER sum args are
+        # decomposed into three base-2^11 digit planes: each plane's
+        # per-tile matmul sum stays < 2^21 (f32-exact); the across-tile
+        # carry then runs in modular int32, and the recombination
+        # d0 + (d1<<11) + (d2<<22) reproduces native int32 wrap-around
+        # exactly (two's-complement arithmetic-shift identity).
         need_sq = sorted(
             {a.arg_idx for a in self.aggs if a.kind == "stddev"}
         )
@@ -481,19 +465,53 @@ class SlidingWindowArtifact:
                 if a.kind in ("sum", "avg", "stddev")
             }
         )
-        vcols = []
-        vmap: Dict[str, int] = {}
-        for j in need_sum:
-            vmap[f"s{j}"] = len(vcols)
-            vcols.append(
-                compact(self.arg_fns[j](env), jnp.float32)
+        int_sum = {
+            j
+            for j in need_sum
+            if not jnp.issubdtype(
+                np.dtype(self.arg_types[j].device_dtype), jnp.floating
             )
+        }
+
+        def digits(v):
+            v = v.astype(jnp.int32)
+            return (
+                (v & 0x7FF).astype(jnp.float32),
+                ((v >> 11) & 0x7FF).astype(jnp.float32),
+                (v >> 22).astype(jnp.float32),
+            )
+
+        vcols = []  # batch-side planes (compacted, f32)
+        rcols = []  # ring-side planes (f32)
+        vmap: Dict[str, int] = {}
+        int_planes: List[int] = []  # plane indices carried in int32
+
+        def plane(name, batch, ringv, isint=False):
+            if isint:
+                int_planes.append(len(vcols))
+            vmap[name] = len(vcols)
+            vcols.append(batch)
+            rcols.append(ringv)
+
+        for j in need_sum:
+            bv = compact(self.arg_fns[j](env))
+            rv = ring[f"a{j}"]
+            if j in int_sum:
+                for d, (bd, rd) in enumerate(
+                    zip(digits(bv), digits(rv))
+                ):
+                    plane(f"s{j}:{d}", bd, rd, isint=True)
+            else:
+                plane(
+                    f"s{j}",
+                    bv.astype(jnp.float32),
+                    rv.astype(jnp.float32),
+                )
         for j in need_sq:
-            vmap[f"q{j}"] = len(vcols)
             v = compact(self.arg_fns[j](env), jnp.float32)
-            vcols.append(v * v)
-        vmap["cnt"] = len(vcols)
-        vcols.append(jnp.ones(E, jnp.float32))
+            rv = ring[f"a{j}"].astype(jnp.float32)
+            plane(f"q{j}", v * v, rv * rv)
+        plane("cnt", jnp.ones(E, jnp.float32), jnp.ones(C, jnp.float32))
         K = len(vcols)
 
         if self.code_key is not None:
@@ -510,17 +528,10 @@ class SlidingWindowArtifact:
         codes = jnp.concatenate([ring_gc, codes_b])
         ts_n = jnp.concatenate([ring["ts"], ts_b])
         live = jnp.concatenate([ring["valid"], live_b])
-        ring_vals = []
-        for j in need_sum:
-            ring_vals.append(ring[f"a{j}"].astype(jnp.float32))
-        for j in need_sq:
-            rv = ring[f"a{j}"].astype(jnp.float32)
-            ring_vals.append(rv * rv)
-        ring_vals.append(jnp.ones(C, jnp.float32))
         V_n = jnp.stack(
             [
                 jnp.concatenate([rv, bv])
-                for rv, bv in zip(ring_vals, vcols)
+                for rv, bv in zip(rcols, vcols)
             ],
             axis=1,
         )  # [N, K]
@@ -623,40 +634,88 @@ class SlidingWindowArtifact:
         )
         S = S.reshape(T, G, K)
         partial = partial.reshape(T * t, K)
-        # exclusive across-tile scan; laid out scan-axis-last (cumsum
-        # along a large-stride leading axis is ~30x slower on TPU)
-        cum = jnp.cumsum(S.reshape(T, G * K).T, axis=1)
-        carry = cum.T.reshape(T, G, K) - S
         tile_of = jnp.arange(T * t, dtype=jnp.int32) // t
-        flat = carry.reshape(T * G, K)
-        R = flat[tile_of * G + m_code] + partial
-        win = R[m_arr]  # [N, K]: windowed sums at each concat arrival
+
+        def carried(S_, partial_):
+            # exclusive across-tile scan; laid out scan-axis-last
+            # (cumsum along a large-stride leading axis is ~30x slower
+            # on TPU); per concat-arrival windowed totals
+            Kx = S_.shape[-1]
+            cum = jnp.cumsum(S_.reshape(T, G * Kx).T, axis=1)
+            carry = cum.T.reshape(T, G, Kx) - S_
+            flat = carry.reshape(T * G, Kx)
+            R = flat[tile_of * G + m_code] + partial_
+            return R[m_arr]
+
+        int_set = set(int_planes)
+        f_order = [k for k in range(K) if k not in int_set]
+        win_f = carried(S[..., f_order], partial[:, f_order])
+        win_i = None
+        if int_planes:
+            # digit planes accumulate in MODULAR int32 (f32 tile sums
+            # are exact below 2^24; the running totals are not)
+            win_i = carried(
+                jnp.round(S[..., int_planes]).astype(jnp.int32),
+                jnp.round(partial[:, int_planes]).astype(jnp.int32),
+            )
+
+        def wcol(name):
+            k = vmap[name]
+            if k in int_set:
+                return win_i[:, int_planes.index(k)]
+            return win_f[:, f_order.index(k)]
+
+        def int_sum_of(j):
+            return (
+                wcol(f"s{j}:0")
+                + (wcol(f"s{j}:1") << 11)
+                + (wcol(f"s{j}:2") << 22)
+            )
 
         def unsort(concat_vals, dtype):
             batch_vals = concat_vals[C + jnp.clip(rank, 0)]
             return jnp.where(mask, batch_vals, 0).astype(dtype)
 
-        cnt = win[:, vmap["cnt"]]
+        cnt = wcol("cnt")
+        minmax = [a for a in self.aggs if a.kind in ("min", "max")]
+        ext = (
+            self._blocked_extrema(
+                minmax, ring, codes, live, env, compact, cnt, N
+            )
+            if minmax
+            else {}
+        )
         for agg in self.aggs:
             if agg.kind == "count":
                 rows = cnt
+            elif agg.kind in ("min", "max"):
+                rows = ext[(agg.kind, agg.arg_idx)]
             elif agg.kind == "sum":
-                rows = win[:, vmap[f"s{agg.arg_idx}"]]
-                if not jnp.issubdtype(
-                    agg.out_type.device_dtype, jnp.floating
-                ):
-                    rows = jnp.round(rows)
+                if agg.arg_idx in int_sum:
+                    rows = int_sum_of(agg.arg_idx)
+                else:
+                    rows = wcol(f"s{agg.arg_idx}")
+                    if not jnp.issubdtype(
+                        agg.out_type.device_dtype, jnp.floating
+                    ):
+                        rows = jnp.round(rows)
             elif agg.kind == "avg":
-                rows = win[:, vmap[f"s{agg.arg_idx}"]] / jnp.maximum(
-                    cnt, 1.0
+                num = (
+                    int_sum_of(agg.arg_idx).astype(jnp.float32)
+                    if agg.arg_idx in int_sum
+                    else wcol(f"s{agg.arg_idx}")
                 )
+                rows = num / jnp.maximum(cnt, 1.0)
             else:  # stddev
                 c_ = jnp.maximum(cnt, 1.0)
-                mean = win[:, vmap[f"s{agg.arg_idx}"]] / c_
+                mean = (
+                    int_sum_of(agg.arg_idx).astype(jnp.float32)
+                    if agg.arg_idx in int_sum
+                    else wcol(f"s{agg.arg_idx}")
+                ) / c_
                 rows = jnp.sqrt(
                     jnp.maximum(
-                        win[:, vmap[f"q{agg.arg_idx}"]] / c_
-                        - mean * mean,
+                        wcol(f"q{agg.arg_idx}") / c_ - mean * mean,
                         0.0,
                     )
                 )
@@ -707,245 +766,69 @@ class SlidingWindowArtifact:
         }
         return new_state, (out_mask, tape.ts, cols)
 
-    def _step_prefix(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
-        """Sliding length-window aggregation as a difference of per-group
-        running sums over a merged arrival/expiry event sequence.
-
-        Window semantics (identical to the matrix path / Siddhi): the
-        window at event k holds the last C *matching* events up to and
-        including k; group-by aggregates over the window members of k's
-        group. Each arrival at compacted position p contributes +v, and
-        expires (-v) at position p+C; the per-group running sum of the
-        merged sequence, sampled at k's arrival, is exactly the windowed
-        aggregate. One stable sort groups the sequence; segmented scans
-        do the rest.
-        """
-        env: ColumnEnv = dict(tape.cols)
-        mask = tape.valid & (tape.stream == self.stream_code)
-        for f in self.filter_fns:
-            mask = mask & f(env)
-        mask = mask & state["enabled"]
-        E = tape.capacity
-        C = self.capacity
-        ring = state["ring"]
-
-        order = jnp.argsort(jnp.logical_not(mask))  # matching first, stable
-        M = mask.sum()
-        rank = jnp.cumsum(mask) - 1
-
-        def cat(ring_col, col):
-            col = jnp.broadcast_to(jnp.asarray(col), (E,))
-            return jnp.concatenate(
-                [ring_col, col[order].astype(ring_col.dtype)]
-            )
-
-        c_cols: Dict[str, jnp.ndarray] = {}
-        for j, fn in enumerate(self.arg_fns):
-            c_cols[f"a{j}"] = cat(ring[f"a{j}"], fn(env))
-        for j, fn in enumerate(self.group_fns):
-            c_cols[f"g{j}"] = cat(ring[f"g{j}"], fn(env))
-        ts_col = env[self.ts_key] if self.ts_key else tape.ts
-        c_cols["ts"] = cat(ring["ts"], ts_col)
-        cval = jnp.concatenate(
-            [ring["valid"], jnp.arange(E) < M]
-        )
-        N = C + E
-
-        # merged sequence: N arrivals (+) then N expiries (-), each expiry
-        # ordered BEFORE any arrival at its position. Length windows expire
-        # C events later ((k-C, k]); time windows expire at the first
-        # position whose timestamp reaches ts + span (ts > ts_k - span
-        # membership, searched over running-max timestamps so disordered
-        # stragglers evict conservatively instead of corrupting the scan)
+    def _blocked_extrema(
+        self, minmax, ring, codes, live, env, compact, cnt, N
+    ) -> Dict:
+        """min/max for blocked LENGTH windows: FIFO expiry makes a
+        window's live members the LAST cnt same-group arrivals — a
+        contiguous range after a group-major (position-stable,
+        invalid-last) ordering — answered by a sparse table: log-depth
+        build, two gathers per arrival. The multi-key stable sorts of
+        the retired prefix path collapse to ONE argsort on a composite
+        (dense group code, position) key."""
         pos = jnp.arange(N, dtype=jnp.int32)
-        if self.window_mode == "length":
-            exp_pos = pos + C
-        else:
-            # saturating add: ts + span can overflow int32 near the
-            # engine's relative-timestamp limit, which would wrap the
-            # expiry target negative and self-cancel the event
-            ts_c = c_cols["ts"].astype(jnp.int32)
-            mono = lax.cummax(ts_c)
-            tgt = ts_c + jnp.int32(self.time_ms)
-            tgt = jnp.where(
-                tgt < ts_c, jnp.int32(2 ** 31 - 1), tgt
+        # concat order IS position order, so a STABLE sort by (invalid-
+        # last, group code) alone yields group-major position-stable
+        # order — one int32 sort, no composite key
+        key = jnp.where(live, codes, jnp.int32(2 ** 31 - 1))
+        ao = jnp.argsort(key, stable=True)
+        rmq_rank = jnp.zeros(N, jnp.int32).at[ao].set(pos)
+        cnt_q = jnp.maximum(cnt.astype(jnp.int32), 1)
+        levels = max(1, int(np.ceil(np.log2(max(N, 2)))))
+        lvl = jnp.zeros(N, jnp.int32)
+        for k in range(1, levels + 1):
+            lvl = lvl + (cnt_q >= (1 << k)).astype(jnp.int32)
+        pow_l = jnp.int32(1) << lvl
+        out: Dict = {}
+        for agg in minmax:
+            j = agg.arg_idx
+            rv = ring[f"a{j}"]
+            vals = jnp.concatenate(
+                [rv, compact(self.arg_fns[j](env), rv.dtype)]
             )
-            exp_pos = jnp.searchsorted(
-                mono, tgt, side="left"
-            ).astype(jnp.int32)
-            # defense for cross-batch stragglers (processing-time inputs
-            # regressing between polls): an event is always inside its
-            # own window, so its expiry can never precede its arrival
-            exp_pos = jnp.maximum(exp_pos, pos + 1)
-        key2 = jnp.concatenate([pos * 2 + 1, exp_pos * 2])
-        sign2 = jnp.concatenate(
-            [jnp.ones(N, jnp.int32), jnp.full(N, -1, jnp.int32)]
-        )
-        live2 = jnp.concatenate([cval, cval])
-
-        # stable group ordering: sort by position key, then stably by each
-        # group column (reversed), so entries of one group stay merged in
-        # position order
-        o = jnp.argsort(key2, stable=True)
-        for j in reversed(range(len(self.group_fns))):
-            g2 = jnp.concatenate([c_cols[f"g{j}"]] * 2)
-            o = o[jnp.argsort(g2[o], stable=True)]
-        seg_start = jnp.zeros(2 * N, dtype=bool).at[0].set(True)
-        for j in range(len(self.group_fns)):
-            g2 = jnp.concatenate([c_cols[f"g{j}"]] * 2)
-            go = g2[o]
-            seg_start = seg_start | jnp.concatenate(
-                [jnp.ones(1, bool), go[1:] != go[:-1]]
-            )
-        live_o = live2[o]
-
-        inv = jnp.zeros(2 * N, jnp.int32).at[o].set(
-            jnp.arange(2 * N, dtype=jnp.int32)
-        )
-        arrival_idx = inv[:N]  # where each arrival sits in sorted order
-
-        def windowed(vals):
-            # exact integer window sums stay integer; floats run in f32
-            sgn = sign2.astype(vals.dtype)
-            v2 = jnp.concatenate([vals] * 2)[o]
-            v2 = jnp.where(live_o, v2 * sgn[o], jnp.zeros((), vals.dtype))
-            cums = _seg_scan(seg_start, v2, lambda a, b: a + b)
-            return cums[arrival_idx]  # per concat-arrival window sum
-
-        stats: Dict[str, jnp.ndarray] = {}
-        has_minmax = any(a.kind in ("min", "max") for a in self.aggs)
-        need_count = has_minmax or any(
-            a.kind in ("count", "avg", "stddev") for a in self.aggs
-        )
-        if need_count:
-            stats["cnt"] = windowed(jnp.ones(N, jnp.int32))
-
-        rmq_rank = None
-        if has_minmax:
-            # min/max don't distribute over +/- — instead: after a
-            # group-major (valid-first, stable position-order) sort of
-            # the ARRIVALS, FIFO expiry makes a window's live members
-            # exactly the LAST cnt same-group arrivals, so the windowed
-            # extremum is a contiguous range query answered by a sparse
-            # table: log-depth build, two gathers per arrival.
-            ao = jnp.argsort(~cval, stable=True)
-            for j in reversed(range(len(self.group_fns))):
-                g = c_cols[f"g{j}"]
-                ao = ao[jnp.argsort(g[ao], stable=True)]
-            rmq_rank = (
-                jnp.zeros(N, jnp.int32)
-                .at[ao]
-                .set(jnp.arange(N, dtype=jnp.int32))
-            )
-            cnt_q = jnp.maximum(stats["cnt"].astype(jnp.int32), 1)
-            levels = max(1, int(np.ceil(np.log2(max(N, 2)))))
-            lvl = jnp.zeros(N, jnp.int32)
-            for k in range(1, levels + 1):
-                lvl = lvl + (cnt_q >= (1 << k)).astype(jnp.int32)
-            pow_l = (jnp.int32(1) << lvl)
-
-        def windowed_extremum(vals, combine, ident):
-            a_sorted = jnp.where(cval, vals, ident)[ao]
+            combine = jnp.minimum if agg.kind == "min" else jnp.maximum
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                ident = jnp.asarray(
+                    jnp.inf if agg.kind == "min" else -jnp.inf,
+                    vals.dtype,
+                )
+            else:
+                info = np.iinfo(np.dtype(vals.dtype))
+                ident = jnp.asarray(
+                    info.max if agg.kind == "min" else info.min,
+                    vals.dtype,
+                )
+            a_sorted = jnp.where(live, vals, ident)[ao]
             table = [a_sorted]
             for k in range(levels):
                 span = 1 << k
-                shifted = jnp.concatenate(
-                    [jnp.full(span, ident, a_sorted.dtype),
-                     table[-1][:-span]]
-                )
-                table.append(combine(table[-1], shifted))
-            flat = jnp.stack(table).reshape(-1)
-            r = rmq_rank
-            v1 = flat[lvl * N + r]
-            r2 = jnp.clip(r - cnt_q + pow_l, 0, N - 1)
-            v2 = flat[lvl * N + r2]
-            return combine(v1, v2)
-
-        for j in range(len(self.arg_fns)):
-            kinds = {
-                a.kind for a in self.aggs if a.arg_idx == j
-            }
-            if kinds & {"sum", "avg", "stddev"}:
-                a_col = c_cols[f"a{j}"]
-                if jnp.issubdtype(a_col.dtype, jnp.floating):
-                    a_col = a_col.astype(jnp.float32)
-                stats[f"s{j}"] = windowed(a_col)
-            if "stddev" in kinds:
-                v = c_cols[f"a{j}"].astype(jnp.float32)
-                stats[f"q{j}"] = windowed(v * v)
-            if "min" in kinds:
-                a_col = c_cols[f"a{j}"]
-                ident = (
-                    jnp.array(jnp.inf, a_col.dtype)
-                    if jnp.issubdtype(a_col.dtype, jnp.floating)
-                    else jnp.array(np.iinfo(a_col.dtype).max, a_col.dtype)
-                )
-                stats[f"mn{j}"] = windowed_extremum(
-                    a_col, jnp.minimum, ident
-                )
-            if "max" in kinds:
-                a_col = c_cols[f"a{j}"]
-                ident = (
-                    jnp.array(-jnp.inf, a_col.dtype)
-                    if jnp.issubdtype(a_col.dtype, jnp.floating)
-                    else jnp.array(np.iinfo(a_col.dtype).min, a_col.dtype)
-                )
-                stats[f"mx{j}"] = windowed_extremum(
-                    a_col, jnp.maximum, ident
-                )
-
-        def unsort(concat_vals, dtype):
-            # concat arrival i corresponds to compacted batch index i-C;
-            # map back to tape order through rank
-            batch_vals = concat_vals[C + jnp.clip(rank, 0)]
-            return jnp.where(mask, batch_vals, 0).astype(dtype)
-
-        slot_types: Dict[str, AttributeType] = {}
-        for agg in self.aggs:
-            if agg.kind == "count":
-                rows = stats["cnt"]
-            elif agg.kind == "sum":
-                rows = stats[f"s{agg.arg_idx}"]
-                if not jnp.issubdtype(
-                    agg.out_type.device_dtype, jnp.floating
-                ):
-                    rows = jnp.round(rows)
-            elif agg.kind == "avg":
-                rows = stats[f"s{agg.arg_idx}"] / jnp.maximum(
-                    stats["cnt"], 1.0
-                )
-            elif agg.kind == "min":
-                rows = stats[f"mn{agg.arg_idx}"]
-            elif agg.kind == "max":
-                rows = stats[f"mx{agg.arg_idx}"]
-            else:  # stddev
-                c = jnp.maximum(stats["cnt"], 1.0)
-                mean = stats[f"s{agg.arg_idx}"] / c
-                rows = jnp.sqrt(
-                    jnp.maximum(
-                        stats[f"q{agg.arg_idx}"] / c - mean * mean, 0.0
+                table.append(
+                    combine(
+                        table[-1],
+                        jnp.concatenate(
+                            [
+                                jnp.full(span, ident, a_sorted.dtype),
+                                table[-1][:-span],
+                            ]
+                        ),
                     )
                 )
-            env[agg.slot] = unsort(rows, agg.out_type.device_dtype)
-            slot_types[agg.slot] = agg.out_type
-
-        cols = tuple(
-            jnp.broadcast_to(jnp.asarray(p(env)), (E,))
-            for p in self.proj_fns
-        )
-        out_mask = mask
-        if self.having_fn is not None:
-            henv = dict(env)
-            for f, c in zip(self.output_schema.fields, cols):
-                henv[f"@out:{f.name}"] = c
-            out_mask = out_mask & self.having_fn(henv)
-
-        new_ring = {
-            k: lax.dynamic_slice(v, (M,), (C,)) for k, v in c_cols.items()
-        }
-        new_ring["valid"] = lax.dynamic_slice(cval, (M,), (C,))
-        new_state = {"enabled": state["enabled"], "ring": new_ring}
-        return new_state, (out_mask, tape.ts, cols)
+            flat = jnp.stack(table).reshape(-1)
+            v1 = flat[lvl * N + rmq_rank]
+            r2 = jnp.clip(rmq_rank - cnt_q + pow_l, 0, N - 1)
+            v2 = flat[lvl * N + r2]
+            out[(agg.kind, j)] = combine(v1, v2)
+        return out
 
     def _step_matrix(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)

@@ -137,13 +137,19 @@ class CronSchedule:
                 "#window.cron: specify day-of-month or day-of-week, "
                 "not both (use '?' for the other)"
             )
-        dow_raw = _parse_field(fields[5], 0, 7, _DOW_NAMES)
+        # Quartz day-of-week is 1=SUN..7=SAT. A BARE '0' is tolerated
+        # as Sunday (common habit), but 0 inside ranges/lists rejects
+        # loudly: silently reading '0-6' as unix-style would drop
+        # Saturday while Quartz-style reads it as an error — ambiguous
+        # either way, so it must not parse.
+        dow_text = ",".join(
+            "1" if part.strip() == "0" else part
+            for part in fields[5].split(",")
+        )
+        dow_raw = _parse_field(dow_text, 1, 7, _DOW_NAMES)
         dow = None
         if dow_raw is not None:
-            # Quartz 1=SUN..7=SAT (0 tolerated as SUN) -> 0=SUN..6=SAT
-            dow = np.unique(
-                np.where(dow_raw == 0, 0, (dow_raw - 1) % 7)
-            )
+            dow = np.unique((dow_raw - 1) % 7)
         return cls(
             expr=expr,
             sec=_parse_field(fields[0], 0, 59),

@@ -550,3 +550,67 @@ def test_blocked_window_group_code_projection_matches_eager():
     for (ke, se, ce), (ko, so, co) in zip(eager, opt):
         assert (ke, ce) == (ko, co)
         assert so == pytest.approx(se, rel=1e-5)
+
+
+def test_blocked_int_sum_exact_beyond_f32():
+    """Round-5: integer sums route through the blocked path via base-2^11
+    digit planes — totals past 2^24 (where a plain f32 pipeline loses
+    integer exactness) must stay bit-exact."""
+    import numpy as np
+
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.batch import EventBatch
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    schema = StreamSchema(
+        [("k", AttributeType.INT), ("v", AttributeType.INT),
+         ("timestamp", AttributeType.LONG)]
+    )
+    rng = np.random.default_rng(3)
+    n, C = 4000, 600
+    ks = rng.integers(0, 3, n).astype(np.int32)
+    # values near 2^24: a C=600 window sums to ~1e10 mod 2^32, far past
+    # exact f32 territory; +1 odd offsets catch low-bit loss
+    vs = (rng.integers(1 << 23, 1 << 25, n) * 2 + 1).astype(np.int32)
+    ts = 1000 + np.arange(n, dtype=np.int64)
+    batches = [
+        EventBatch(
+            "S", schema,
+            {"k": ks[s:s + 512], "v": vs[s:s + 512],
+             "timestamp": ts[s:s + 512]},
+            ts[s:s + 512],
+        )
+        for s in range(0, n, 512)
+    ]
+    cql = (
+        f"from S#window.length({C}) "
+        "select k, sum(v) as s, min(v) as mn, max(v) as mx "
+        "group by k insert into o"
+    )
+    plan = compile_plan(cql, {"S": schema})
+    art = plan.artifacts[0]
+    assert art._blocked(), "int sums + min/max must take the blocked path"
+    job = Job(
+        [plan], [BatchSource("S", schema, iter(batches))],
+        batch_size=512, time_mode="processing",
+    )
+    job.run()
+    rows = job.results("o")
+    assert len(rows) == n
+    from collections import deque
+    win = deque()
+    for i, (k, s, mn, mx) in enumerate(rows):
+        win.append(i)
+        if len(win) > C:
+            win.popleft()
+        member = [j for j in win if ks[j] == ks[i]]
+        exact = int(np.sum(vs[member], dtype=np.int64) & 0xFFFFFFFF)
+        if exact >= 1 << 31:
+            exact -= 1 << 32
+        assert k == ks[i]
+        assert s == exact, (i, s, exact)
+        assert mn == min(int(vs[j]) for j in member)
+        assert mx == max(int(vs[j]) for j in member)

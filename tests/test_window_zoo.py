@@ -428,3 +428,56 @@ def test_cron_window_oracle():
         want[(idv, c, round(s, 2))] += 1
     assert len(rows) == len(expect)
     assert got == want
+
+
+def test_cron_into_table_and_dow_edges():
+    from flink_siddhi_tpu.utils.cron import CronSchedule
+
+    # bare '0' tolerated as Sunday; 0 inside a range rejects loudly
+    sun = int(
+        np.datetime64("2023-11-19T12:00:00").astype(
+            "datetime64[ms]"
+        ).astype(np.int64)
+    )
+    assert CronSchedule.parse("0 0 12 ? * 0").next_fire(sun - 1) == sun
+    with pytest.raises(SiddhiQLError, match="range"):
+        CronSchedule.parse("0 0 12 ? * 0-6")
+
+    # cron window feeding a TABLE insert (the wrapper must forward the
+    # host-computed window-id column)
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.batch import EventBatch
+
+    t0 = 1_700_000_000_137
+    n = 40
+    ids = [i % 3 for i in range(n)]
+    prices = [float(i) for i in range(n)]
+    ts = (t0 + np.cumsum(np.full(n, 700))).tolist()
+    cql = (
+        "define table T (id int, s double); "
+        "from S#window.cron('0/2 * * * * ?') "
+        "select id, sum(price) as s group by id insert into T; "
+        "from S[id == 0] join T on S.id == T.id "
+        "select T.s as s insert into out"
+    )
+    batches = [
+        EventBatch(
+            "S", SCHEMA,
+            {
+                "id": np.asarray(ids[s:s + 8], np.int32),
+                "price": np.asarray(prices[s:s + 8], np.float64),
+                "timestamp": np.asarray(ts[s:s + 8], np.int64),
+            },
+            np.asarray(ts[s:s + 8], np.int64),
+        )
+        for s in range(0, n, 8)
+    ]
+    plan = compile_plan(cql, {"S": SCHEMA})
+    job = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(batches))],
+        batch_size=8, time_mode="processing",
+    )
+    job.run()  # must not KeyError on the cron wid column
+    assert job.results("out")
