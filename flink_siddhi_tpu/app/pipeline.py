@@ -32,6 +32,17 @@ _LOG = logging.getLogger(__name__)
 _TYPES = {t.name.lower(): t for t in AttributeType}
 
 
+def _parse_kafka_url(url: str) -> Tuple[str, str]:
+    """kafka://host:port/topic -> (host:port, topic)."""
+    rest = url[len("kafka://"):]
+    bootstrap, _, topic = rest.partition("/")
+    if not bootstrap or not topic:
+        raise ValueError(
+            f"kafka url must be kafka://host:port/topic, got {url!r}"
+        )
+    return bootstrap, topic
+
+
 @dataclass
 class PipelineConfig:
     """Everything needed to deploy one CEP job (the reference reads the
@@ -91,7 +102,20 @@ class CEPPipeline:
     def build(self) -> Job:
         cfg = self.config
         schema = cfg.schema()
-        if cfg.format == "csv":
+        if cfg.input_path.startswith("kafka://"):
+            # kafka://host:port/topic — the reference's deployable shape
+            # (FlinkKafkaConsumer010, CEPPipeline.scala:49-51); offsets
+            # checkpoint as the source position
+            from ..runtime.kafka import KafkaSource
+
+            bootstrap, topic = _parse_kafka_url(cfg.input_path)
+            src = KafkaSource(
+                cfg.stream_id, schema, bootstrap, topic,
+                fmt=cfg.format, delim=cfg.csv_delim,
+                ts_field=cfg.ts_field,
+                allowed_lateness_ms=cfg.allowed_lateness_ms,
+            )
+        elif cfg.format == "csv":
             src = CsvSource(
                 cfg.stream_id, schema, cfg.input_path,
                 delim=cfg.csv_delim, header=cfg.csv_header,
@@ -129,6 +153,21 @@ class CEPPipeline:
         cfg = self.config
         import sys
 
+        if cfg.output_path.startswith("kafka://"):
+            # kafka://host:port/topic egress (FlinkKafkaProducer010,
+            # CEPPipeline.scala:53-56): one JSON object per emitted row
+            from ..runtime.kafka import KafkaSink
+
+            bootstrap, topic = _parse_kafka_url(cfg.output_path)
+            self._kafka_sinks = []
+            for out_stream, schemas in plan.output_streams().items():
+                sink = KafkaSink(
+                    bootstrap, topic, list(schemas[0].field_names),
+                    stream_id=out_stream,
+                )
+                self._kafka_sinks.append(sink)
+                job.add_sink(out_stream, sink)
+            return
         if self._out is None or getattr(self._out, "closed", False):
             self._out = (
                 sys.stdout
@@ -169,6 +208,7 @@ class CEPPipeline:
                     "pipeline failed; restarting in %.1fs (%d attempts "
                     "left)", cfg.restart_delay_s, attempts_left,
                 )
+                self._close_kafka()  # each attempt builds fresh clients
                 self._sleep(cfg.restart_delay_s)
         if self._out is not None and self.config.output_path != "-":
             self._out.flush()
@@ -186,14 +226,40 @@ class CEPPipeline:
             job.run_cycle()
             now = self._clock()
             if ckpt and now - last_ckpt >= cfg.checkpoint_interval_s:
+                # barrier order: surface every in-flight emission, THEN
+                # producer-flush, THEN commit source offsets — a crash
+                # anywhere in between replays input (at-least-once) but
+                # can never skip rows still sitting in a sink buffer
+                # (the role of Flink's checkpoint-barrier flush)
+                job.drain_outputs()
+                for sink in getattr(self, "_kafka_sinks", ()):
+                    sink.flush()
                 job.save_checkpoint(ckpt)
                 last_ckpt = now
         job.flush()
         job.drain_outputs()
+        for sink in getattr(self, "_kafka_sinks", ()):
+            sink.flush()
         if ckpt:
             job.save_checkpoint(ckpt)
 
+    def _close_kafka(self) -> None:
+        """Drop broker connections (failed attempt / shutdown) — the
+        restart loop builds fresh sources and sinks each time."""
+        for sink in getattr(self, "_kafka_sinks", ()):
+            try:
+                sink.client.close()
+            except Exception:
+                pass
+        self._kafka_sinks = []
+        if self.job is not None:
+            for src in self.job._sources:
+                client = getattr(src, "client", None)
+                if client is not None:
+                    client.close()
+
     def close(self) -> None:
+        self._close_kafka()
         if self._out is not None and self.config.output_path != "-":
             self._out.close()
             self._out = None

@@ -1,0 +1,178 @@
+"""In-process fake Kafka broker speaking the v0 wire protocol subset the
+engine's client uses (Metadata/ListOffsets/Fetch/Produce, MessageSet
+magic 0/1). Single node, in-memory logs, enough fidelity to test
+offset semantics: fetches honor offsets, produce appends and assigns
+base offsets, ListOffsets reports earliest/latest."""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Tuple
+
+from flink_siddhi_tpu.runtime.kafka import (
+    _Reader,
+    _Writer,
+    decode_message_set,
+    encode_message_set,
+)
+
+
+class FakeBroker:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        # (topic, partition) -> list of (ts, value)
+        self.logs: Dict[Tuple[str, int], List] = {}
+        self._lock = threading.Lock()
+        self._server = socket.create_server((host, port))
+        self._server.settimeout(0.2)
+        self.host, self.port = self._server.getsockname()[:2]
+        self._closed = False
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    @property
+    def bootstrap(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def create_topic(self, topic: str, partitions: int = 1) -> None:
+        with self._lock:
+            for p in range(partitions):
+                self.logs.setdefault((topic, p), [])
+
+    def append(self, topic: str, partition: int, values, ts_ms=0):
+        with self._lock:
+            log = self.logs[(topic, partition)]
+            for v in values:
+                if isinstance(v, str):
+                    v = v.encode()
+                log.append((ts_ms, v))
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+    # -- server loop ------------------------------------------------------
+    def _accept(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed:
+                head = b""
+                while len(head) < 4:
+                    chunk = conn.recv(4 - len(head))
+                    if not chunk:
+                        return
+                    head += chunk
+                (size,) = struct.unpack(">i", head)
+                data = bytearray()
+                while len(data) < size:
+                    chunk = conn.recv(min(1 << 16, size - len(data)))
+                    if not chunk:
+                        return
+                    data += chunk
+                resp = self._handle(bytes(data))
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+        finally:
+            conn.close()
+
+    def _handle(self, data: bytes) -> bytes:
+        r = _Reader(data)
+        api, version, corr = r.i16(), r.i16(), r.i32()
+        r.string()  # client_id
+        w = _Writer().i32(corr)
+        if api == 3:  # Metadata v0
+            n = r.i32()
+            topics = [r.string() for _ in range(n)]
+            with self._lock:
+                if not topics:
+                    topics = sorted({t for t, _ in self.logs})
+                w.i32(1).i32(0).string(self.host).i32(self.port)
+                w.i32(len(topics))
+                for t in topics:
+                    parts = sorted(
+                        p for (tt, p) in self.logs if tt == t
+                    )
+                    w.i16(0 if parts else 3).string(t)
+                    w.i32(len(parts))
+                    for p in parts:
+                        w.i16(0).i32(p).i32(0)
+                        w.i32(1).i32(0)  # replicas [0]
+                        w.i32(1).i32(0)  # isr [0]
+        elif api == 2:  # ListOffsets v0
+            r.i32()  # replica
+            w.i32(r_topics := r.i32())
+            for _ in range(r_topics):
+                t = r.string()
+                np_ = r.i32()
+                w.string(t).i32(np_)
+                for _ in range(np_):
+                    pid, time_, _maxn = r.i32(), r.i64(), r.i32()
+                    with self._lock:
+                        log = self.logs.get((t, pid))
+                    if log is None:
+                        w.i32(pid).i16(3).i32(0)
+                        continue
+                    off = 0 if time_ == -2 else len(log)
+                    w.i32(pid).i16(0).i32(1).i64(off)
+        elif api == 1:  # Fetch v0
+            r.i32()
+            r.i32()
+            r.i32()  # replica, max_wait, min_bytes
+            nt = r.i32()
+            w.i32(nt)
+            for _ in range(nt):
+                t = r.string()
+                np_ = r.i32()
+                w.string(t).i32(np_)
+                for _ in range(np_):
+                    pid, off, maxb = r.i32(), r.i64(), r.i32()
+                    with self._lock:
+                        log = list(self.logs.get((t, pid), ()))
+                    hw = len(log)
+                    mset = b""
+                    size = 0
+                    o = off
+                    while o < hw and size < maxb:
+                        ts, v = log[o]
+                        one = encode_message_set([v], ts_ms=ts)
+                        # stamp the real offset into the entry header
+                        one = struct.pack(">q", o) + one[8:]
+                        mset += one
+                        size += len(one)
+                        o += 1
+                    w.i32(pid).i16(0).i64(hw).bytes_(mset)
+        elif api == 0:  # Produce v0
+            r.i16()
+            r.i32()  # acks, timeout
+            nt = r.i32()
+            w.i32(nt)
+            for _ in range(nt):
+                t = r.string()
+                np_ = r.i32()
+                w.string(t).i32(np_)
+                for _ in range(np_):
+                    pid = r.i32()
+                    mset = r.bytes_() or b""
+                    msgs = decode_message_set(mset)
+                    with self._lock:
+                        log = self.logs.setdefault((t, pid), [])
+                        base = len(log)
+                        for _off, ts, _k, v in msgs:
+                            log.append((ts or 0, v))
+                    w.i32(pid).i16(0).i64(base)
+        else:
+            raise AssertionError(f"fake broker: unsupported api {api}")
+        return w.done()

@@ -284,3 +284,131 @@ def test_control_service_rest_roundtrip(tmp_path):
         assert len(job.results("base")) > before
     finally:
         svc.stop()
+
+
+# -- round-5: Kafka-protocol source/sink (CEPPipeline.scala:49-56) -------
+
+def _kafka_events(n, start=0):
+    return [
+        json.dumps(
+            {
+                "id": (start + i) % 4,
+                "name": f"n{(start + i) % 3}",
+                "price": float(start + i),
+                "timestamp": 1000 + start + i,
+            }
+        )
+        for i in range(n)
+    ]
+
+
+def test_kafka_pipeline_roundtrip():
+    """kafka://in -> filter CEP -> kafka://out, the reference's only
+    deployable job shape, against an in-process v0-protocol broker."""
+    from tests.fake_kafka import FakeBroker
+
+    broker = FakeBroker()
+    try:
+        broker.create_topic("in")
+        broker.create_topic("out")
+        n = 200
+        broker.append("in", 0, _kafka_events(n))
+        cfg = PipelineConfig(
+            stream_id="inputStream",
+            fields=FIELDS,
+            cql=(
+                "from inputStream[id == 2] select name, price "
+                "insert into out"
+            ),
+            input_path=f"kafka://{broker.bootstrap}/in",
+            output_path=f"kafka://{broker.bootstrap}/out",
+            ts_field="timestamp",
+            time_mode="processing",
+            batch_size=64,
+        )
+        pipe = CEPPipeline(cfg)
+        job = pipe.build()
+        src = job._sources[0]
+        while job.processed_events < n:
+            job.run_cycle()
+        src.close()
+        while not job.finished:
+            job.run_cycle()
+        job.flush()
+        job.drain_outputs()
+        for sink in pipe._kafka_sinks:
+            sink.flush()
+        out_rows = [
+            json.loads(v.decode())
+            for _, v in broker.logs[("out", 0)]
+        ]
+        assert len(out_rows) == n // 4
+        assert all(r["stream"] == "out" for r in out_rows)
+        assert [r["name"] for r in out_rows] == [
+            f"n{i % 3}" for i in range(2, n, 4)
+        ]
+        assert [r["price"] for r in out_rows] == [
+            float(i) for i in range(2, n, 4)
+        ]
+    finally:
+        broker.close()
+
+
+def test_kafka_offsets_resume_across_restart(tmp_path):
+    """Offsets are checkpointed source positions: a job restarted from
+    a checkpoint resumes fetching exactly where the snapshot was taken
+    — every event processed exactly once across the two runs."""
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.kafka import KafkaSource
+    from tests.fake_kafka import FakeBroker
+
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t")
+        broker.append("t", 0, _kafka_events(100))
+        schema = PipelineConfig(
+            stream_id="S", fields=FIELDS, cql="", input_path="x",
+            output_path="x",
+        ).schema()
+        cql = "from S select id, price insert into o"
+        seen = []
+
+        def build_job():
+            src = KafkaSource(
+                "S", schema, broker.bootstrap, "t",
+                ts_field="timestamp",
+            )
+            plan = compile_plan(cql, {"S": schema})
+            job = Job(
+                [plan], [src], batch_size=32,
+                time_mode="processing", retain_results=False,
+            )
+            job.add_sink("o", lambda ts, row: seen.append(row))
+            return job, src
+
+        ckpt = str(tmp_path / "ckpt")
+        job1, src1 = build_job()
+        while job1.processed_events < 48:
+            job1.run_cycle()
+        job1.save_checkpoint(ckpt)
+        taken_at = len(seen)
+        # events appended after the snapshot belong to the next run
+        broker.append("t", 0, _kafka_events(40, start=100))
+        # simulate the failure: everything after the checkpoint is lost
+        del seen[taken_at:]
+
+        job2, src2 = build_job()
+        job2.restore(ckpt)
+        assert src2.offsets == src1.offsets  # resumed, not re-read
+        src2.close()
+        while not job2.finished:
+            job2.run_cycle()
+        job2.flush()
+        job2.drain_outputs()
+        # exactly once: 140 events total, no duplicates, no gaps
+        assert len(seen) == 140
+        prices = sorted(p for _, p in seen)
+        assert prices == [float(i) for i in range(140)]
+    finally:
+        broker.close()
