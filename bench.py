@@ -325,6 +325,11 @@ def main():
     # (visibility) latency from phase 1 instead.
     measure_latency = config in ("headline", "pattern2", "filter")
     if measure_latency:
+        # the floor every ingest->visibility sample pays on a tunneled
+        # device: one dispatch round + one drain fetch, each >= 1 RTT.
+        # Printed so the p99 claim is checkable against the tunnel's
+        # OWN tail (shared link: its p99 is many x its p50)
+        s_a = _measure_rtt()
         # offered load: capped at 1M ev/s (~2x the measured single-core
         # baseline's throughput) and at half the full-throttle rate —
         # the sink path (data drains over a slow d2h tunnel + host
@@ -334,8 +339,12 @@ def main():
         # not an engine property
         lat_rate = min(0.5 * ev_per_sec, 1_000_000.0)
         lat_rate = float(os.environ.get("BENCH_LAT_RATE", lat_rate))
-        lat = _latency_phase(config, lat_rate)
+        lat, phases = _latency_phase(config, lat_rate)
         if lat is not None:
+            # RTT again AFTER the phase: the shared tunnel drifts on
+            # minute scales, so the floor brackets the measurement
+            s_b = _measure_rtt()
+            rtt = s_a + s_b
             out["p99_match_latency_ms"] = round(
                 1e3 * float(np.percentile(lat, 99)), 1
             )
@@ -343,6 +352,67 @@ def main():
                 1e3 * float(np.percentile(lat, 50)), 1
             )
             out["latency_load_events_per_sec"] = round(lat_rate)
+            # the checkable decomposition: a sample's floor is one
+            # dispatch round + one drain fetch (>= 2 tunnel RTTs) +
+            # drain-interval staleness; p99-vs-floor uses the TUNNEL's
+            # own p99 because the tail of a shared link is the tail of
+            # every fetch that rides it
+            floor50 = 2 * float(np.percentile(rtt, 50)) * 1e3
+            floor99 = 2 * float(np.percentile(rtt, 99)) * 1e3
+            out["latency_breakdown"] = {
+                "tunnel_rtt_p50_ms": round(
+                    1e3 * float(np.percentile(rtt, 50)), 1
+                ),
+                "tunnel_rtt_p99_ms": round(
+                    1e3 * float(np.percentile(rtt, 99)), 1
+                ),
+                "drain_p50_ms": phases.get("drain_p50_ms"),
+                "drain_p99_ms": phases.get("drain_p99_ms"),
+                "drain_wait_ready_p50_ms": phases.get(
+                    "drain_wait_ready_p50_ms"
+                ),
+                "drain_queue_p50_ms": phases.get("drain_queue_p50_ms"),
+                "drain_fetch_p50_ms": phases.get("drain_fetch_p50_ms"),
+                "drain_emit_lag_p50_ms": phases.get(
+                    "drain_emit_lag_p50_ms"
+                ),
+                "drain_interval_ms": phases.get("drain_interval_ms"),
+                "floor_p50_ms": round(
+                    floor50 + phases.get("drain_interval_ms", 0.0), 1
+                ),
+                "floor_p99_ms": round(
+                    floor99 + phases.get("drain_interval_ms", 0.0), 1
+                ),
+                "p99_vs_floor": round(
+                    out["p99_match_latency_ms"]
+                    / max(
+                        floor99 + phases.get("drain_interval_ms", 0.0),
+                        1e-6,
+                    ),
+                    2,
+                ),
+            }
+            # the floor the p99 ACTUALLY stands on: the measured p99 of
+            # the drain's own transport legs (readiness RTT + d2h
+            # fetch) + one dispatch RTT + interval staleness — every
+            # term printed above, every term a raw tunnel measurement
+            tr99 = phases.get("transport_p99_ms")
+            if tr99 is not None:
+                tfloor = (
+                    tr99
+                    + float(np.percentile(rtt, 50)) * 1e3
+                    + phases.get("drain_interval_ms", 0.0)
+                )
+                out["latency_breakdown"]["transport_p99_ms"] = tr99
+                out["latency_breakdown"]["transport_floor_p99_ms"] = (
+                    round(tfloor, 1)
+                )
+                out["latency_breakdown"]["p99_vs_transport_floor"] = (
+                    round(
+                        out["p99_match_latency_ms"] / max(tfloor, 1e-6),
+                        2,
+                    )
+                )
     elif job.drain_latencies:
         dl = job.drain_latencies
         out["p99_visibility_latency_ms"] = round(
@@ -352,6 +422,25 @@ def main():
             1e3 * float(np.percentile(dl, 50)) + job.drain_interval_ms, 1
         )
     print(json.dumps(out))
+
+
+def _measure_rtt(n=40):
+    """The tunnel's raw host->device->host round-trip distribution,
+    measured with a minimal transfer + sync (the latency phase's floor:
+    every match needs >= 1 dispatch round + 1 drain fetch). Returns
+    (p50_ms, p99_ms, samples)."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.int32)
+    np.asarray(f(x))  # compile + connection warm
+    samples = []
+    for i in range(n):
+        t0 = time.perf_counter()
+        np.asarray(f(jnp.full(8, i, jnp.int32)))
+        samples.append(time.perf_counter() - t0)
+    return samples
 
 
 class _PacedSource:
@@ -395,9 +484,10 @@ class _PacedSource:
 
 def _latency_phase(config, rate):
     """Steady-state ingest->sink latency at the given offered load.
-    Returns per-batch latency samples (seconds), middle 80% of the run."""
+    Returns (per-batch latency samples [s] from the middle 80% of the
+    run, per-phase breakdown dict)."""
     if rate <= 0:
-        return None
+        return None, {}
     # power-of-two micro-batch so catch-up concats (2x, 4x) land on
     # precompiled tape shapes instead of triggering mid-run compiles.
     # Sized so ONE tunnel round trip (~100 ms — every dispatch pays it
@@ -409,10 +499,13 @@ def _latency_phase(config, rate):
     n_batches = max(int(seconds / period), 10)
     job = build_job(config, m * n_batches, m)
     # each data drain costs ~one d2h round trip that serializes with the
-    # pipeline; 150 ms balances staleness against that toll
+    # pipeline; drains are flow-controlled (skipped while one is in
+    # flight), so a short interval bounds staleness without piling
+    # fetches onto the tunnel
     job.drain_interval_ms = float(
-        os.environ.get("BENCH_LAT_DRAIN_MS", 150.0)
+        os.environ.get("BENCH_LAT_DRAIN_MS", 60.0)
     )
+    job.record_drain_latency = True
     # re-source with the paced release schedule
     src = job._sources[0]
     batches = []
@@ -475,12 +568,37 @@ def _latency_phase(config, rate):
         else:
             time.sleep(0.002)
     job.flush()
+    phases = {"drain_interval_ms": job.drain_interval_ms}
+    if job.drain_latencies:
+        dl = job.drain_latencies
+        phases["drain_p50_ms"] = round(
+            1e3 * float(np.percentile(dl, 50)), 1
+        )
+        phases["drain_p99_ms"] = round(
+            1e3 * float(np.percentile(dl, 99)), 1
+        )
+    if job.drain_stages:
+        for key in ("wait_ready", "queue", "fetch", "emit_lag"):
+            vals = [s[key] for s in job.drain_stages]
+            phases[f"drain_{key}_p50_ms"] = round(
+                1e3 * float(np.percentile(vals, 50)), 1
+            )
+        # transport tail: readiness round trip + d2h fetch are raw
+        # tunnel operations; their measured p99 is the floor the match
+        # p99 actually stands on (the 8-sample RTT probe undersamples
+        # the shared link's minute-scale stalls)
+        transport = [
+            s["wait_ready"] + s["fetch"] for s in job.drain_stages
+        ]
+        phases["transport_p99_ms"] = round(
+            1e3 * float(np.percentile(transport, 99)), 1
+        )
     if not lat:
-        return None
+        return None, phases
     lo = warm_n + 0.1 * (seen - warm_n)  # steady-state window
     hi = warm_n + 0.9 * (seen - warm_n)
     samples = [t for t, b in lat if lo <= b <= hi]
-    return samples or [t for t, _ in lat]
+    return samples or [t for t, _ in lat], phases
 
 
 if __name__ == "__main__":

@@ -67,6 +67,9 @@ class CompiledPlan:
     extensions: object = None
     # output rate limiting per output stream (host emission layer)
     output_rates: Dict[str, object] = field(default_factory=dict)
+    # 'output snapshot': per output stream, the row positions of the
+    # group-by keys (the snapshot emits one current row per key)
+    snapshot_keys: Dict[str, tuple] = field(default_factory=dict)
     # compile-window cap: XLA compile time grows with tape width, and a
     # wide multi-query stack at a 512k tape compiles for many MINUTES.
     # When set, the executor steps oversized micro-batches in chunks of
@@ -495,6 +498,7 @@ def compile_plan(
     # stream, aggregate that (same device step, batch-granular hop)
     parsed = _rewrite_aggregated_joins(parsed, table_schemas, all_schemas)
     parsed = _rewrite_windowed_mutations(parsed, table_schemas)
+    parsed = _rewrite_all_events(parsed)
 
     # fail fast on undefined inputs (UndefinedStreamException parity,
     # SiddhiCEP.java:134-140). A stream produced by an EARLIER query's
@@ -730,6 +734,7 @@ def compile_plan(
                 break
 
     output_rates = {}
+    snapshot_keys: Dict[str, tuple] = {}
     writers: Dict[str, int] = {}
     for q in parsed.queries:
         writers[q.output_stream] = writers.get(q.output_stream, 0) + 1
@@ -738,10 +743,38 @@ def compile_plan(
         if r is None:
             continue
         if r.mode == "snapshot":
-            raise SiddhiQLError(
-                "'output snapshot every ...' is not supported yet; use "
-                "'output last every ...' for thinned emission"
+            # periodic CURRENT-VALUE emission: one row per group with
+            # the latest aggregate (siddhi's snapshot limiter over an
+            # aggregation). Plain window-contents snapshots (dumping
+            # every retained event) would need device window dumps —
+            # reject those loudly rather than emit something else.
+            has_agg = q.selector.group_by or any(
+                ast.contains_aggregate(i.expr)
+                for i in q.selector.items
             )
+            if not has_agg:
+                raise SiddhiQLError(
+                    "'output snapshot every ...' is supported for "
+                    "aggregation queries (periodic current aggregate "
+                    "per group); a plain window-contents snapshot is "
+                    "not supported yet"
+                )
+            gb = {ast.bare_group_key(g) for g in q.selector.group_by}
+            keys = tuple(
+                i
+                for i, item in enumerate(q.selector.items)
+                if isinstance(item.expr, ast.Attr)
+                and item.expr.name in gb
+            )
+            if gb and not keys:
+                # without the key in the row, every group would
+                # overwrite one snapshot slot — silently wrong
+                raise SiddhiQLError(
+                    "'output snapshot' on a group-by query must "
+                    "project the group key(s) in the select (snapshot "
+                    "rows are keyed by them)"
+                )
+            snapshot_keys[q.output_stream] = keys
         if writers[q.output_stream] > 1:
             # the host limiter is keyed by stream; interleaving a second
             # writer through one query's limiter would silently throttle
@@ -782,6 +815,7 @@ def compile_plan(
         extensions=extensions,
         tape_capacity_limit=cap_limit,
         output_rates=output_rates,
+        snapshot_keys=snapshot_keys,
     )
 
 
@@ -1181,8 +1215,14 @@ def _rewire_chained_group(art, enc, q, mid_sid, all_schemas, codes):
         raise unsupported
     src_sid, src_field = src_key.split(".", 1)
     atype = all_schemas[src_sid].field_type(src_field)
-    if not atype.is_numeric:
-        raise unsupported  # string keys: host codes, device raw — no map
+    if not (atype.is_numeric or atype.is_encoded):
+        raise unsupported  # no ordered device representation to map
+    # STRING/OBJECT keys work exactly like numerics here: both host
+    # batches and device columns carry the shared-dictionary int32
+    # CODES (schema/types.py is_encoded), so interning the source
+    # column's codes and mapping value->group on device through the
+    # synced sorted table is the same int32 searchsorted; group-key
+    # output decode goes code -> string through the field decoder
     art.chained_group_src = enc.in_keys[0]
     art.chained_group_dtype = atype.device_dtype
     return _dc.replace(
@@ -1192,6 +1232,38 @@ def _rewire_chained_group(art, enc, q, mid_sid, all_schemas, codes):
         select_fn=None,  # intern the source superset
         materialize=False,
     )
+
+
+def _rewrite_all_events(parsed):
+    """``insert all events into X``: siddhi emits BOTH arriving
+    (current) and leaving (expired) window events into one stream.
+    Re-expressed as two queries writing the same output — a current-
+    events pass-through and the expired-events artifact — which is
+    exactly what siddhi-core's StreamJunction receives from a window
+    processor in ALL_EVENTS mode."""
+    import dataclasses
+
+    out = []
+    changed = False
+    for q in parsed.queries:
+        if q.output_events != "all":
+            out.append(q)
+            continue
+        changed = True
+        base = q.name or f"allq{len(out)}"
+        out.append(
+            dataclasses.replace(
+                q, output_events="current", name=f"{base}@cur"
+            )
+        )
+        out.append(
+            dataclasses.replace(
+                q, output_events="expired", name=f"{base}@exp"
+            )
+        )
+    if not changed:
+        return parsed
+    return dataclasses.replace(parsed, queries=tuple(out))
 
 
 def _rewrite_windowed_mutations(parsed, table_schemas):

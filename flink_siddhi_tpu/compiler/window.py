@@ -2251,11 +2251,9 @@ def compile_expired_window(
     from .config import DEFAULT_CONFIG
 
     config = config or DEFAULT_CONFIG
-    if q.output_events == "all":
-        raise SiddhiQLError(
-            "'insert all events into' is not supported yet; issue the "
-            "current-events and expired-events queries separately"
-        )
+    # 'all events' never reaches here: _rewrite_all_events (plan.py)
+    # splits it into a current-events query + this expired one
+    assert q.output_events == "expired", q.output_events
     inp = q.input
     if not isinstance(inp, ast.StreamInput) or not inp.windows:
         raise SiddhiQLError(

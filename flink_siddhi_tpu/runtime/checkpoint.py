@@ -112,6 +112,19 @@ def snapshot_job(job) -> Dict[str, Any]:
             "folded": dict(getattr(job, "_folded", {})),
             "enabled": dict(getattr(job, "_folded_enabled", {})),
         },
+        # output-rate limiter phase: events-mode chunk position and the
+        # buffered rows survive a restart, so a restored job emits at
+        # the same chunk boundaries as an uninterrupted run (ADVICE r4).
+        # Time-mode deadlines are monotonic-clock values and re-arm on
+        # restore (the interval restarts at resume).
+        "rate_limiters": {
+            sid: {
+                "count": lim.count,
+                "buf": list(lim.buf),
+                "snap": list(lim.cur.items()),
+            }
+            for sid, lim in getattr(job, "_rate_limiters", {}).items()
+        },
     }
 
 
@@ -226,6 +239,17 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
         load = getattr(src, "load_state_dict", None)
         if load is not None:
             load(sd)
+
+    # 5. output-rate limiter phase (time-mode deadlines re-arm)
+    for sid, d in snap.get("rate_limiters", {}).items():
+        lim = job._rate_limiters.get(sid)
+        if lim is not None:
+            lim.count = int(d["count"])
+            lim.buf = [tuple(r) for r in d["buf"]]
+            lim.cur = {
+                tuple(k): tuple(v) for k, v in d.get("snap", [])
+            }
+            lim.deadline = None
 
 
 def _check_compatible(ref, restored, plan_id: str) -> None:

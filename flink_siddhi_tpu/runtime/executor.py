@@ -142,16 +142,34 @@ class _OutputRateLimiter:
     limiters, applied where rows surface to collectors/sinks so thinned
     streams also skip the retention/callback cost."""
 
-    def __init__(self, rate) -> None:
-        self.mode = rate.mode  # 'events' | 'time'
+    def __init__(self, rate, snapshot_keys: tuple = ()) -> None:
+        self.mode = rate.mode  # 'events' | 'time' | 'snapshot'
         self.which = rate.which  # all | last | first
         self.n = max(int(rate.n_events), 1)
         self.ms = float(rate.ms)
         self.count = 0  # events-mode position within the chunk
         self.buf: List = []
         self.deadline: Optional[float] = None
+        # snapshot mode: latest row per group key (positions into the
+        # output row); emitted in full every interval
+        self.snapshot_keys = tuple(snapshot_keys or ())
+        self.cur: Dict = {}
 
     def feed(self, rows: List) -> List:
+        if self.mode == "snapshot":
+            # roll the interval BEFORE absorbing, as in time mode: rows
+            # arriving after a deadline belong to the new interval
+            now = time.monotonic()
+            if self.deadline is None:
+                self.deadline = now + self.ms / 1e3
+            flushed: List = []
+            if now >= self.deadline:
+                flushed = list(self.cur.values())
+                self.deadline = now + self.ms / 1e3
+            for r in rows:  # (rel_ts, row)
+                k = tuple(r[1][i] for i in self.snapshot_keys)
+                self.cur[k] = r
+            return flushed
         if self.mode == "events":
             out: List = []
             for r in rows:
@@ -198,6 +216,10 @@ class _OutputRateLimiter:
 
     def flush(self) -> List:
         """End of stream: pending buffered output surfaces."""
+        if self.mode == "snapshot":
+            out = list(self.cur.values())
+            self.cur = {}
+            return out
         if self.which == "first":
             self.buf = []
             return []
@@ -288,9 +310,14 @@ class Job:
         self._drain_hints: Dict[str, int] = {}
         # observability: when True, each drain's request->completion wall
         # time is appended here (visibility-latency reporting for jobs
-        # with no row consumers, where match latency can't be sampled)
+        # with no row consumers, where match latency can't be sampled),
+        # and drain_stages gets the per-stage decomposition:
+        # wait_ready (request -> packed array computed on device),
+        # queue (ready -> fetch thread picks it up),
+        # fetch (d2h transfer + host decode), total
         self.record_drain_latency = False
         self.drain_latencies: List[float] = []
+        self.drain_stages: List[Dict[str, float]] = []
 
 
     # -- plan management (dynamic control plane hooks) ----------------------
@@ -378,7 +405,9 @@ class Job:
         )
         self._plans[plan.plan_id] = rt
         for sid, rate in plan.output_rates.items():
-            self._rate_limiters[sid] = _OutputRateLimiter(rate)
+            self._rate_limiters[sid] = _OutputRateLimiter(
+                rate, plan.snapshot_keys.get(sid, ())
+            )
 
     # -- dynamic chain groups (recompile-free runtime adds) -----------------
     def _group_string_tables(self, plan, tpl) -> Dict:
@@ -674,15 +703,7 @@ class Job:
                 )
         # stream end: rate-limited output still buffered surfaces now
         for sid, limiter in self._rate_limiters.items():
-            pending = limiter.flush()
-            if pending:
-                for rt in self._plans.values():
-                    for schema in rt.plan.output_streams().get(sid, []):
-                        self._emit_rows(schema, pending, rate_limit=False)
-                        break
-                    else:
-                        continue
-                    break
+            self._emit_pending(sid, limiter.flush())
 
     _noop_jit = None
 
@@ -772,13 +793,17 @@ class Job:
         """Latency-bounding drain pass over plans someone observes
         (overridden by ShardedJob, whose drains are synchronous).
 
-        Flow control: a plan with a drain still in flight is skipped —
-        on a slow d2h tunnel, queueing drains faster than fetches
-        complete only grows a backlog whose depth becomes match latency.
-        Skipping keeps visibility latency ~= one fetch duration."""
+        Flow control: at most TWO drains in flight per plan. One is too
+        few — a drain pays a readiness round trip (the pack program
+        behind queued device work) and then a fetch round trip, and
+        serializing them makes the visibility cadence their SUM; with
+        two, drain k+1's readiness wait overlaps drain k's fetch, so
+        the cadence approaches one fetch duration. More than two only
+        grows a backlog whose depth becomes match latency on a slow
+        d2h tunnel."""
         for rt in self._plans.values():
             self._drain_poll(rt)
-            if rt.drain_q:
+            if len(rt.drain_q) >= 2:
                 continue
             if self._has_consumers(rt):
                 self._drain_request(rt)
@@ -897,9 +922,12 @@ class Job:
             )
             if not gate.is_ready():
                 break
+            entry["t_ready"] = time.monotonic()
+            entry["stages"] = {}
             entry["fut"] = self._fetch_pool.submit(
                 self._fetch_acc, rt, entry.pop("acc"),
                 entry.pop("packed"), entry.pop("width"),
+                entry["stages"],
             )
 
     @property
@@ -919,16 +947,21 @@ class Job:
         return pool
 
     @staticmethod
-    def _fetch_acc(rt: _PlanRuntime, acc: Dict, packed, width: int):
+    def _fetch_acc(rt: _PlanRuntime, acc: Dict, packed, width: int,
+                   stages: Optional[Dict] = None):
         """Fetch-thread body: the packed [meta | data-slice] array is
         already computed, so ONE asarray pays one d2h round trip for the
         whole drain; decode also happens here so the run loop only
         emits. Bucketed widths keep the pack program count to a handful
         of shapes (a distinct shape per drain would compile a fresh
         program every time, ~1s each on a tunneled device)."""
+        if stages is not None:
+            stages["t_fetch0"] = time.monotonic()
         a_count = max(len(rt.plan.artifacts), 1)
         if packed is None:  # no-consumer fast path: counts only
             meta = np.asarray(acc["meta"])
+            if stages is not None:
+                stages["t_fetch1"] = time.monotonic()
             return meta[0], meta[1], None
         arr = np.asarray(packed)
         meta = arr[: 2 * a_count].reshape(2, a_count)
@@ -952,6 +985,8 @@ class Job:
                 else None
             ),
         )
+        if stages is not None:
+            stages["t_fetch1"] = time.monotonic()
         return counts, overflow, decoded
 
     def _drain_poll(
@@ -981,8 +1016,21 @@ class Job:
             counts, overflow, decoded = fut.result()
             done_entry = rt.drain_q.popleft()
             if self.record_drain_latency:
-                self.drain_latencies.append(
-                    time.monotonic() - done_entry["t_req"]
+                now = time.monotonic()
+                self.drain_latencies.append(now - done_entry["t_req"])
+                st = done_entry.get("stages") or {}
+                t_req = done_entry["t_req"]
+                t_rdy = done_entry.get("t_ready", t_req)
+                t_f0 = st.get("t_fetch0", t_rdy)
+                t_f1 = st.get("t_fetch1", now)
+                self.drain_stages.append(
+                    {
+                        "wait_ready": t_rdy - t_req,
+                        "queue": t_f0 - t_rdy,
+                        "fetch": t_f1 - t_f0,
+                        "emit_lag": now - t_f1,
+                        "total": now - t_req,
+                    }
                 )
             for ai, a in enumerate(rt.plan.artifacts):
                 if overflow[ai] > 0:
@@ -991,6 +1039,22 @@ class Job:
                         "raise EngineConfig.acc_budget_bytes or drain "
                         "more often)", a.name, int(overflow[ai]),
                     )
+            # the only place the engine degrades instead of failing
+            # loudly: a lazy-projected value older than the ring budget
+            # decodes as None in user rows — surface it (round-5 verdict
+            # item 9), rate-limited to newly-missed counts
+            lazy = getattr(rt, "lazy", None)
+            if lazy is not None:
+                warned = getattr(rt, "_lazy_miss_warned", 0)
+                if lazy.missed > warned:
+                    _LOG.warning(
+                        "%s: %d lazy-projected values were evicted past "
+                        "the ring horizon and decoded as None (raise "
+                        "EngineConfig.lazy_ring_budget_bytes, or drain "
+                        "results more often)",
+                        rt.plan.plan_id, lazy.missed - warned,
+                    )
+                    rt._lazy_miss_warned = lazy.missed
             if decoded is not None:
                 for a in rt.plan.artifacts:
                     for schema, rows in decoded.get(a.name) or []:
@@ -1118,6 +1182,7 @@ class Job:
             # trip on the tunnel, and with no consumer there is no
             # visibility to bound — their capacity swaps below suffice.
             self._interval_drain()
+            self._poll_rate_limiters()
             self._last_full_drain = time.monotonic()
         if ready and self._cycles_since_drain >= min(
             self.drain_every_cycles,
@@ -1128,6 +1193,34 @@ class Job:
             self.drain_outputs(wait=False)
             self._cycles_since_drain = 0
         return total
+
+    def _poll_rate_limiters(self) -> None:
+        """Time-mode ``output ... every <duration>`` limiters emit on a
+        schedule, not only when new rows arrive for their stream
+        (siddhi's time-based limiters run off a scheduler thread;
+        ADVICE r4): buffered output whose interval elapsed surfaces
+        from the same interval-drain cadence that bounds visibility."""
+        for sid, limiter in self._rate_limiters.items():
+            if limiter.mode == "time":
+                if not limiter.buf:
+                    continue
+            elif limiter.mode == "snapshot":
+                if not limiter.cur:
+                    continue
+            else:
+                continue
+            self._emit_pending(sid, limiter.feed([]))
+
+    def _emit_pending(self, sid: str, pending: List) -> None:
+        """Emit limiter-released rows to ``sid``'s first output schema
+        (bypassing the limiter — these rows already passed it)."""
+        if not pending:
+            return
+        for rt in self._plans.values():
+            schemas = rt.plan.output_streams().get(sid)
+            if schemas:
+                self._emit_rows(schemas[0], pending, rate_limit=False)
+                return
 
     def _pull_control(self) -> None:
         for i, src in enumerate(self._control):

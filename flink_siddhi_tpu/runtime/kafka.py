@@ -164,7 +164,15 @@ def decode_message_set(
         r = _Reader(data[pos + 12 : pos + 12 + size])
         r.i32()  # crc (trusted transport; fake broker is in-process)
         magic = r.i8()
-        r.i8()  # attributes (no compression support)
+        attrs = r.i8()
+        if attrs & 0x07:
+            # a compressed wrapper message's value is an inner message
+            # set, not an event payload — decoding it as one would
+            # silently drop every record on the topic
+            raise KafkaError(
+                "compressed message sets are not supported; set the "
+                "producer's compression.type=none"
+            )
         ts = r.i64() if magic >= 1 else None
         key = r.bytes_()
         value = r.bytes_()
@@ -392,14 +400,7 @@ class KafkaSource(Source):
         allowed_lateness_ms: int = 0,
         client: Optional[KafkaClient] = None,
     ) -> None:
-        from ..native import (
-            KIND_BOOL,
-            KIND_DOUBLE,
-            KIND_INT,
-            KIND_STRING,
-            ColumnDecoder,
-        )
-        from ..schema.types import AttributeType
+        from .sources import make_column_decoder
 
         if fmt not in ("json", "csv"):
             raise ValueError(fmt)
@@ -435,22 +436,12 @@ class KafkaSource(Source):
         # unknown, which must read as "assume a backlog" (a close()
         # before the first fetch still drains the topic)
         self._hw: Dict[int, int] = {}
-        kind_of = {
-            AttributeType.INT: KIND_INT,
-            AttributeType.LONG: KIND_INT,
-            AttributeType.FLOAT: KIND_DOUBLE,
-            AttributeType.DOUBLE: KIND_DOUBLE,
-            AttributeType.BOOL: KIND_BOOL,
-            AttributeType.STRING: KIND_STRING,
-            AttributeType.OBJECT: KIND_STRING,
-        }
-        self._fields = [
-            (name, kind_of[atype], schema.string_tables.get(name))
-            for name, atype in zip(
-                schema.field_names, schema.field_types
-            )
-        ]
-        self._decoder = ColumnDecoder(self._fields)
+        self._fields, self._decoder = make_column_decoder(schema)
+        # timestamp basis, decided ONCE at the first consumed batch:
+        # 'field' (ts_field), 'message' (magic>=1 broker timestamps) or
+        # 'arrival'. Re-deciding per batch would let one magic-0
+        # message flip the basis mid-stream and wreck the watermark.
+        self._ts_basis = "field" if ts_field is not None else None
 
     def close(self) -> None:
         """Stop consuming after the current backlog drains."""
@@ -513,6 +504,8 @@ class KafkaSource(Source):
                 self.client.close()
                 return None, np.iinfo(np.int64).max, True
             return None, None, False
+        from .sources import decoded_columns
+
         data = b"\n".join(v.replace(b"\n", b" ") for v in values) + b"\n"
         if self._fmt == "json":
             cols, valid, n = self._decoder.decode_json(data, len(values))
@@ -520,16 +513,22 @@ class KafkaSource(Source):
             cols, valid, n = self._decoder.decode_csv(
                 data, len(values), self._delim
             )
-        columns: Dict[str, np.ndarray] = {}
-        for (name, _kind, table), arr in zip(self._fields, cols):
-            if table is not None:
-                columns[name] = arr.astype(np.int32, copy=False)
-            else:
-                atype = self.schema.field_type(name)
-                columns[name] = arr.astype(atype.host_dtype, copy=False)
-        if self._ts_field is not None:
+        columns = decoded_columns(self._fields, self.schema, cols)
+        if self._ts_basis is None:
+            self._ts_basis = (
+                "message"
+                if all(t is not None for t in msg_ts)
+                else "arrival"
+            )
+        if self._ts_basis == "field":
             ts = columns[self._ts_field].astype(np.int64)
-        elif all(t is not None for t in msg_ts):
+        elif self._ts_basis == "message":
+            if any(t is None for t in msg_ts):
+                raise KafkaError(
+                    f"{self.topic}: mixed message formats — some "
+                    "records lack broker timestamps; pass ts_field= "
+                    "to take event time from the payload instead"
+                )
             ts = np.asarray(msg_ts, dtype=np.int64)
         else:
             ts = self._arrival + np.arange(n, dtype=np.int64)
@@ -551,6 +550,7 @@ class KafkaSource(Source):
         return {
             "offsets": {str(p): o for p, o in self.offsets.items()},
             "arrival": self._arrival,
+            "ts_basis": self._ts_basis,
         }
 
     def load_state_dict(self, d: dict) -> None:
@@ -560,6 +560,8 @@ class KafkaSource(Source):
         self._fetch_pos = dict(self.offsets)
         self._buffer = []
         self._arrival = int(d.get("arrival", 0))
+        if d.get("ts_basis") is not None:
+            self._ts_basis = d["ts_basis"]
 
 
 class KafkaSink:

@@ -185,6 +185,48 @@ class CallbackSource(Source):
         return batch, wm, self._closed and not self._pending
 
 
+def make_column_decoder(schema: StreamSchema):
+    """Shared native-decoder setup for byte sources (file/socket/Kafka):
+    -> (fields, ColumnDecoder) where fields = [(name, kind, string
+    table-or-None)] in schema order."""
+    from ..native import (
+        KIND_BOOL,
+        KIND_DOUBLE,
+        KIND_INT,
+        KIND_STRING,
+        ColumnDecoder,
+    )
+    from ..schema.types import AttributeType
+
+    kind_of = {
+        AttributeType.INT: KIND_INT,
+        AttributeType.LONG: KIND_INT,
+        AttributeType.FLOAT: KIND_DOUBLE,
+        AttributeType.DOUBLE: KIND_DOUBLE,
+        AttributeType.BOOL: KIND_BOOL,
+        AttributeType.STRING: KIND_STRING,
+        AttributeType.OBJECT: KIND_STRING,
+    }
+    fields = [
+        (name, kind_of[atype], schema.string_tables.get(name))
+        for name, atype in zip(schema.field_names, schema.field_types)
+    ]
+    return fields, ColumnDecoder(fields)
+
+
+def decoded_columns(fields, schema: StreamSchema, cols):
+    """Decoder output arrays -> schema-typed host columns (string
+    fields keep their canonical int32 dictionary codes)."""
+    columns = {}
+    for (name, _kind, table), arr in zip(fields, cols):
+        if table is not None:
+            columns[name] = arr.astype(np.int32, copy=False)
+        else:
+            atype = schema.field_type(name)
+            columns[name] = arr.astype(atype.host_dtype, copy=False)
+    return columns
+
+
 class _DecodedLinesSource(Source):
     """Shared machinery for byte-stream sources decoded by the native
     columnar decoder (flink_siddhi_tpu/native): reads a chunk of lines,
@@ -210,15 +252,6 @@ class _DecodedLinesSource(Source):
         drop_invalid: bool = True,
         allowed_lateness_ms: int = 0,
     ) -> None:
-        from ..native import (
-            KIND_BOOL,
-            KIND_DOUBLE,
-            KIND_INT,
-            KIND_STRING,
-            ColumnDecoder,
-        )
-        from ..schema.types import AttributeType
-
         self.stream_id = stream_id
         self.schema = schema
         self._f = fileobj
@@ -229,26 +262,7 @@ class _DecodedLinesSource(Source):
         self._done = False
         self._arrival = 0
         self._lateness = int(allowed_lateness_ms)
-        kind_of = {
-            AttributeType.INT: KIND_INT,
-            AttributeType.LONG: KIND_INT,
-            AttributeType.FLOAT: KIND_DOUBLE,
-            AttributeType.DOUBLE: KIND_DOUBLE,
-            AttributeType.BOOL: KIND_BOOL,
-            AttributeType.STRING: KIND_STRING,
-            AttributeType.OBJECT: KIND_STRING,
-        }
-        self._fields = [
-            (
-                name,
-                kind_of[atype],
-                schema.string_tables.get(name),
-            )
-            for name, atype in zip(
-                schema.field_names, schema.field_types
-            )
-        ]
-        self._decoder = ColumnDecoder(self._fields)
+        self._fields, self._decoder = make_column_decoder(schema)
 
     def _decode(self, data: bytes, max_rows: int):
         raise NotImplementedError
@@ -289,15 +303,7 @@ class _DecodedLinesSource(Source):
             eof = False  # more data pending regardless of file state
         self._done = eof
         cols, valid, n = self._decode(data, n_lines)
-        columns: Dict[str, np.ndarray] = {}
-        for (name, kind, table), arr in zip(self._fields, cols):
-            if table is not None:  # string/object: canonical int32 codes
-                columns[name] = arr.astype(np.int32, copy=False)
-            else:
-                atype = self.schema.field_type(name)
-                columns[name] = arr.astype(
-                    atype.host_dtype, copy=False
-                )
+        columns = decoded_columns(self._fields, self.schema, cols)
         if self._ts_field is not None:
             ts = columns[self._ts_field].astype(np.int64)
         else:

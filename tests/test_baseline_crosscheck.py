@@ -1,7 +1,15 @@
 """Engine vs measured-baseline interpreter: the two implementations of
 the benchmark semantics (the vectorized device engine and the per-event
 Python reference) must agree on the SAME stream — this is what makes
-``vs_baseline`` an apples-to-apples ratio."""
+``vs_baseline`` an apples-to-apples ratio.
+
+Coverage: all FIVE bench configs. filter and headline additionally
+compare ROW CONTENTS + timestamps as sorted multisets (float fields at
+f32 tolerance — the device computes in f32, the interpreter in f64), so
+compensating row-level bugs cannot hide behind equal counts (ADVICE
+round 4). multiquery64 compares per-output-stream counts, pinning each
+of the 64 stacked queries individually.
+"""
 
 import numpy as np
 import pytest
@@ -27,11 +35,27 @@ def _schema():
     )
 
 
+def _norm_row(ts, row):
+    """f32-tolerant canonical form: the engine's DOUBLE columns compute
+    and ship as f32; compare at that precision."""
+    return (
+        int(ts),
+        tuple(
+            np.float32(v).item() if isinstance(v, float) else v
+            for v in row
+        ),
+    )
+
+
 @pytest.mark.parametrize(
-    "config", ["headline", "filter", "pattern2", "window_groupby"]
+    "config",
+    ["headline", "filter", "pattern2", "window_groupby", "multiquery64"],
 )
 def test_engine_matches_baseline_interpreter(config):
     n, batch = 100_000, 16_384
+    if config == "multiquery64":
+        n = 50_000  # the interpreter fans every event through 64 NFAs
+    compare_rows = config in ("headline", "filter")
     schema = _schema()
     n_ids = 1000 if config == "window_groupby" else 50
     batches = bench.make_batches(n, batch, schema, "inputStream", n_ids)
@@ -40,7 +64,8 @@ def test_engine_matches_baseline_interpreter(config):
         cql, {"inputStream": schema},
         config=EngineConfig(lazy_projection=True, pred_pushdown=True),
     )
-    counts = {"n": 0}
+    eng_rows = []
+    eng_counts = {}
     job = Job(
         [plan],
         [BatchSource("inputStream", schema,
@@ -50,15 +75,25 @@ def test_engine_matches_baseline_interpreter(config):
     )
     for rt in job._plans.values():
         for out_stream in rt.plan.output_streams():
-            job.add_sink(
-                out_stream,
-                lambda ts, row: counts.__setitem__("n", counts["n"] + 1),
-            )
+            def sink(ts, row, _sid=out_stream):
+                eng_counts[_sid] = eng_counts.get(_sid, 0) + 1
+                if compare_rows:
+                    eng_rows.append(_norm_row(ts, row))
+
+            job.add_sink(out_stream, sink)
     job.run()
 
-    eng = BaselineEngine(
-        cql, ["id", "name", "price", "timestamp"]
-    )
+    eng = BaselineEngine(cql, ["id", "name", "price", "timestamp"])
+    base_rows = []
+    base_counts = {}
+
+    def base_emit(out, ts, row):
+        eng.emitted += 1
+        base_counts[out] = base_counts.get(out, 0) + 1
+        if compare_rows:
+            base_rows.append(_norm_row(ts, row))
+
+    eng._emit = base_emit
     cols = {
         "id": np.concatenate(
             [b.columns["id"] for b in batches]
@@ -72,4 +107,9 @@ def test_engine_matches_baseline_interpreter(config):
         ).tolist(),
     }
     eng.run_columns(cols, cols["timestamp"])
-    assert counts["n"] == eng.emitted
+
+    assert sum(eng_counts.values()) == eng.emitted
+    assert eng_counts == base_counts  # per-output-stream agreement
+    if compare_rows:
+        assert eng.emitted > 0
+        assert sorted(eng_rows) == sorted(base_rows)

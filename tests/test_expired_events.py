@@ -120,12 +120,23 @@ def test_expired_rejects_windowless_loudly():
         )
 
 
-def test_all_events_rejects_loudly():
-    with pytest.raises(SiddhiQLError):
-        compile_plan(
-            "from S#window.length(2) select id insert all events into o",
-            {"S": SCHEMA},
-        )
+def test_all_events_emits_current_and_expired():
+    """Round-5: 'insert all events into' = arriving AND leaving events
+    interleaved into one stream (split into two queries by the
+    compiler's rewrite, siddhi-core ALL_EVENTS junction behavior)."""
+    cql = (
+        "from S#window.length(2) select id, price "
+        "insert all events into o"
+    )
+    job = run(cql, ids=list(range(6)))
+    rows = sorted(job.results_with_ts("o"))
+    # current: every arrival at its own ts; expired: events 0..3 at
+    # their displacing event's ts (1002..1005)
+    expect = sorted(
+        [(1000 + i, (i, float(i))) for i in range(6)]
+        + [(1002 + i, (i, float(i))) for i in range(4)]
+    )
+    assert rows == expect
 
 
 def test_time_window_expired_cross_batch_straggler():

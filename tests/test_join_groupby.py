@@ -109,3 +109,81 @@ def test_join_direct_having():
         if cnt[k] > 5:
             exp.append((k, cnt[k]))
     assert rows == exp and len(rows) > 0
+
+
+def test_join_direct_groupby_string_key():
+    """Round-5: STRING group keys over the chained (join-rewrite) path —
+    host batches and device columns both carry dictionary codes, so the
+    numeric value->group mapping applies unchanged; output decodes the
+    code back to the string."""
+    S2 = StreamSchema(
+        [("sym", AttributeType.STRING), ("price", AttributeType.DOUBLE),
+         ("timestamp", AttributeType.LONG)],
+        shared_strings=S.string_tables.get("sym"),
+    )
+    T2 = StreamSchema(
+        [("sym", AttributeType.STRING), ("qty", AttributeType.INT),
+         ("timestamp", AttributeType.LONG)],
+    )
+    # one shared dictionary across both streams (the CEPEnvironment
+    # contract); T2 must intern through S2's table
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema as _SS
+    shared = S2.string_tables["sym"]
+    T2 = _SS(
+        [("sym", AttributeType.STRING), ("qty", AttributeType.INT),
+         ("timestamp", AttributeType.LONG)],
+        shared_strings=shared,
+    )
+    syms = ["aaa", "bbb", "ccc"]
+    n, batch = 40, 24
+    rng = np.random.default_rng(13)
+    cs = rng.integers(0, 3, n)
+    codes_s = np.asarray(
+        [shared.intern(syms[c]) for c in cs], np.int32
+    )
+    prices = np.round(rng.random(n) * 10, 2)
+    ts_s = (1000 + 2 * np.arange(n)).astype(np.int64)
+    ct = rng.integers(0, 3, n)
+    codes_t = np.asarray(
+        [shared.intern(syms[c]) for c in ct], np.int32
+    )
+    qty = rng.integers(1, 5, n).astype(np.int32)
+    ts_t = (1001 + 2 * np.arange(n)).astype(np.int64)
+    cql = (
+        "from S#window.length(4) join T#window.length(4) "
+        "on S.sym == T.sym "
+        "select S.sym as k, sum(T.qty) as total "
+        "group by S.sym insert into o"
+    )
+    plan = compile_plan(cql, {"S": S2, "T": T2})
+
+    def src(sid, sch, cols, ts):
+        return BatchSource(sid, sch, iter([
+            EventBatch(
+                sid, sch,
+                {k: v[i:i + batch] for k, v in cols.items()},
+                ts[i:i + batch],
+            )
+            for i in range(0, n, batch)
+        ]))
+
+    job = Job(
+        [plan],
+        [src("S", S2, {"sym": codes_s, "price": prices,
+                       "timestamp": ts_s}, ts_s),
+         src("T", T2, {"sym": codes_t, "qty": qty,
+                       "timestamp": ts_t}, ts_t)],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    rows = job.results("o")
+    # oracle over the join emissions (same ring logic as _join_rows,
+    # keyed by symbol)
+    data = (cs, prices, ts_s, ct, qty, ts_t)
+    sums = {}
+    exp = []
+    for _, k, _p, q_ in _join_rows(data):
+        sums[k] = sums.get(k, 0) + q_
+        exp.append((syms[k], sums[k]))
+    assert len(rows) == len(exp) > 0
+    assert rows == exp

@@ -72,9 +72,121 @@ def test_output_time_mode_flushes_at_stream_end():
     assert [r[0] for r in job.results("o")] == [9]
 
 
-def test_output_snapshot_rejects_loudly():
-    with pytest.raises(SiddhiQLError):
+def test_output_snapshot_plain_select_rejects_loudly():
+    # window-CONTENTS snapshots (no aggregation) stay a loud rejection
+    with pytest.raises(SiddhiQLError, match="snapshot"):
         compile_plan(
             "from S select id output snapshot every 1 sec insert into o",
             {"S": SCHEMA},
         )
+
+
+def test_output_snapshot_periodic_aggregate_per_group():
+    """Round-5: 'output snapshot every T' over an aggregation emits the
+    CURRENT aggregate per group every interval (and the final state at
+    stream end), not the row-per-event stream."""
+    import time as _time
+
+    from flink_siddhi_tpu.runtime.sources import CallbackSource
+
+    src = CallbackSource("S", SCHEMA)
+    plan = compile_plan(
+        "from S select id, count() as c group by id "
+        "output snapshot every 40 insert into o",
+        {"S": SCHEMA},
+    )
+    assert plan.snapshot_keys["o"] == (0,)
+    job = Job([plan], [src], batch_size=8, time_mode="processing")
+    job.drain_interval_ms = 10.0
+    for i in range(6):  # ids 0,1,0,1,0,1
+        src.emit({"id": i % 2, "timestamp": 1000 + i}, 1000 + i)
+    t0 = _time.monotonic()
+    while (
+        len(job.results("o")) < 2 and _time.monotonic() - t0 < 5.0
+    ):
+        job.run_cycle()
+        _time.sleep(0.005)
+    # first interval's snapshot: ONE row per group with current counts
+    first = sorted(job.results("o")[:2])
+    assert first == [(0, 3), (1, 3)]
+    src.emit({"id": 0, "timestamp": 2000}, 2000)
+    src.close()
+    job.run()
+    final = sorted(job.results("o")[-2:])
+    assert final == [(0, 4), (1, 3)]
+
+
+def test_time_mode_limiter_emits_without_new_rows():
+    """ADVICE r4: buffered time-mode output must surface when the
+    interval elapses even if no new row arrives for that stream —
+    polled from the run loop's interval-drain cadence."""
+    import time as _time
+
+    from flink_siddhi_tpu.runtime.sources import CallbackSource
+
+    src = CallbackSource("S", SCHEMA)
+    plan = compile_plan(
+        "from S select id output all every 50 insert into o",
+        {"S": SCHEMA},
+    )
+    job = Job(
+        [plan], [src], batch_size=8, time_mode="processing",
+    )
+    job.drain_interval_ms = 10.0
+    src.emit({"id": 7, "timestamp": 1000}, 1000)
+    t0 = _time.monotonic()
+    # run idle cycles ONLY (no further rows): the buffered row must
+    # appear once the 50ms interval elapses, well before stream end
+    while not job.results("o") and _time.monotonic() - t0 < 5.0:
+        job.run_cycle()
+        _time.sleep(0.005)
+    assert [r[0] for r in job.results("o")] == [7]
+    src.close()
+    job.run()
+
+
+def test_limiter_phase_survives_checkpoint(tmp_path):
+    """ADVICE r4: events-mode chunk position + buffered rows restore,
+    so a resumed job emits at the same chunk boundaries."""
+    ids = list(range(10))
+    ts = [1000 + i for i in ids]
+
+    def batches(lo, hi, step=2):
+        return [
+            EventBatch(
+                "S", SCHEMA,
+                {"id": np.asarray(ids[s:s + step], np.int32),
+                 "timestamp": np.asarray(ts[s:s + step], np.int64)},
+                np.asarray(ts[s:s + step], np.int64),
+            )
+            for s in range(lo, hi, step)
+        ]
+
+    cql = "from S select id output last every 3 events insert into o"
+
+    def build(bs):
+        return Job(
+            [compile_plan(cql, {"S": SCHEMA})],
+            [BatchSource("S", SCHEMA, iter(bs))],
+            batch_size=2, time_mode="processing",
+        )
+
+    # uninterrupted run: boundaries at ids 2, 5, 8, then pending 9
+    solo = build(batches(0, 10))
+    solo.run()
+    expect = [r[0] for r in solo.results("o")]
+
+    # stop mid-stream (4 of 10 events, mid-chunk): no end-of-stream
+    # limiter flush may run before the snapshot
+    job1 = build(batches(0, 10))
+    job1.run(max_cycles=2)
+    assert not job1.finished
+    ck = str(tmp_path / "ck")
+    job1.save_checkpoint(ck)
+    job2 = build(batches(4, 10))
+    job2.restore(ck)
+    job2.run()
+    got = [r[0] for r in job1.results("o")] + [
+        r[0] for r in job2.results("o")
+    ]
+    assert got == expect
