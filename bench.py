@@ -428,7 +428,7 @@ def _measure_rtt(n=40):
     """The tunnel's raw host->device->host round-trip distribution,
     measured with a minimal transfer + sync (the latency phase's floor:
     every match needs >= 1 dispatch round + 1 drain fetch). Returns
-    (p50_ms, p99_ms, samples)."""
+    the per-iteration samples in seconds."""
     import jax
     import jax.numpy as jnp
 
@@ -585,7 +585,7 @@ def _latency_phase(config, rate):
             )
         # transport tail: readiness round trip + d2h fetch are raw
         # tunnel operations; their measured p99 is the floor the match
-        # p99 actually stands on (the 8-sample RTT probe undersamples
+        # p99 actually stands on (the brief RTT probe undersamples
         # the shared link's minute-scale stalls)
         transport = [
             s["wait_ready"] + s["fetch"] for s in job.drain_stages

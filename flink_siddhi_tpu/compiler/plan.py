@@ -760,21 +760,25 @@ def compile_plan(
                     "not supported yet"
                 )
             gb = {ast.bare_group_key(g) for g in q.selector.group_by}
-            keys = tuple(
-                i
-                for i, item in enumerate(q.selector.items)
-                if isinstance(item.expr, ast.Attr)
-                and item.expr.name in gb
-            )
-            if gb and not keys:
-                # without the key in the row, every group would
-                # overwrite one snapshot slot — silently wrong
+            keys = []
+            projected = set()
+            for i, item in enumerate(q.selector.items):
+                if (
+                    isinstance(item.expr, ast.Attr)
+                    and item.expr.name in gb
+                ):
+                    keys.append(i)
+                    projected.add(item.expr.name)
+            if gb - projected:
+                # EVERY group key must be in the row, or distinct
+                # groups overwrite one snapshot slot — silently wrong
                 raise SiddhiQLError(
                     "'output snapshot' on a group-by query must "
-                    "project the group key(s) in the select (snapshot "
-                    "rows are keyed by them)"
+                    "project every group key in the select "
+                    f"(missing: {sorted(gb - projected)}); snapshot "
+                    "rows are keyed by them"
                 )
-            snapshot_keys[q.output_stream] = keys
+            snapshot_keys[q.output_stream] = tuple(keys)
         if writers[q.output_stream] > 1:
             # the host limiter is keyed by stream; interleaving a second
             # writer through one query's limiter would silently throttle
@@ -1249,6 +1253,16 @@ def _rewrite_all_events(parsed):
         if q.output_events != "all":
             out.append(q)
             continue
+        if q.output_rate is not None:
+            # the split halves would share one stream limiter, thinning
+            # interleaved current/expired rows as one sequence — and
+            # the multi-writer check would blame a "second query" the
+            # user never wrote. Name the real combination instead.
+            raise SiddhiQLError(
+                "'insert all events into' combined with 'output ... "
+                "every ...' is not supported; rate-limit the current-"
+                "events and expired-events queries separately"
+            )
         changed = True
         base = q.name or f"allq{len(out)}"
         out.append(

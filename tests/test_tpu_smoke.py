@@ -189,3 +189,128 @@ def test_pallas_compiled_not_interpreted(on_tpu):
     assert pallas_ops.warmup(), (
         "Pallas kernel unavailable on the real device (XLA fallback)"
     )
+
+
+def test_session_window_scan_engine_on_device(on_tpu):
+    # round-5 verdict item 8: the per-event lax.scan engine (session /
+    # sort / unique windows) had never run on real hardware
+    ids = np.array([0, 1, 0, 0, 1, 0, 1, 1], dtype=np.int32)
+    ts = np.array(
+        [1000, 1002, 1005, 1040, 1041, 1100, 1101, 1150],
+        dtype=np.int64,
+    )
+    prices = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0])
+    batches = [
+        EventBatch(
+            "S", SCHEMA,
+            {"id": ids[s:s + 4], "price": prices[s:s + 4],
+             "timestamp": ts[s:s + 4]},
+            ts[s:s + 4],
+        )
+        for s in range(0, 8, 4)
+    ]
+    job = _run(
+        "from S#window.session(10 ms, id) "
+        "select id, sum(price) as s, count() as c insert into o",
+        batches, 4,
+    )
+    rows = sorted(job.results("o"))
+    expect = sorted([
+        (0, 4.0, 2), (0, 4.0, 1), (0, 6.0, 1),
+        (1, 2.0, 1), (1, 5.0, 1), (1, 7.0, 1), (1, 8.0, 1),
+    ])
+    assert len(rows) == len(expect)
+    for (k, s, c), (ek, es, ec) in zip(rows, expect):
+        assert (k, c) == (ek, ec)
+        assert s == pytest.approx(es, rel=1e-4)
+
+
+def test_sharded_step_on_device(on_tpu):
+    # the shard_map'd step (stacked state + collectives) compiled and
+    # executed on the real chip — a 1-device mesh exercises the same
+    # program the virtual 8-device CPU mesh runs
+    from flink_siddhi_tpu.parallel import ShardedJob
+
+    ids, prices, ts, batches = _batches(2048, 512)
+    cql = (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] "
+        "select s1.timestamp as t1, s2.timestamp as t2 insert into o"
+    )
+    sj = ShardedJob(
+        [compile_plan(cql, {"S": SCHEMA})],
+        [BatchSource("S", SCHEMA, iter(batches))],
+        n_shards=1, batch_size=512, time_mode="processing",
+    )
+    sj.run()
+    got = sorted(sj.results("o"))
+    # oracle: every-restart 2-step chain
+    partials, exp = [], []
+    for i in range(len(ids)):
+        nxt = []
+        for t1 in partials:
+            if ids[i] == 2:
+                exp.append((int(t1), int(ts[i])))
+            else:
+                nxt.append(t1)
+        partials = nxt
+        if ids[i] == 1:
+            partials.append(ts[i])
+    assert got == sorted(exp) and got
+
+
+def test_checkpoint_roundtrip_on_device(on_tpu, tmp_path):
+    # device state snapshot mid-stream -> fresh job -> identical tail
+    ids, prices, ts, batches = _batches(4096, 512)
+    cql = (
+        "from S#window.length(64) select id, sum(price) as s "
+        "group by id insert into o"
+    )
+
+    def build(bs):
+        plan = compile_plan(cql, {"S": SCHEMA})
+        return Job(
+            [plan], [BatchSource("S", SCHEMA, iter(bs))],
+            batch_size=512, time_mode="processing",
+        )
+
+    solo = build(batches)
+    solo.run()
+    expect = solo.results("o")
+
+    job1 = build(batches)
+    job1.run(max_cycles=4)
+    assert not job1.finished
+    ck = str(tmp_path / "ck")
+    job1.save_checkpoint(ck)
+    head = job1.results("o")
+    job2 = build(batches[4:])
+    job2.restore(ck)
+    job2.run()
+    got = head + job2.results("o")
+    assert len(got) == len(expect) == 4096
+    for (k, s), (ek, es) in zip(got, expect):
+        assert k == ek
+        assert s == pytest.approx(es, rel=1e-5)
+
+
+def test_resident_replay_on_device(on_tpu):
+    # the bounded-replay scan (the bench's execution mode) against the
+    # streaming path ON HARDWARE — row-identical
+    from flink_siddhi_tpu.runtime.replay import ResidentReplay
+
+    ids, prices, ts, batches = _batches(4096, 1024)
+    cql = (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] -> "
+        "s3 = S[id == 3] within 5 sec "
+        "select s1.timestamp as t1, s3.timestamp as t3 insert into m"
+    )
+    cfg = EngineConfig(lazy_projection=True, pred_pushdown=True)
+    a = _run(cql, list(batches), 1024, cfg)
+    plan = compile_plan(cql, {"S": SCHEMA}, config=cfg)
+    b = Job(
+        [plan], [BatchSource("S", SCHEMA, iter(batches))],
+        batch_size=1024, time_mode="processing",
+    )
+    ResidentReplay(b).execute()
+    ra, rb = a.results_with_ts("m"), b.results_with_ts("m")
+    assert sorted(ra) == sorted(rb) and ra
