@@ -343,11 +343,24 @@ def _rewrite_sequence_absence(inp: ast.PatternInput) -> ast.PatternInput:
             # non-absent) element's event — folding one absent filter
             # into another absent element would negate it twice
             if (el.min_count, el.max_count) != (1, 1):
+                # The fold rewrites `not B` into the next element's
+                # filter; for a quantified next element the guard
+                # belongs to its FIRST occurrence only — folding it
+                # into the shared per-occurrence filter would also
+                # veto later repeats whose predecessor is a repeat,
+                # not B's window. Expressing "first occurrence only"
+                # needs a count-conditional predicate in the slot-NFA
+                # absorb path; until then this rejects rather than
+                # silently matching fewer sequences. Rewrite as
+                # `A, (C and not B-guard), C*`-style splits only when
+                # the quantified element is not capture-referenced.
                 raise SiddhiQLError(
                     "absence before a QUANTIFIED sequence element is "
                     "not supported (the guard applies to the first "
                     "occurrence only, which the folded form cannot "
-                    "express)"
+                    "express); split the first occurrence into its "
+                    "own element: `A, not B, C, C*` -> "
+                    "`A, not B, c1=C, crest=C*`"
                 )
             nxt = el
             for ab in pending:
