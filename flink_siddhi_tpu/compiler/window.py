@@ -588,8 +588,10 @@ class SlidingWindowArtifact:
         # [T,G,K] cumsum across tiles. Tiles run in CHUNKS of batched
         # matmuls — a per-tile lax.scan would pay ~2000 iterations of
         # dispatch overhead for microscopic matmuls.
-        t = 512
-        chunk = 16
+        import os as _os
+
+        t = int(_os.environ.get("FST_BLOCKED_TILE", 512))
+        chunk = int(_os.environ.get("FST_BLOCKED_CHUNK", 16))
         pad = (-N2) % (t * chunk)
         if pad:
             m_code = jnp.concatenate(

@@ -1,0 +1,57 @@
+"""Sweep the blocked window step's tile/chunk knobs on the real chip:
+time the jitted step_acc (piped) for the window_groupby bench shape."""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
+import jax
+
+from bench import build_job
+from flink_siddhi_tpu.runtime.tape import build_wire_tape
+
+
+def run_one(tile, chunk, batch=524288):
+    os.environ["FST_BLOCKED_TILE"] = str(tile)
+    os.environ["FST_BLOCKED_CHUNK"] = str(chunk)
+    job = build_job("window_groupby", batch, batch)
+    rt = list(job._plans.values())[0]
+    job._pull_sources()
+    ready = job._release_ready()
+    wire, _ = build_wire_tape(
+        rt.plan.spec, ready, int(ready[0].timestamps.min()),
+        rt.wire_kinds,
+    )
+    states, acc = rt.states, rt.acc
+    states = rt.plan.grow_state(states)
+    states, acc = rt.jitted_acc(states, acc, wire)  # compile+warm
+    jax.block_until_ready(states)
+    N = 8
+    t0 = time.perf_counter()
+    for _ in range(N):
+        states, acc = rt.jitted_acc(states, acc, wire)
+    jax.block_until_ready(states)
+    piped = (time.perf_counter() - t0) / N
+    print(
+        f"tile={tile:5d} chunk={chunk:3d}: {piped*1e3:7.1f}ms/step "
+        f"({batch/piped/1e6:5.2f}M ev/s)"
+    )
+
+
+def main():
+    for tile, chunk in (
+        (512, 16), (512, 64), (512, 128), (1024, 16), (1024, 64),
+        (2048, 16), (2048, 32), (256, 64),
+    ):
+        run_one(tile, chunk)
+
+
+if __name__ == "__main__":
+    main()
