@@ -268,10 +268,18 @@ def main():
         # latency fallback for configs the paced phase can't measure)
         job.record_drain_latency = True
         rep.stage()  # host tape build + H2D + compiles: off the clock
+        # the shared tunnel stalls on minute scales (observed 2x on a
+        # single replay); the staged tapes stay in HBM, so repeat the
+        # replay and report the MEDIAN — each run still processes the
+        # full stream
+        n_runs = max(int(os.environ.get("BENCH_RUNS", 3)), 1)
         t0 = time.perf_counter()
         rep.run()
         job.flush()
-        elapsed = time.perf_counter() - t0
+        run_times = [time.perf_counter() - t0]
+        for _ in range(n_runs - 1):
+            run_times.append(rep.rerun())
+        elapsed = float(np.median(run_times))
         measured = rep.total_events
         stage_s = round(rep.stage_seconds, 2)
     else:
@@ -313,6 +321,7 @@ def main():
     }
     if stage_s is not None:
         out["stage_seconds"] = stage_s
+        out["runs_elapsed_s"] = [round(t, 3) for t in run_times]
 
     # Phase 2: MATCH LATENCY at a sustainable offered load (80% of the
     # measured throughput). At full saturation queueing latency is

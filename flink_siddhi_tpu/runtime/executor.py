@@ -911,7 +911,12 @@ class Job:
     def _advance_ready(self, rt: _PlanRuntime) -> None:
         """Promote waiting entries whose packed array (or bare meta, for
         counts-only drains) is ready to fetch jobs (FIFO: stop at the
-        first not-ready entry)."""
+        first not-ready entry). Eager promotion (blocking on the packed
+        array from the fetch thread) was measured on the tunnel and
+        does NOT help: the readiness round trip just moves into fetch-
+        thread queueing (drain_stages showed wait_ready ~0 but queue
+        ~230ms), while the gated form lets two in-flight drains
+        pipeline readiness against fetch."""
         for entry in rt.drain_q:
             if "fut" in entry:
                 continue

@@ -231,3 +231,41 @@ class ResidentReplay:
         self.stage()
         self.run()
         self.job.flush()
+
+    def rerun(self) -> float:
+        """Benchmarking aid: reset every staged plan's engine state and
+        replay the SAME staged tapes again, returning elapsed seconds.
+        The staged input stays in device HBM, so repeat measurements
+        cost only compute — the way to de-noise a shared/tunneled
+        device whose minute-scale stalls can double any single run.
+
+        Counts-only jobs only: collectors or sinks would observe every
+        row once per run."""
+        job = self.job
+        for pid in self._staged:
+            if job._has_consumers(job._plans[pid]):
+                raise ValueError(
+                    "rerun() is for no-consumer (counts-only) jobs; "
+                    "sinks/collectors would double-observe rows"
+                )
+        for pid in self._staged:
+            rt = job._plans[pid]
+            # grow to the staged encoder sizes: the compiled scan was
+            # lowered against the GROWN state shapes
+            rt.states = jax.device_put(
+                rt.plan.grow_state(rt.plan.init_state())
+            )
+            rt.acc = rt.jitted_init_acc()
+            rt.acc_dirty = False
+        # host-side emission state resets too: a carried rate-limiter
+        # phase (chunk position / buffered rows / deadlines) would make
+        # the second run's flush emit at different boundaries
+        for lim in job._rate_limiters.values():
+            lim.count = 0
+            lim.buf = []
+            lim.cur = {}
+            lim.deadline = None
+        t0 = time.perf_counter()
+        self.run()
+        self.job.flush()
+        return time.perf_counter() - t0

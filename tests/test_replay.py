@@ -187,3 +187,49 @@ def test_resident_rejects_control_streams():
     )
     with pytest.raises(ValueError, match="control"):
         ResidentReplay(job)
+
+
+def test_rerun_is_deterministic_counts_only():
+    """rerun() resets state and replays the staged tapes: emitted
+    counts double exactly (same matches found twice), and it refuses
+    jobs with consumers."""
+    schema = _schema()
+    n, batch = 20_000, 4096
+    cql = CASES["pattern3"][0]
+
+    def batches():
+        return bench.make_batches(n, batch, schema, "inputStream", 50)
+
+    plan = compile_plan(
+        cql, {"inputStream": schema},
+        config=EngineConfig(lazy_projection=True, pred_pushdown=True),
+    )
+    job = Job(
+        [plan],
+        [BatchSource("inputStream", schema, iter(batches()))],
+        batch_size=batch, time_mode="processing", retain_results=False,
+    )
+    rep = ResidentReplay(job)
+    rep.stage()
+    rep.run()
+    job.flush()
+    first = dict(job.emitted_counts)
+    assert sum(first.values()) > 0
+    dt = rep.rerun()
+    assert dt > 0
+    assert {k: 2 * v for k, v in first.items()} == dict(
+        job.emitted_counts
+    )
+
+    # with a consumer attached, rerun refuses
+    job2 = Job(
+        [compile_plan(cql, {"inputStream": schema})],
+        [BatchSource("inputStream", schema, iter(batches()))],
+        batch_size=batch, time_mode="processing",
+    )
+    rep2 = ResidentReplay(job2)
+    rep2.stage()
+    rep2.run()
+    job2.flush()
+    with pytest.raises(ValueError, match="counts-only"):
+        rep2.rerun()
