@@ -92,10 +92,14 @@ def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
     return jax.jit(smapped)
 
 
-def make_sharded_step_acc(plan: CompiledPlan, mesh) -> callable:
+def make_sharded_step_acc(
+    plan: CompiledPlan, mesh, jitted: bool = True
+) -> callable:
     """jit(shard_map(plan.step_acc)): each shard appends its emissions to
     its own on-device accumulator — the hot loop never fetches (same
-    contract as the single-device executor)."""
+    contract as the single-device executor). ``jitted=False`` returns
+    the bare shard_map'd callable for callers that embed it in a larger
+    program (the sharded bounded-replay scan)."""
 
     use_kernel = _shard_kernel_ok()
 
@@ -124,6 +128,8 @@ def make_sharded_step_acc(plan: CompiledPlan, mesh) -> callable:
         out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
         check_vma=False,
     )
+    if not jitted:
+        return smapped
     return jax.jit(smapped, donate_argnums=(0, 1))
 
 
@@ -273,6 +279,11 @@ class ShardedJob(Job):
                 rt.states.get(name),
             ),
         )
+
+    def prewarm_drains(self, widths=None) -> None:
+        # the packed-drain programs are a single-device optimization;
+        # sharded drains read per-shard meta/slices directly
+        return
 
     def drain_outputs(self, wait: bool = True) -> None:
         # sharded drains stay synchronous for now (the wait=False fast

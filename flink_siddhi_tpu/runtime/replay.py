@@ -127,27 +127,15 @@ class ResidentReplay:
         for pid, rt in job._plans.items():
             if not rt.enabled:
                 continue
-            windows: List[List[EventBatch]] = []
-            for ready in ready_sets:
-                windows.extend(job._plan_windows(rt, ready))
-            if not windows:
-                continue
             # pass A: the streaming host half per window — interning,
-            # lazy-ring retention, sticky width/capacity evolution
-            wires = [job._stage_tape(rt, w) for w in windows]
-            rt.states = rt.plan.grow_state(rt.states)
-            # pass B: early tapes built before a width/capacity widened
-            # get rebuilt against the FINAL sticky kinds, so every tape
-            # shares one structure (one compiled scan, no retraces).
-            # The LAST tape already carries the final kinds/capacity
-            # (both are sticky and monotone), so it IS the reference.
-            want = _wire_sig(wires[-1])
-            for i, w in enumerate(wires[:-1]):
-                if _wire_sig(w) != want:
-                    wires[i] = build_wire_tape(
-                        rt.plan.spec, windows[i], job._epoch_ms,
-                        rt.wire_kinds, capacity=rt.tape_capacity,
-                    )[0]
+            # lazy-ring retention, sticky width/capacity evolution —
+            # then pass B rebuilds early tapes against the FINAL sticky
+            # kinds so every tape shares one structure (one compiled
+            # scan, no retraces); the LAST tape already carries the
+            # final kinds/capacity (both sticky and monotone)
+            wires = self._plan_wires(rt, ready_sets)
+            if wires is None:
+                continue
             self._staged[pid] = self._stage_plan(rt, wires)
         if self._staged:
             self.job.prewarm_drains()
@@ -232,6 +220,28 @@ class ResidentReplay:
         self.run()
         self.job.flush()
 
+    # subclass hooks -------------------------------------------------------
+    def _plan_wires(self, rt, ready_sets):
+        """Build every tape for one plan (pass A + structural
+        normalization). Returns the list of scan inputs, or None when
+        the plan sees no events."""
+        job = self.job
+        windows = []
+        for ready in ready_sets:
+            windows.extend(job._plan_windows(rt, ready))
+        if not windows:
+            return None
+        wires = [job._stage_tape(rt, w) for w in windows]
+        rt.states = rt.plan.grow_state(rt.states)
+        want = _wire_sig(wires[-1])
+        for i, w in enumerate(wires[:-1]):
+            if _wire_sig(w) != want:
+                wires[i] = build_wire_tape(
+                    rt.plan.spec, windows[i], job._epoch_ms,
+                    rt.wire_kinds, capacity=rt.tape_capacity,
+                )[0]
+        return wires
+
     def rerun(self) -> float:
         """Benchmarking aid: reset every staged plan's engine state and
         replay the SAME staged tapes again, returning elapsed seconds.
@@ -269,3 +279,135 @@ class ResidentReplay:
         self.run()
         self.job.flush()
         return time.perf_counter() - t0
+
+
+class ShardedResidentReplay(ResidentReplay):
+    """Bounded replay over a ``parallel.ShardedJob`` mesh: the same
+    stage-everything-then-scan shape, with per-shard tapes routed by
+    the job's Router, stacked ``[cycles, shards, ...]``, laid out with
+    the mesh sharding, and advanced by a scan whose body is the
+    shard_map'd step — the mesh analog of Flink's bounded execution of
+    an N-subtask pipeline. Drains stay synchronous (the ShardedJob
+    contract)."""
+
+    def _plan_wires(self, rt, ready_sets):
+        import jax.numpy as jnp
+
+        job = self.job
+        plan = rt.plan
+        if plan.tape_capacity_limit:
+            raise ValueError(
+                "sharded bounded replay does not support compile-window"
+                "-capped (wide multi-query) plans yet; run streaming"
+            )
+        from ..runtime.tape import bucket_size, build_tape
+
+        routed = []
+        for ready in ready_sets:
+            involved = [
+                b
+                for b in ready
+                if b.stream_id in plan.spec.stream_codes
+            ]
+            if involved:
+                routed.append(
+                    job._routers[plan.plan_id].route_all(involved)
+                )
+        if not routed:
+            return None
+        cap = max(
+            bucket_size(
+                max(sum(len(b) for b in sh) for sh in shards) or 1
+            )
+            for shards in routed
+        )
+        rt.tape_capacity = max(rt.tape_capacity, cap)
+        stacked = []
+        for shards in routed:
+            tapes = [
+                build_tape(
+                    plan.spec, sh, job._epoch_ms, rt.tape_capacity
+                )[0]
+                for sh in shards
+            ]
+            stacked.append(
+                jax.tree.map(lambda *xs: np.stack(xs), *tapes)
+            )
+        rt.states = job._grow_stacked(plan, rt.states)
+        return stacked
+
+    def _stage_plan(self, rt, wires) -> Dict:
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import SHARD_AXIS
+        from ..parallel.sharded import make_sharded_step_acc
+
+        job = self.job
+        job._update_drain_hint(
+            rt.plan,
+            wires[0].ts.shape[-1],
+            lambda name: jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    np.shape(x)[1:], x.dtype
+                ),
+                rt.states.get(name),
+            ),
+        )
+        k = (
+            max(1, self.segment_cycles)
+            if self.segment_cycles is not None
+            else max(1, job._drain_hints[rt.plan.plan_id])
+        )
+        k = min(len(wires), k)
+        pad = (-len(wires)) % k
+        if pad:
+            import dataclasses
+
+            last = wires[-1]
+            empty = dataclasses.replace(
+                last,
+                valid=np.zeros_like(last.valid),
+                stream=np.full_like(last.stream, -1),
+            )
+            wires = wires + [empty] * pad
+        sharding = NamedSharding(job.mesh, P(None, SHARD_AXIS))
+        segments = [
+            jax.device_put(
+                jax.tree.map(
+                    lambda *xs: np.stack(xs), *wires[i : i + k]
+                ),
+                sharding,
+            )
+            for i in range(0, len(wires), k)
+        ]
+        smapped = make_sharded_step_acc(rt.plan, job.mesh, jitted=False)
+
+        def seg_scan(states, acc, seg):
+            def body(carry, tape):
+                s, a = smapped(carry[0], carry[1], tape)
+                return (s, a), None
+
+            (states, acc), _ = jax.lax.scan(body, (states, acc), seg)
+            return states, acc
+
+        scan = jax.jit(seg_scan, donate_argnums=(0, 1)).lower(
+            rt.states, rt.acc, segments[0]
+        ).compile()
+        warm = scan(
+            jax.tree.map(jnp.copy, rt.states),
+            jax.tree.map(jnp.copy, rt.acc),
+            segments[0],
+        )
+        jax.block_until_ready(warm)
+        del warm
+        return {"scan": scan, "segments": segments}
+
+    def run(self) -> None:
+        job = self.job
+        for pid, st in self._staged.items():
+            rt = job._plans[pid]
+            for seg in st["segments"]:
+                rt.states, rt.acc = st["scan"](rt.states, rt.acc, seg)
+                rt.acc_dirty = True
+                job._drain_plan(rt)  # ShardedJob drains synchronously
