@@ -21,7 +21,22 @@ Env knobs: BENCH_EVENTS (default 10_000_000), BENCH_BATCH (default
 524288 — the per-event device step cost saturates there; in resident
 mode dispatch overhead no longer matters, so the smaller batch's better
 per-event time wins), BENCH_MODE (resident | streaming), BENCH_CONFIG
-(headline | filter | pattern2 | window_groupby | multiquery64).
+(headline | filter | pattern2 | window_groupby | multiquery64),
+BENCH_TELEMETRY (default 1; 0 disables the telemetry registry — the
+overhead A/B switch).
+
+``--dryrun``: a small self-contained run (BENCH_EVENTS defaults to
+200_000, one replay, no latency phase) that still emits the full JSON
+line including ``stage_breakdown`` — the schema gate
+(scripts/check_bench_schema.py) validates its output shape.
+
+Honest wall-clock accounting: every BENCH JSON line carries a
+``stage_breakdown`` section computed from the telemetry subsystem
+(flink_siddhi_tpu/telemetry) — the end-to-end window from job build to
+the final flush, decomposed into named stages that must cover >= 95%
+of elapsed wall-clock (docs/observability.md). Latency percentiles are
+answered by the subsystem's log-bucketed histograms, not ad-hoc
+percentile arithmetic.
 """
 
 from __future__ import annotations
@@ -171,7 +186,15 @@ def _config_cql(config):
     raise SystemExit(f"unknown BENCH_CONFIG {config!r}")
 
 
+def _telemetry_enabled():
+    return os.environ.get("BENCH_TELEMETRY", "1") != "0"
+
+
 def build_job(config, n_events, batch):
+    # the first of these imports pulls in jax (seconds of wall-clock on
+    # a cold interpreter): measured and attributed below, not left as
+    # unattributed window time
+    t0 = time.perf_counter()
     from flink_siddhi_tpu import CEPEnvironment
     from flink_siddhi_tpu.compiler.plan import compile_plan
     from flink_siddhi_tpu.runtime.executor import Job
@@ -179,6 +202,8 @@ def build_job(config, n_events, batch):
     from flink_siddhi_tpu.schema.stream_schema import StreamSchema
     from flink_siddhi_tpu.schema.types import AttributeType
 
+    dt_import = time.perf_counter() - t0
+    t0 = time.perf_counter()
     env = CEPEnvironment(batch_size=batch, time_mode="processing")
     schema = StreamSchema(
         [
@@ -189,11 +214,14 @@ def build_job(config, n_events, batch):
         ],
         shared_strings=env.shared_strings,
     )
+    dt_env = time.perf_counter() - t0  # may include jax backend init
 
     cql = _config_cql(config)
 
     n_ids = 1000 if config == "window_groupby" else 50
+    t0 = time.perf_counter()
     batches = make_batches(n_events, batch, schema, "inputStream", n_ids)
+    dt_input = time.perf_counter() - t0
     src = BatchSource("inputStream", schema, iter(batches))
     from flink_siddhi_tpu.compiler.config import EngineConfig
 
@@ -208,13 +236,24 @@ def build_job(config, n_events, batch):
             int(os.environ.get("BENCH_TAPE_CAP", 0)) or None
         ),
     )
+    t0 = time.perf_counter()
     plan = compile_plan(
         cql, {"inputStream": schema}, plan_id="bench", config=ecfg
     )
+    dt_compile = time.perf_counter() - t0
+    t0 = time.perf_counter()
     job = Job(
         [plan], [src], batch_size=batch, time_mode="processing",
         retain_results=False,
     )
+    dt_init = time.perf_counter() - t0
+    # telemetry: BENCH_TELEMETRY=0 reduces every span/record to a no-op
+    # (the <2%-overhead A/B). The setup costs measured above predate the
+    # registry, so they are back-filled as stage times.
+    job.telemetry.enabled = _telemetry_enabled()
+    job.telemetry.add_time("input_gen", dt_input)
+    job.telemetry.add_time("plan_compile", dt_compile)
+    job.telemetry.add_time("job_init", dt_import + dt_env + dt_init)
     # latency/throughput trade-off knobs (defaults tuned on TPU v5e-1).
     # Depth adapts to the measured cycle pace (target_p99_ms); drains
     # are flow-controlled (never queued behind an in-flight fetch), so a
@@ -224,14 +263,24 @@ def build_job(config, n_events, batch):
     job.drain_interval_ms = float(
         os.environ.get("BENCH_DRAIN_MS", 250.0)
     )
-    job.prewarm_drains()
+    with job.telemetry.span("prewarm"):
+        job.prewarm_drains()
     return job
 
 
 def main():
     config = os.environ.get("BENCH_CONFIG", "headline")
-    n_events = int(os.environ.get("BENCH_EVENTS", 10_000_000))
-    batch = int(os.environ.get("BENCH_BATCH", 524_288))
+    dryrun = "--dryrun" in sys.argv
+    n_events = int(
+        os.environ.get(
+            "BENCH_EVENTS", 200_000 if dryrun else 10_000_000
+        )
+    )
+    batch = int(
+        os.environ.get(
+            "BENCH_BATCH", 65_536 if dryrun else 524_288
+        )
+    )
     if "--baseline" in sys.argv:
         run_baseline(
             config, int(os.environ.get("BENCH_BASELINE_EVENTS", 1_000_000))
@@ -240,6 +289,10 @@ def main():
     warmup_cycles = 3
     mode = os.environ.get("BENCH_MODE", "resident")
 
+    # honest-wall-clock window: everything from here to the final
+    # flush is attributed to a named telemetry stage; stage_breakdown
+    # below must cover >= 95% of this elapsed time
+    t_wall0 = time.perf_counter()
     job = build_job(config, n_events, batch)
 
     # Phase 1: THROUGHPUT.
@@ -264,15 +317,12 @@ def main():
         from flink_siddhi_tpu.runtime.replay import ResidentReplay
 
         rep = ResidentReplay(job)
-        # segment drains populate drain_latencies (the visibility-
-        # latency fallback for configs the paced phase can't measure)
-        job.record_drain_latency = True
         rep.stage()  # host tape build + H2D + compiles: off the clock
         # the shared tunnel stalls on minute scales (observed 2x on a
         # single replay); the staged tapes stay in HBM, so repeat the
         # replay and report the MEDIAN — each run still processes the
         # full stream
-        n_runs = max(int(os.environ.get("BENCH_RUNS", 3)), 1)
+        n_runs = max(int(os.environ.get("BENCH_RUNS", 1 if dryrun else 3)), 1)
         t0 = time.perf_counter()
         rep.run()
         job.flush()
@@ -283,7 +333,6 @@ def main():
         measured = rep.total_events
         stage_s = round(rep.stage_seconds, 2)
     else:
-        job.record_drain_latency = True
         cycles = 0
         t_start = time.perf_counter()
         t0 = t_start
@@ -302,6 +351,7 @@ def main():
         if measured <= 0:  # tiny runs: count everything + warmup wall
             measured = job.processed_events
             elapsed = time.perf_counter() - t_start
+    elapsed_wall = time.perf_counter() - t_wall0
     ev_per_sec = measured / max(elapsed, 1e-9)
     base = MEASURED_BASELINE.get(config, BASELINE_EVENTS_PER_SEC)
     out = {
@@ -322,6 +372,8 @@ def main():
     if stage_s is not None:
         out["stage_seconds"] = stage_s
         out["runs_elapsed_s"] = [round(t, 3) for t in run_times]
+    out["stage_breakdown"] = _stage_breakdown(job, elapsed_wall)
+    out["schema_version"] = 2
 
     # Phase 2: MATCH LATENCY at a sustainable offered load (80% of the
     # measured throughput). At full saturation queueing latency is
@@ -332,13 +384,20 @@ def main():
     # multiquery64 fans out 64 queries) would measure host row decode,
     # not the engine — they report drain request->completion
     # (visibility) latency from phase 1 instead.
-    measure_latency = config in ("headline", "pattern2", "filter")
+    measure_latency = (
+        config in ("headline", "pattern2", "filter") and not dryrun
+    )
     if measure_latency:
+        from flink_siddhi_tpu.telemetry import LatencyHistogram
+
         # the floor every ingest->visibility sample pays on a tunneled
         # device: one dispatch round + one drain fetch, each >= 1 RTT.
         # Printed so the p99 claim is checkable against the tunnel's
-        # OWN tail (shared link: its p99 is many x its p50)
-        s_a = _measure_rtt()
+        # OWN tail (shared link: its p99 is many x its p50). Both RTT
+        # brackets land in ONE histogram: percentiles below come from
+        # it, not from ad-hoc array arithmetic.
+        rtt_hist = LatencyHistogram()
+        rtt_hist.record_many_seconds(_measure_rtt())
         # offered load: capped at 1M ev/s (~2x the measured single-core
         # baseline's throughput) and at half the full-throttle rate —
         # the sink path (data drains over a slow d2h tunnel + host
@@ -348,33 +407,28 @@ def main():
         # not an engine property
         lat_rate = min(0.5 * ev_per_sec, 1_000_000.0)
         lat_rate = float(os.environ.get("BENCH_LAT_RATE", lat_rate))
-        lat, phases = _latency_phase(config, lat_rate)
-        if lat is not None:
+        lat_hist, phases = _latency_phase(config, lat_rate)
+        if lat_hist is not None and lat_hist.count:
             # RTT again AFTER the phase: the shared tunnel drifts on
             # minute scales, so the floor brackets the measurement
-            s_b = _measure_rtt()
-            rtt = s_a + s_b
-            out["p99_match_latency_ms"] = round(
-                1e3 * float(np.percentile(lat, 99)), 1
-            )
-            out["p50_match_latency_ms"] = round(
-                1e3 * float(np.percentile(lat, 50)), 1
-            )
+            rtt_hist.record_many_seconds(_measure_rtt())
+            out["p99_match_latency_ms"] = lat_hist.percentile_ms(99)
+            out["p50_match_latency_ms"] = lat_hist.percentile_ms(50)
+            out["latency_source"] = "telemetry_histogram"
             out["latency_load_events_per_sec"] = round(lat_rate)
             # the checkable decomposition: a sample's floor is one
             # dispatch round + one drain fetch (>= 2 tunnel RTTs) +
             # drain-interval staleness; p99-vs-floor uses the TUNNEL's
             # own p99 because the tail of a shared link is the tail of
             # every fetch that rides it
-            floor50 = 2 * float(np.percentile(rtt, 50)) * 1e3
-            floor99 = 2 * float(np.percentile(rtt, 99)) * 1e3
+            rtt50 = rtt_hist.percentile_ms(50)
+            rtt99 = rtt_hist.percentile_ms(99)
+            interval = phases.get("drain_interval_ms", 0.0)
+            floor50 = 2 * rtt50 + interval
+            floor99 = 2 * rtt99 + interval
             out["latency_breakdown"] = {
-                "tunnel_rtt_p50_ms": round(
-                    1e3 * float(np.percentile(rtt, 50)), 1
-                ),
-                "tunnel_rtt_p99_ms": round(
-                    1e3 * float(np.percentile(rtt, 99)), 1
-                ),
+                "tunnel_rtt_p50_ms": rtt50,
+                "tunnel_rtt_p99_ms": rtt99,
                 "drain_p50_ms": phases.get("drain_p50_ms"),
                 "drain_p99_ms": phases.get("drain_p99_ms"),
                 "drain_wait_ready_p50_ms": phases.get(
@@ -382,23 +436,17 @@ def main():
                 ),
                 "drain_queue_p50_ms": phases.get("drain_queue_p50_ms"),
                 "drain_fetch_p50_ms": phases.get("drain_fetch_p50_ms"),
+                "drain_decode_p50_ms": phases.get(
+                    "drain_decode_p50_ms"
+                ),
                 "drain_emit_lag_p50_ms": phases.get(
                     "drain_emit_lag_p50_ms"
                 ),
-                "drain_interval_ms": phases.get("drain_interval_ms"),
-                "floor_p50_ms": round(
-                    floor50 + phases.get("drain_interval_ms", 0.0), 1
-                ),
-                "floor_p99_ms": round(
-                    floor99 + phases.get("drain_interval_ms", 0.0), 1
-                ),
+                "drain_interval_ms": interval,
+                "floor_p50_ms": round(floor50, 1),
+                "floor_p99_ms": round(floor99, 1),
                 "p99_vs_floor": round(
-                    out["p99_match_latency_ms"]
-                    / max(
-                        floor99 + phases.get("drain_interval_ms", 0.0),
-                        1e-6,
-                    ),
-                    2,
+                    out["p99_match_latency_ms"] / max(floor99, 1e-6), 2
                 ),
             }
             # the floor the p99 ACTUALLY stands on: the measured p99 of
@@ -407,11 +455,7 @@ def main():
             # term printed above, every term a raw tunnel measurement
             tr99 = phases.get("transport_p99_ms")
             if tr99 is not None:
-                tfloor = (
-                    tr99
-                    + float(np.percentile(rtt, 50)) * 1e3
-                    + phases.get("drain_interval_ms", 0.0)
-                )
+                tfloor = tr99 + rtt50 + interval
                 out["latency_breakdown"]["transport_p99_ms"] = tr99
                 out["latency_breakdown"]["transport_floor_p99_ms"] = (
                     round(tfloor, 1)
@@ -422,15 +466,52 @@ def main():
                         2,
                     )
                 )
-    elif job.drain_latencies:
-        dl = job.drain_latencies
-        out["p99_visibility_latency_ms"] = round(
-            1e3 * float(np.percentile(dl, 99)) + job.drain_interval_ms, 1
-        )
-        out["p50_visibility_latency_ms"] = round(
-            1e3 * float(np.percentile(dl, 50)) + job.drain_interval_ms, 1
-        )
+    else:
+        # high-match-rate configs (and dryrun): drain request->
+        # completion (visibility) latency from the throughput phase's
+        # own telemetry histograms, staleness-adjusted by the drain
+        # interval
+        dh = job.telemetry.histogram("drain.total")
+        if dh.count:
+            out["p99_visibility_latency_ms"] = round(
+                dh.percentile_ms(99) + job.drain_interval_ms, 1
+            )
+            out["p50_visibility_latency_ms"] = round(
+                dh.percentile_ms(50) + job.drain_interval_ms, 1
+            )
+            out["latency_source"] = "telemetry_histogram"
     print(json.dumps(out))
+
+
+def _stage_breakdown(job, elapsed_wall):
+    """The honest-wall-clock section of the BENCH JSON: every named
+    stage's seconds from the job's telemetry registry, plus the
+    attribution ratio over the end-to-end window. Top-level stage names
+    (TOP_LEVEL_STAGES) partition the run-loop thread's wall clock;
+    nested.* names are drill-down detail already counted by their
+    enclosing stage. scripts/check_bench_schema.py enforces
+    coverage >= 0.95."""
+    from flink_siddhi_tpu.telemetry import TOP_LEVEL_STAGES
+
+    if not job.telemetry.enabled:
+        return {"telemetry": "off"}
+    stages = job.telemetry.stages.snapshot()
+    attributed = sum(
+        d["seconds"]
+        for name, d in stages.items()
+        if name in TOP_LEVEL_STAGES
+    )
+    return {
+        "telemetry": "on",
+        "window": "build_job..final_flush",
+        "elapsed_s": round(elapsed_wall, 3),
+        "attributed_s": round(attributed, 3),
+        "coverage": round(attributed / max(elapsed_wall, 1e-9), 4),
+        "stages": {
+            name: round(d["seconds"], 3)
+            for name, d in stages.items()
+        },
+    }
 
 
 def _measure_rtt(n=40):
@@ -493,8 +574,9 @@ class _PacedSource:
 
 def _latency_phase(config, rate):
     """Steady-state ingest->sink latency at the given offered load.
-    Returns (per-batch latency samples [s] from the middle 80% of the
-    run, per-phase breakdown dict)."""
+    Returns (LatencyHistogram over the middle 80% of the run's
+    per-batch samples, per-phase breakdown dict sourced from the
+    latency job's drain.* telemetry histograms)."""
     if rate <= 0:
         return None, {}
     # power-of-two micro-batch so catch-up concats (2x, 4x) land on
@@ -514,7 +596,6 @@ def _latency_phase(config, rate):
     job.drain_interval_ms = float(
         os.environ.get("BENCH_LAT_DRAIN_MS", 60.0)
     )
-    job.record_drain_latency = True
     # re-source with the paced release schedule
     src = job._sources[0]
     batches = []
@@ -577,37 +658,41 @@ def _latency_phase(config, rate):
         else:
             time.sleep(0.002)
     job.flush()
+    # per-leg drain percentiles come from the job's own telemetry
+    # histograms (runtime/executor.py records every completed drain's
+    # wait_ready/queue/fetch/decode/emit_lag/total legs) — the
+    # subsystem IS the measurement path, not a bench-side recompute
     phases = {"drain_interval_ms": job.drain_interval_ms}
-    if job.drain_latencies:
-        dl = job.drain_latencies
-        phases["drain_p50_ms"] = round(
-            1e3 * float(np.percentile(dl, 50)), 1
-        )
-        phases["drain_p99_ms"] = round(
-            1e3 * float(np.percentile(dl, 99)), 1
-        )
-    if job.drain_stages:
-        for key in ("wait_ready", "queue", "fetch", "emit_lag"):
-            vals = [s[key] for s in job.drain_stages]
-            phases[f"drain_{key}_p50_ms"] = round(
-                1e3 * float(np.percentile(vals, 50)), 1
-            )
-        # transport tail: readiness round trip + d2h fetch are raw
-        # tunnel operations; their measured p99 is the floor the match
-        # p99 actually stands on (the brief RTT probe undersamples
-        # the shared link's minute-scale stalls)
-        transport = [
-            s["wait_ready"] + s["fetch"] for s in job.drain_stages
-        ]
-        phases["transport_p99_ms"] = round(
-            1e3 * float(np.percentile(transport, 99)), 1
-        )
+    tel = job.telemetry
+    for out_key, (hist_name, q) in {
+        "drain_p50_ms": ("drain.total", 50),
+        "drain_p99_ms": ("drain.total", 99),
+        "drain_wait_ready_p50_ms": ("drain.wait_ready", 50),
+        "drain_queue_p50_ms": ("drain.queue", 50),
+        "drain_fetch_p50_ms": ("drain.fetch", 50),
+        "drain_decode_p50_ms": ("drain.decode", 50),
+        "drain_emit_lag_p50_ms": ("drain.emit_lag", 50),
+    }.items():
+        h = tel.histogram(hist_name)
+        if h.count:
+            phases[out_key] = h.percentile_ms(q)
+    # transport tail: readiness round trip + d2h fetch are raw tunnel
+    # operations; their measured p99 is the floor the match p99
+    # actually stands on (the brief RTT probe undersamples the shared
+    # link's minute-scale stalls)
+    tr = tel.histogram("drain.transport")
+    if tr.count:
+        phases["transport_p99_ms"] = tr.percentile_ms(99)
     if not lat:
         return None, phases
+    from flink_siddhi_tpu.telemetry import LatencyHistogram
+
     lo = warm_n + 0.1 * (seen - warm_n)  # steady-state window
     hi = warm_n + 0.9 * (seen - warm_n)
     samples = [t for t, b in lat if lo <= b <= hi]
-    return samples or [t for t, _ in lat], phases
+    hist = LatencyHistogram()
+    hist.record_many_seconds(samples or [t for t, _ in lat])
+    return hist, phases
 
 
 if __name__ == "__main__":

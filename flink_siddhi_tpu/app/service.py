@@ -32,6 +32,25 @@ from ..control.events import (
 )
 
 
+def _json_safe(obj):
+    """Recursively convert a metrics snapshot to JSON-serializable
+    primitives: numpy scalars/arrays (watermarks, routed-event gauges)
+    become Python ints/floats/lists at the REST boundary."""
+    if isinstance(obj, dict):
+        return {str(k): _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return [_json_safe(v) for v in obj.tolist()]
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    return str(obj)
+
+
 class ControlQueueSource:
     """Push-style control source: the service enqueues events, the job's
     executor drains them at micro-batch boundaries. Stays open until
@@ -122,7 +141,12 @@ class QueryControlService:
                 if parts == ["api", "v1", "metrics"]:
                     if service.job is None:
                         return self._reply(200, {})
-                    return self._reply(200, service.job.metrics())
+                    # metrics(drain=False): host-side registry snapshot
+                    # only — never touches the device from this thread
+                    # (response schema: docs/observability.md)
+                    return self._reply(
+                        200, _json_safe(service.job.metrics())
+                    )
                 tail = self._route()
                 if tail is None or tail:
                     return self._reply(404, {"error": "not found"})

@@ -67,6 +67,9 @@ class Router:
         self.partitions = dict(partitions)
         self.default = StreamPartition(kind=default)
         self._rr: Dict[str, int] = {}  # per-stream round-robin cursor
+        # observability: cumulative events routed to each shard (read
+        # into the job's telemetry gauges — skew shows up here first)
+        self.routed = np.zeros(n_shards, dtype=np.int64)
 
     def partition_of(self, stream_id: str) -> StreamPartition:
         return self.partitions.get(stream_id, self.default)
@@ -139,6 +142,8 @@ class Router:
             for s, piece in enumerate(self.route(b)):
                 if piece is not None and len(piece):
                     shards[s].append(piece)
+        for s, pieces in enumerate(shards):
+            self.routed[s] += sum(len(p) for p in pieces)
         return shards
 
     def _segment_bounds(self, ts_arrays: List[np.ndarray]) -> np.ndarray:

@@ -25,10 +25,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import heapq
 import logging
 
+import time
+
 from ..compiler.plan import CompiledPlan
 from ..runtime.executor import Job, _PlanRuntime
 from ..runtime.tape import build_tape, bucket_size
 from ..schema.batch import EventBatch
+from ..telemetry import LatencyHistogram
 from .mesh import SHARD_AXIS, make_cep_mesh
 from .router import Router
 
@@ -244,29 +247,44 @@ class ShardedJob(Job):
 
     def _step_plan(self, rt: _PlanRuntime, ready: List[EventBatch]) -> None:
         plan = rt.plan
+        tel = self.telemetry
         involved = [
             b for b in ready if b.stream_id in plan.spec.stream_codes
         ]
         if not involved:
             return
-        shards = self._routers[plan.plan_id].route_all(involved)
+        router = self._routers[plan.plan_id]
+        with tel.span("route"):
+            shards = router.route_all(involved)
+        # per-shard placement visibility: a skewed key distribution
+        # shows up here long before it shows up as one hot shard
+        tel.gauge(
+            f"route.per_shard_events.{plan.plan_id}",
+            [int(r) for r in router.routed],
+        )
         # sticky capacity: pad the end-of-stream tail up to the compiled
         # shape instead of bucketing down into a fresh XLA executable
         rt.tape_capacity = max(
             rt.tape_capacity,
             bucket_size(max(sum(len(b) for b in sh) for sh in shards) or 1),
         )
-        tapes = [
-            build_tape(plan.spec, sh, self._epoch_ms, rt.tape_capacity)[0]
-            for sh in shards
-        ]
-        stacked_tape = _tree_stack(
-            [jax.tree.map(jnp.asarray, t) for t in tapes]
-        )
+        with tel.span("tape_build"):
+            tapes = [
+                build_tape(
+                    plan.spec, sh, self._epoch_ms, rt.tape_capacity
+                )[0]
+                for sh in shards
+            ]
+            stacked_tape = _tree_stack(
+                [jax.tree.map(jnp.asarray, t) for t in tapes]
+            )
         rt.states = self._grow_stacked(plan, rt.states)
         # per-shard on-device accumulation; no fetch in the hot loop
         # (drained in bulk by _drain_plan, same as the single-device Job)
-        rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, stacked_tape)
+        with tel.span("dispatch"):
+            rt.states, rt.acc = rt.jitted_acc(
+                rt.states, rt.acc, stacked_tape
+            )
         # shared no-overflow contract (Job._update_drain_hint); strip the
         # leading shard axis via shape metadata only
         self._update_drain_hint(
@@ -297,8 +315,13 @@ class ShardedJob(Job):
                 self._drain_plan(rt)
 
     def _drain_plan(self, rt: _PlanRuntime) -> None:
+        with self.telemetry.span("drain"):
+            self._drain_plan_body(rt)
+
+    def _drain_plan_body(self, rt: _PlanRuntime) -> None:
         if rt.acc is None or not rt.plan.artifacts:
             return
+        t_req = time.monotonic()
         meta = np.asarray(rt.acc["meta"])  # (shards, 2, A) — one fetch
         counts, overflow = meta[:, 0], meta[:, 1]
         seen = getattr(rt, "_overflow_seen", None)
@@ -322,11 +345,26 @@ class ShardedJob(Job):
         )[:, :, :max_n]  # fetch two
         rt.acc = rt.jitted_init_acc()
         rt._overflow_seen = None  # counters reset with the accumulator
+        tel = self.telemetry
+        # per-shard decode-time histograms, kept PER SHARD on the
+        # runtime and folded into the job registry after the sweep —
+        # the mergeable-across-shards histogram contract in production
+        # use (tests assert merge associativity)
+        shard_hists = getattr(rt, "_shard_decode_hists", None)
+        if shard_hists is None and tel.enabled:
+            shard_hists = rt._shard_decode_hists = [
+                LatencyHistogram() for _ in range(self.n_shards)
+            ]
         # merge each output's per-shard (already time-ordered) rows by
         # timestamp so sinks observe near-monotonic time across shards
         per_schema = {}
         for s in range(self.n_shards):
+            t0 = time.perf_counter()
             decoded = rt.plan.drain_decode(counts[s], data[s])
+            if shard_hists is not None:
+                shard_hists[s].record_seconds(
+                    time.perf_counter() - t0
+                )
             for a in rt.plan.artifacts:
                 for schema, rows in decoded.get(a.name) or []:
                     per_schema.setdefault(
@@ -342,22 +380,53 @@ class ShardedJob(Job):
                 # collectors re-sort on read; skip the per-row merge
                 rows = [r for sh in shard_rows for r in sh]
             self._emit_rows(schema, rows)
+        if tel.enabled:
+            # same semantics as Job's drain.total: meta check -> rows
+            # emitted (the timestamp merge and sink delivery included),
+            # so the metric is comparable across job kinds
+            tel.record_seconds("drain.total", time.monotonic() - t_req)
+            tel.inc("drains.completed")
 
     def flush(self) -> None:
         for rt in self._plans.values():
             self._drain_plan(rt)
             if not rt.plan.has_flush:
                 continue
-            host = jax.device_get(rt.states)
-            new_shards = []
-            for s in range(self.n_shards):
-                st, outputs = rt.plan.flush(_tree_index(host, s))
-                new_shards.append(st)
-                if outputs:
-                    self._decode_outputs(rt.plan, outputs, only=set(outputs))
-            rt.states = jax.device_put(
-                _tree_stack(new_shards), self._state_sharding
-            )
+            with self.telemetry.span("flush"):
+                host = jax.device_get(rt.states)
+                new_shards = []
+                for s in range(self.n_shards):
+                    st, outputs = rt.plan.flush(_tree_index(host, s))
+                    new_shards.append(st)
+                    if outputs:
+                        self._decode_outputs(
+                            rt.plan, outputs, only=set(outputs)
+                        )
+                rt.states = jax.device_put(
+                    _tree_stack(new_shards), self._state_sharding
+                )
+
+    # -- observability -------------------------------------------------------
+    def metrics(self, drain: bool = False):
+        """Adds the cross-shard view: every shard's decode-time
+        histogram folded into one (``LatencyHistogram.merge`` — the
+        associative shard-aggregation primitive) plus the router's
+        per-shard placement counts."""
+        m = super().metrics(drain)
+        if not self.telemetry.enabled:
+            return m
+        merged = LatencyHistogram()
+        for rt in list(self._plans.values()):
+            for h in getattr(rt, "_shard_decode_hists", ()):
+                merged.merge(h)
+        m["telemetry"]["histograms"]["drain.shard_decode"] = (
+            merged.snapshot()
+        )
+        m["telemetry"]["gauges"]["route.cumulative_per_shard"] = {
+            pid: [int(x) for x in r.routed]
+            for pid, r in list(self._routers.items())
+        }
+        return m
 
     # -- results: merge shard-interleaved output back to time order ---------
     def results_with_ts(self, output_stream: str):

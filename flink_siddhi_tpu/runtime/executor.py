@@ -27,6 +27,7 @@ import numpy as np
 
 from ..compiler.plan import CompiledPlan
 from ..schema.batch import EventBatch
+from ..telemetry import MetricsRegistry
 from .sources import Source
 from .tape import bucket_size, build_wire_tape
 
@@ -308,16 +309,16 @@ class Job:
         self._last_cycle_t: Optional[float] = None
         # per-plan capacity-check cadence (recomputed as plans come and go)
         self._drain_hints: Dict[str, int] = {}
-        # observability: when True, each drain's request->completion wall
-        # time is appended here (visibility-latency reporting for jobs
-        # with no row consumers, where match latency can't be sampled),
-        # and drain_stages gets the per-stage decomposition:
-        # wait_ready (request -> packed array computed on device),
-        # queue (ready -> fetch thread picks it up),
-        # fetch (d2h transfer + host decode), total
-        self.record_drain_latency = False
-        self.drain_latencies: List[float] = []
-        self.drain_stages: List[Dict[str, float]] = []
+        # telemetry: stage-attributed wall clock + latency histograms +
+        # counters, snapshotted by metrics()/REST readers. Each drain's
+        # request->completion decomposition (wait_ready: request ->
+        # packed array computed on device; queue: ready -> fetch thread
+        # picks it up; fetch: d2h transfer; decode: host decode;
+        # emit_lag; total) lands in the drain.* histograms. All records
+        # happen at batch/drain boundaries on the host — never inside
+        # the jitted device path. Set .enabled = False to reduce every
+        # span/record to a no-op (the bench overhead A/B switch).
+        self.telemetry = MetricsRegistry()
 
 
     # -- plan management (dynamic control plane hooks) ----------------------
@@ -691,19 +692,21 @@ class Job:
                 # tunneled device even an empty flush costs several
                 # fixed-latency fetches
                 continue
-            rt.states, outputs = self._flush_fn(rt)(rt.states)
-            if outputs:
-                self._decode_outputs(
-                    rt.plan, outputs, only=set(outputs),
-                    lookup=(
-                        rt.lazy.lookup
-                        if getattr(rt, "lazy", None) is not None
-                        else None
-                    ),
-                )
+            with self.telemetry.span("flush"):
+                rt.states, outputs = self._flush_fn(rt)(rt.states)
+                if outputs:
+                    self._decode_outputs(
+                        rt.plan, outputs, only=set(outputs),
+                        lookup=(
+                            rt.lazy.lookup
+                            if getattr(rt, "lazy", None) is not None
+                            else None
+                        ),
+                    )
         # stream end: rate-limited output still buffered surfaces now
-        for sid, limiter in self._rate_limiters.items():
-            self._emit_pending(sid, limiter.flush())
+        with self.telemetry.span("flush"):
+            for sid, limiter in self._rate_limiters.items():
+                self._emit_pending(sid, limiter.flush())
 
     _noop_jit = None
 
@@ -780,14 +783,16 @@ class Job:
         the fetches — the accumulator is swapped for a fresh one and its
         meta/data transfers overlap with subsequent device cycles, to be
         decoded by a later poll (run_cycle) or a waiting drain."""
-        for rt in self._plans.values():
-            self._drain_request(rt)
-            self._drain_poll(rt, block=wait)
+        with self.telemetry.span("drain"):
+            for rt in self._plans.values():
+                self._drain_request(rt)
+                self._drain_poll(rt, block=wait)
 
     def _drain_plan(self, rt: _PlanRuntime) -> None:
         """Synchronous per-plan drain (checkpoint / removal paths)."""
-        self._drain_request(rt)
-        self._drain_poll(rt, block=True)
+        with self.telemetry.span("drain"):
+            self._drain_request(rt)
+            self._drain_poll(rt, block=True)
 
     def _interval_drain(self) -> None:
         """Latency-bounding drain pass over plans someone observes
@@ -914,7 +919,8 @@ class Job:
         first not-ready entry). Eager promotion (blocking on the packed
         array from the fetch thread) was measured on the tunnel and
         does NOT help: the readiness round trip just moves into fetch-
-        thread queueing (drain_stages showed wait_ready ~0 but queue
+        thread queueing (the drain-leg decomposition, now the drain.*
+        histograms, showed wait_ready ~0 but queue
         ~230ms), while the gated form lets two in-flight drains
         pipeline readiness against fetch."""
         for entry in rt.drain_q:
@@ -966,7 +972,7 @@ class Job:
         if packed is None:  # no-consumer fast path: counts only
             meta = np.asarray(acc["meta"])
             if stages is not None:
-                stages["t_fetch1"] = time.monotonic()
+                stages["t_dec0"] = stages["t_fetch1"] = time.monotonic()
             return meta[0], meta[1], None
         arr = np.asarray(packed)
         meta = arr[: 2 * a_count].reshape(2, a_count)
@@ -977,11 +983,18 @@ class Job:
             rt.plan.acc_capacity(),
         )
         if max_n == 0:
+            # stamp the leg ends: falling back to the run-loop poll
+            # time would record idle poll latency as transfer time in
+            # the drain.fetch / drain.transport histograms
+            if stages is not None:
+                stages["t_dec0"] = stages["t_fetch1"] = time.monotonic()
             return counts, overflow, None
         if max_n > width:  # misprediction: pay one extra slice fetch
             data = np.asarray(acc["buf"][:, :rt.fetch_width])[:, :max_n]
         else:
             data = arr[2 * a_count :].reshape(-1, width)[:, :max_n]
+        if stages is not None:
+            stages["t_dec0"] = time.monotonic()
         decoded = rt.plan.drain_decode(
             counts, data,
             lookup=(
@@ -1020,23 +1033,35 @@ class Job:
                 return
             counts, overflow, decoded = fut.result()
             done_entry = rt.drain_q.popleft()
-            if self.record_drain_latency:
+            tel = self.telemetry
+            if tel.enabled:
                 now = time.monotonic()
-                self.drain_latencies.append(now - done_entry["t_req"])
                 st = done_entry.get("stages") or {}
                 t_req = done_entry["t_req"]
                 t_rdy = done_entry.get("t_ready", t_req)
                 t_f0 = st.get("t_fetch0", t_rdy)
                 t_f1 = st.get("t_fetch1", now)
-                self.drain_stages.append(
-                    {
-                        "wait_ready": t_rdy - t_req,
-                        "queue": t_f0 - t_rdy,
-                        "fetch": t_f1 - t_f0,
-                        "emit_lag": now - t_f1,
-                        "total": now - t_req,
-                    }
+                t_d0 = st.get("t_dec0", t_f1)
+                legs = {
+                    "wait_ready": t_rdy - t_req,
+                    "queue": t_f0 - t_rdy,
+                    "fetch": t_d0 - t_f0,  # d2h transfer only
+                    "decode": t_f1 - t_d0,  # host decode only
+                    "emit_lag": now - t_f1,
+                    "total": now - t_req,
+                }
+                # per-leg latency distributions: these histograms (not
+                # ad-hoc lists) are what the bench's latency breakdown
+                # and /api/v1/metrics report
+                for leg, dt in legs.items():
+                    tel.record_seconds(f"drain.{leg}", dt)
+                # transport = the raw tunnel legs of one drain
+                # (readiness round trip + d2h transfer, decode excluded)
+                tel.record_seconds(
+                    "drain.transport",
+                    legs["wait_ready"] + legs["fetch"],
                 )
+                tel.inc("drains.completed")
             for ai, a in enumerate(rt.plan.artifacts):
                 if overflow[ai] > 0:
                     _LOG.warning(
@@ -1109,12 +1134,15 @@ class Job:
             if self.retain_results
             else None
         )
-        for rel_ts, row in rows:
-            abs_ts = epoch + rel_ts
-            if bucket is not None:
-                bucket.append((abs_ts, row))
-            for sink in sinks:
-                sink(abs_ts, row)
+        # sink delivery time is its own (nested) stage: callbacks are
+        # user code whose cost must be visible in the breakdown
+        with self.telemetry.span("sink"):
+            for rel_ts, row in rows:
+                abs_ts = epoch + rel_ts
+                if bucket is not None:
+                    bucket.append((abs_ts, row))
+                for sink in sinks:
+                    sink(abs_ts, row)
 
     @property
     def finished(self) -> bool:
@@ -1129,10 +1157,14 @@ class Job:
         """Pull, apply control, reorder, step, decode. Returns events
         processed. Control events take effect at micro-batch boundaries
         (the reference applies them per event; §3.4)."""
-        self._pull_sources()
-        self._pull_control()
-        self._apply_ready_control()
-        ready = self._release_ready()
+        tel = self.telemetry
+        tel.inc("cycles")
+        with tel.span("ingest"):
+            self._pull_sources()
+            self._pull_control()
+            self._apply_ready_control()
+        with tel.span("reorder"):
+            ready = self._release_ready()
         total = 0
         if ready:
             total = sum(len(b) for b in ready)
@@ -1170,8 +1202,9 @@ class Job:
                     )
             self._last_cycle_t = t_now
         # advance any in-flight drain fetches (never blocks the host)
-        for rt in self._plans.values():
-            self._drain_poll(rt)
+        with tel.span("drain"):
+            for rt in self._plans.values():
+                self._drain_poll(rt)
         now = time.monotonic()
         interval_due = (
             self.drain_interval_ms is not None
@@ -1186,8 +1219,9 @@ class Job:
             # sinks, retention off) skip it: each drain costs a d2h round
             # trip on the tunnel, and with no consumer there is no
             # visibility to bound — their capacity swaps below suffice.
-            self._interval_drain()
-            self._poll_rate_limiters()
+            with tel.span("drain"):
+                self._interval_drain()
+                self._poll_rate_limiters()
             self._last_full_drain = time.monotonic()
         if ready and self._cycles_since_drain >= min(
             self.drain_every_cycles,
@@ -1338,6 +1372,12 @@ class Job:
         ring. Shared by the streaming dispatch path below and the
         bounded-replay pre-stager (runtime/replay.py). The caller is
         responsible for ``plan.grow_state`` before the jitted step."""
+        with self.telemetry.span("tape_build"):
+            return self._stage_tape_body(rt, involved)
+
+    def _stage_tape_body(
+        self, rt: _PlanRuntime, involved: List[EventBatch]
+    ):
         plan = rt.plan
         total = sum(len(b) for b in involved)
         rt.tape_capacity = max(rt.tape_capacity, bucket_size(total))
@@ -1422,23 +1462,28 @@ class Job:
     ) -> None:
         plan = rt.plan
         tape = self._stage_tape(rt, involved)
+        tel = self.telemetry
         # host interning may have discovered new group keys: re-bucket state
         # tables before the jit call (shape change -> one-off retrace)
         rt.states = plan.grow_state(rt.states)
-        # NO device->host fetch here: emissions append to the on-device
-        # accumulator and are drained in bulk (flush/results/periodic check)
-        rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, tape)
-        rt.acc_dirty = True
-        # sliding-window backpressure: a tiny non-donated "ticket" is
-        # derived from the new state each cycle; completed tickets retire
-        # via is_ready polling (free), and only when the device is a full
-        # window behind does the host genuinely block. Holding tickets
-        # (fresh jit outputs) never blocks state-buffer donation.
-        rt.tickets.append(self._make_ticket(rt.states))
+        with tel.span("dispatch"):
+            # NO device->host fetch here: emissions append to the
+            # on-device accumulator and are drained in bulk
+            # (flush/results/periodic check)
+            rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, tape)
+            rt.acc_dirty = True
+            # sliding-window backpressure: a tiny non-donated "ticket"
+            # is derived from the new state each cycle; completed
+            # tickets retire via is_ready polling (free), and only when
+            # the device is a full window behind does the host genuinely
+            # block. Holding tickets (fresh jit outputs) never blocks
+            # state-buffer donation.
+            rt.tickets.append(self._make_ticket(rt.states))
         while rt.tickets and rt.tickets[0].is_ready():
             rt.tickets.popleft()
         if len(rt.tickets) > self.max_inflight_cycles:
-            jax.block_until_ready(rt.tickets.popleft())
+            with tel.span("backpressure_wait"):
+                jax.block_until_ready(rt.tickets.popleft())
             while rt.tickets and rt.tickets[0].is_ready():
                 rt.tickets.popleft()
         self._update_drain_hint(
@@ -1574,6 +1619,10 @@ class Job:
                 len(b) for b in list(self._pending.values())
             ),
             "watermark": None if wm in (MAX_WM, MIN_WM) else wm,
+            # stage-attributed wall clock, latency histograms (drain.*
+            # legs at least; jobs under bench add more), counters —
+            # an atomic registry snapshot, safe off-thread
+            "telemetry": self.telemetry.snapshot(),
         }
 
     # -- results -------------------------------------------------------------

@@ -1,0 +1,107 @@
+"""Metrics registry: the single object a Job's components report into.
+
+One ``MetricsRegistry`` per Job (``job.telemetry``). The run loop, the
+drain fetch thread, the replay stager, the sharded drain path, and the
+sink path all record into it; a metrics reader (``Job.metrics()`` /
+``GET /api/v1/metrics``) snapshots it atomically from any thread.
+
+Everything degrades to near-zero cost when ``enabled`` is False: spans
+return a shared no-op context and record/inc calls return immediately —
+this is the switch the bench's telemetry-overhead A/B flips.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from .histogram import LatencyHistogram
+from .spans import NULL_SPAN, StageTimes
+
+
+class Counter:
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class MetricsRegistry:
+    """Named counters, gauges, histograms, and stage times with an
+    atomic JSON-safe ``snapshot()``."""
+
+    def __init__(self, enabled: bool = True) -> None:
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, object] = {}
+        self._hists: Dict[str, LatencyHistogram] = {}
+        self.stages = StageTimes()
+
+    # -- spans / stage time -------------------------------------------------
+    def span(self, name: str):
+        if not self.enabled:
+            return NULL_SPAN
+        return self.stages.span(name)
+
+    def add_time(self, name: str, seconds: float) -> None:
+        if self.enabled:
+            self.stages.add(name, seconds)
+
+    # -- counters / gauges ---------------------------------------------------
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter()
+            return c
+
+    def inc(self, name: str, n: int = 1) -> None:
+        if self.enabled:
+            self.counter(name).inc(n)
+
+    def gauge(self, name: str, value) -> None:
+        if self.enabled:
+            with self._lock:
+                self._gauges[name] = value
+
+    # -- histograms ----------------------------------------------------------
+    def histogram(self, name: str, **kwargs) -> LatencyHistogram:
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = LatencyHistogram(**kwargs)
+            return h
+
+    def record_seconds(self, name: str, seconds: float) -> None:
+        if self.enabled:
+            self.histogram(name).record_seconds(seconds)
+
+    # -- snapshot ------------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """Atomic, JSON-serializable view: the registry lock pins the
+        name->object maps while each object snapshots under its own
+        lock, so a reader thread never observes a torn registry."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            hists = dict(self._hists)
+        return {
+            "enabled": self.enabled,
+            "counters": {n: c.value for n, c in sorted(counters.items())},
+            "gauges": dict(sorted(gauges.items())),
+            "stages": self.stages.snapshot(),
+            "histograms": {
+                n: h.snapshot() for n, h in sorted(hists.items())
+            },
+        }

@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Validate BENCH_*.json lines against the bench output schema.
+
+Schema v2 (telemetry rounds, bench.py ``schema_version: 2``) adds the
+honest-wall-clock contract: a ``stage_breakdown`` section whose
+top-level stages (flink_siddhi_tpu.telemetry.TOP_LEVEL_STAGES) must
+sum to >= 95% of the end-to-end elapsed wall clock — the gate that
+keeps "unattributed off-clock time" from ever reappearing in a
+headline number. Pre-v2 files (BENCH_r01..r05) validate against the
+legacy subset only.
+
+Usage:
+    python scripts/check_bench_schema.py [FILES...]
+    python scripts/check_bench_schema.py --require-stages FILES...
+
+With no FILES, validates every BENCH_*.json in the repo root. Exit
+status 0 = all valid. ``--require-stages`` additionally fails any file
+that lacks a stage_breakdown (used for freshly-produced bench output,
+where telemetry is expected on).
+
+Runs in the tier-1 lane via tests/test_bench_schema.py.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+MIN_COVERAGE = 0.95
+
+_NUM = (int, float)
+
+
+def _stage_names():
+    from flink_siddhi_tpu.telemetry import TOP_LEVEL_STAGES
+
+    return TOP_LEVEL_STAGES
+
+
+def validate_stage_breakdown(sb, errors: List[str], where: str) -> None:
+    if not isinstance(sb, dict):
+        errors.append(f"{where}: stage_breakdown is not an object")
+        return
+    if sb.get("telemetry") == "off":
+        return  # explicit opt-out run (BENCH_TELEMETRY=0): no contract
+    for key in ("elapsed_s", "attributed_s", "coverage"):
+        if not isinstance(sb.get(key), _NUM):
+            errors.append(
+                f"{where}: stage_breakdown.{key} missing/non-numeric"
+            )
+            return
+    stages = sb.get("stages")
+    if not isinstance(stages, dict) or not stages:
+        errors.append(f"{where}: stage_breakdown.stages missing/empty")
+        return
+    bad = [
+        k for k, v in stages.items() if not isinstance(v, _NUM) or v < 0
+    ]
+    if bad:
+        errors.append(
+            f"{where}: non-numeric/negative stage seconds: {bad}"
+        )
+        return
+    if sb["elapsed_s"] <= 0:
+        errors.append(f"{where}: elapsed_s must be > 0")
+        return
+    top = _stage_names()
+    top_sum = sum(v for k, v in stages.items() if k in top)
+    cov = top_sum / sb["elapsed_s"]
+    # the declared coverage must match a recompute from the stages map
+    if abs(cov - sb["coverage"]) > 0.02:
+        errors.append(
+            f"{where}: declared coverage {sb['coverage']:.4f} != "
+            f"recomputed {cov:.4f} from top-level stages"
+        )
+    if cov < MIN_COVERAGE:
+        errors.append(
+            f"{where}: top-level stages attribute only {cov:.1%} of "
+            f"elapsed wall-clock (< {MIN_COVERAGE:.0%}): "
+            "unattributed off-clock time"
+        )
+    unknown = [
+        k
+        for k in stages
+        if k not in top and not k.startswith("nested.")
+    ]
+    if unknown:
+        errors.append(
+            f"{where}: unknown stage names (not in TOP_LEVEL_STAGES, "
+            f"not nested.*): {unknown}"
+        )
+
+
+def validate_doc(
+    doc, errors: List[str], where: str, require_stages: bool = False
+) -> None:
+    if not isinstance(doc, dict):
+        errors.append(f"{where}: not a JSON object")
+        return
+    for key, types in (
+        ("metric", str),
+        ("value", _NUM),
+        ("unit", str),
+    ):
+        if not isinstance(doc.get(key), types):
+            errors.append(f"{where}: {key} missing or wrong type")
+    for key in (
+        "vs_baseline",
+        "vs_jvm_estimate",
+        "p50_match_latency_ms",
+        "p99_match_latency_ms",
+        "p50_visibility_latency_ms",
+        "p99_visibility_latency_ms",
+        "stage_seconds",
+    ):
+        if key in doc and not isinstance(doc[key], _NUM):
+            errors.append(f"{where}: {key} non-numeric")
+    v2 = doc.get("schema_version", 1) >= 2
+    if "stage_breakdown" in doc:
+        validate_stage_breakdown(doc["stage_breakdown"], errors, where)
+    elif v2 or require_stages:
+        errors.append(
+            f"{where}: schema v2 output lacks stage_breakdown"
+        )
+
+
+def extract_docs(text: str, errors: List[str], path: str):
+    """Bench-output JSON objects from either format:
+
+    * raw bench stdout — one JSON object per line (mixed with logging
+      noise, which is skipped);
+    * a driver-harvest wrapper — one pretty-printed object with the
+      bench stdout embedded in its ``tail`` string (BENCH_r01..r05).
+    """
+    try:
+        wrapper = json.loads(text)
+    except ValueError:
+        wrapper = None
+    if isinstance(wrapper, dict) and "tail" in wrapper:
+        text = str(wrapper.get("tail") or "")
+    docs = []
+    for i, line in enumerate(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue  # non-bench JSON-ish noise
+        if isinstance(doc, dict) and "metric" in doc:
+            docs.append((f"{path}:{i + 1}", doc))
+    if not docs and wrapper is None:
+        errors.append(f"{path}: no bench JSON lines found")
+    return docs
+
+
+def validate_file(path: str, require_stages: bool = False) -> List[str]:
+    errors: List[str] = []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    if not text.strip():
+        return [f"{path}: empty"]
+    for where, doc in extract_docs(text, errors, path):
+        validate_doc(doc, errors, where, require_stages)
+    return errors
+
+
+def main(argv: List[str]) -> int:
+    require = "--require-stages" in argv
+    files = [a for a in argv if not a.startswith("--")]
+    if not files:
+        files = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+    if not files:
+        print("no BENCH_*.json files found")
+        return 1
+    all_errors: List[str] = []
+    for path in files:
+        all_errors.extend(validate_file(path, require))
+    for err in all_errors:
+        print(f"SCHEMA ERROR: {err}")
+    print(
+        f"checked {len(files)} file(s): "
+        + ("FAIL" if all_errors else "ok")
+    )
+    return 1 if all_errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

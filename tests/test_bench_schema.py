@@ -1,0 +1,137 @@
+"""scripts/check_bench_schema.py in the tier-1 lane: the BENCH JSON
+schema gate (stage_breakdown present and attributing >= 95% of elapsed
+wall-clock) validates both synthetic documents and the repo's real
+BENCH_*.json harvest files."""
+
+import glob
+import importlib.util
+import json
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_bench_schema",
+        os.path.join(REPO, "scripts", "check_bench_schema.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+CHECK = _checker()
+
+
+def _v2_doc(coverage=0.97, elapsed=10.0, extra_stages=None):
+    stages = {
+        "plan_compile": 0.5,
+        "stage.compile": elapsed * coverage - 1.0,
+        "replay.dispatch": 0.3,
+        "drain": 0.1,
+        "flush": 0.1,
+        "nested.sink": 0.05,  # drill-down: excluded from the sum
+    }
+    if extra_stages:
+        stages.update(extra_stages)
+    top = CHECK._stage_names()
+    attributed = sum(v for k, v in stages.items() if k in top)
+    return {
+        "metric": "events/sec (headline, 1000 events)",
+        "value": 1234.5,
+        "unit": "events/sec",
+        "vs_baseline": 2.0,
+        "schema_version": 2,
+        "stage_breakdown": {
+            "telemetry": "on",
+            "window": "build_job..final_flush",
+            "elapsed_s": elapsed,
+            "attributed_s": round(attributed, 3),
+            "coverage": round(attributed / elapsed, 4),
+            "stages": stages,
+        },
+    }
+
+
+def test_valid_v2_doc_passes():
+    errors = []
+    CHECK.validate_doc(_v2_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v2_without_stage_breakdown_fails():
+    doc = _v2_doc()
+    del doc["stage_breakdown"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("stage_breakdown" in e for e in errors)
+
+
+def test_low_coverage_fails():
+    doc = _v2_doc(coverage=0.80)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("unattributed off-clock" in e for e in errors)
+
+
+def test_declared_coverage_must_match_stages():
+    doc = _v2_doc()
+    doc["stage_breakdown"]["coverage"] = 0.99  # lies about the stages
+    doc["stage_breakdown"]["stages"]["stage.compile"] = 1.0
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors
+
+
+def test_unknown_stage_names_fail():
+    doc = _v2_doc(extra_stages={"mystery_stage": 1.0})
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("unknown stage names" in e for e in errors)
+
+
+def test_telemetry_off_run_is_exempt():
+    doc = _v2_doc()
+    doc["stage_breakdown"] = {"telemetry": "off"}
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []
+
+
+def test_legacy_doc_passes_without_stages():
+    doc = {
+        "metric": "events/sec (headline, 10000000 events)",
+        "value": 16881096.6,
+        "unit": "events/sec",
+    }
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc", require_stages=True)
+    assert errors  # unless the caller demands the new contract
+
+
+def test_repo_bench_files_validate():
+    files = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+    assert files, "no BENCH_*.json harvest files in repo root"
+    for path in files:
+        assert CHECK.validate_file(path) == []
+
+
+def test_wrapper_format_extraction(tmp_path):
+    inner = json.dumps(_v2_doc())
+    wrapper = json.dumps(
+        {"n": 6, "cmd": "python bench.py", "rc": 0,
+         "tail": "WARNING: noise\n" + inner + "\n"}
+    )
+    p = tmp_path / "BENCH_x.json"
+    p.write_text(wrapper)
+    assert CHECK.validate_file(str(p)) == []
+    # and a broken inner doc is caught through the wrapper
+    bad = _v2_doc(coverage=0.5)
+    p.write_text(
+        json.dumps({"rc": 0, "tail": json.dumps(bad)})
+    )
+    assert CHECK.validate_file(str(p))

@@ -185,8 +185,13 @@ def test_resident_rejects_control_streams():
         [BatchSource("inputStream", schema, iter([]))],
         control_sources=[ctrl],
     )
-    with pytest.raises(ValueError, match="control"):
+    # the rejection must NAME the working alternative: streaming mode
+    # via Job.run()/run_cycle() applies control at batch boundaries
+    with pytest.raises(ValueError, match="control") as ei:
         ResidentReplay(job)
+    msg = str(ei.value)
+    assert "streaming" in msg
+    assert "Job.run()" in msg and "Job.run_cycle()" in msg
 
 
 def test_rerun_is_deterministic_counts_only():
