@@ -5,8 +5,30 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"vs_jvm_estimate", latency fields}.
+Prints ONE JSON line (``schema_version: 3``). One invocation measures
+THREE execution modes and emits all of them in the same document, so a
+regression in any path stays a tracked number:
+
+* ``modes.resident``  — bounded-replay engine throughput (counts-only
+  drains; the historical headline number, still mirrored at top level
+  as ``value``);
+* ``modes.streaming`` — the per-micro-batch dispatch loop (counts-only
+  drains; the unbounded-pipeline path; ROADMAP open item 8);
+* ``modes.sink``      — the DATA path: every row is decoded, merged and
+  delivered to a sink callback (``BENCH_SINK=1`` runs it over the full
+  event count; the default caps it so the slower materializing path
+  does not dominate wall clock — the cap is printed in ``events``).
+
+Each mode section carries its own ``stage_breakdown`` (>= 95% coverage
+contract) and a ``latency`` block with BOTH an in-process
+telemetry-histogram number and the **out-of-process side-channel
+prober** number (flink_siddhi_tpu/telemetry/prober.py): a separate OS
+process injects sentinel events through a real TCP socket source during
+the paced latency phase and stamps send/receive on its own monotonic
+clock. ``discrepancy_ratio`` = prober p99 / telemetry p99 per mode —
+the falsifiability contract: the engine's claims are now checked by a
+clock it does not own, and a contradiction is reported loudly
+(``prober_contradiction``) and rejected by scripts/check_bench_schema.py.
 
 ``vs_baseline``: the reference publishes no numbers (BASELINE.md — repo
 has no benchmarks), so the denominator is MEASURED: the single-core
@@ -20,23 +42,28 @@ north star "vs 20x" was stated against it).
 Env knobs: BENCH_EVENTS (default 10_000_000), BENCH_BATCH (default
 524288 — the per-event device step cost saturates there; in resident
 mode dispatch overhead no longer matters, so the smaller batch's better
-per-event time wins), BENCH_MODE (resident | streaming), BENCH_CONFIG
-(headline | filter | pattern2 | window_groupby | multiquery64),
+per-event time wins), BENCH_CONFIG (headline | filter | pattern2 |
+window_groupby | multiquery64), BENCH_SINK (default 0: sink mode runs
+capped at 2M events; 1: sink mode runs the full BENCH_EVENTS),
 BENCH_TELEMETRY (default 1; 0 disables the telemetry registry — the
-overhead A/B switch).
+overhead A/B switch), BENCH_MODES (comma subset of
+resident,streaming,sink for profiling — emits ``"partial": true``,
+which the schema gate rejects; headline numbers must carry all three),
+BENCH_TRACE_EVERY (per-event trace sample period, default 1024).
 
 ``--dryrun``: a small self-contained run (BENCH_EVENTS defaults to
-200_000, one replay, no latency phase) that still emits the full JSON
-line including ``stage_breakdown`` — the schema gate
-(scripts/check_bench_schema.py) validates its output shape.
+200_000) that still exercises ALL THREE modes and the out-of-process
+prober and emits the full schema-v3 JSON line — the schema gate
+(scripts/check_bench_schema.py + tests/test_bench_schema.py) runs it
+in the tier-1 lane.
 
-Honest wall-clock accounting: every BENCH JSON line carries a
-``stage_breakdown`` section computed from the telemetry subsystem
+Honest wall-clock accounting: every mode section carries a
+``stage_breakdown`` computed from the telemetry subsystem
 (flink_siddhi_tpu/telemetry) — the end-to-end window from job build to
 the final flush, decomposed into named stages that must cover >= 95%
 of elapsed wall-clock (docs/observability.md). Latency percentiles are
-answered by the subsystem's log-bucketed histograms, not ad-hoc
-percentile arithmetic.
+answered by the subsystem's log-bucketed histograms and the per-event
+trace sampler, not ad-hoc percentile arithmetic.
 """
 
 from __future__ import annotations
@@ -190,6 +217,102 @@ def _telemetry_enabled():
     return os.environ.get("BENCH_TELEMETRY", "1") != "0"
 
 
+# -- side-channel probe construction ----------------------------------------
+# Sentinel events ride the REAL ingest path (a SocketLineSource on the
+# latency job's stream) and must (a) match the config's query, (b) carry
+# a recoverable sequence number in the emitted row, and (c) not
+# cross-match with background traffic. (c) is guaranteed by placing
+# probe timestamps ~11 days past the background stream (PROBE_TS_BASE,
+# still within the int32 rebased-ms range): `within`-windowed patterns
+# cannot pair a probe event with a background partial, and multi-event
+# probes are sent in ONE payload so they land adjacent in the same
+# sorted micro-batch.
+
+PROBE_TS_BASE = 1_000_000_000  # ms; background tops out ~BENCH_EVENTS ms
+PROBE_MAGIC = 1.0e9  # price-space sentinel (background prices are < 100)
+_PROBE_LINE = (
+    '{"id": %d, "name": "test_event", "price": %.1f, "timestamp": %d}\n'
+)
+
+
+def _probe_payloads(config, n):
+    """-> (payloads, nonce_of, output_stream): ``payloads[i]`` is the
+    exact line(s) probe ``i`` injects; ``nonce_of(row)`` recovers ``i``
+    from an emitted row (None for background rows)."""
+
+    def from_price(idx):
+        def nonce_of(row):
+            p = float(row[idx])
+            return int(p - PROBE_MAGIC) if p >= PROBE_MAGIC / 2 else None
+
+        return nonce_of
+
+    def from_ts(idx, offset):
+        def nonce_of(row):
+            t = int(row[idx])
+            if t < PROBE_TS_BASE:
+                return None
+            return (t - PROBE_TS_BASE - offset) // 8
+
+        return nonce_of
+
+    if config == "filter":
+        # select id, name, price -> price carries the nonce
+        payloads = [
+            _PROBE_LINE % (2, PROBE_MAGIC + i, PROBE_TS_BASE + i * 8)
+            for i in range(n)
+        ]
+        return payloads, from_price(2), "matches"
+    if config == "headline":
+        # select t1, t3, price (price = s3.price) -> price nonce; the
+        # triplet goes in one payload so s1,s2,s3 land in one batch
+        payloads = []
+        for i in range(n):
+            tb = PROBE_TS_BASE + i * 8
+            payloads.append(
+                _PROBE_LINE % (1, 0.0, tb)
+                + _PROBE_LINE % (2, 0.0, tb + 1)
+                + _PROBE_LINE % (3, PROBE_MAGIC + i, tb + 2)
+            )
+        return payloads, from_price(2), "matches"
+    if config == "pattern2":
+        # select t1, t2 -> t2 = base + i*8 + 1 carries the nonce
+        payloads = []
+        for i in range(n):
+            tb = PROBE_TS_BASE + i * 8
+            payloads.append(
+                _PROBE_LINE % (1, 0.0, tb)
+                + _PROBE_LINE % (2, 0.0, tb + 1)
+            )
+        return payloads, from_ts(1, 1), "matches"
+    if config == "window_groupby":
+        # select id, sum(price), count() group by id -> a UNIQUE probe
+        # id carries the nonce (new group keys exercise the interning /
+        # grow_state path — part of what a live probe should feel)
+        base = 50_000_000
+        payloads = [
+            _PROBE_LINE % (base + i, 1.0, PROBE_TS_BASE + i * 8)
+            for i in range(n)
+        ]
+
+        def nonce_of(row):
+            i = int(row[0])
+            return i - base if i >= base else None
+
+        return payloads, nonce_of, "matches"
+    if config == "multiquery64":
+        # probe query m0 (id==0 -> id==1, select t1, t2): t2 nonce
+        payloads = []
+        for i in range(n):
+            tb = PROBE_TS_BASE + i * 8
+            payloads.append(
+                _PROBE_LINE % (0, 0.0, tb)
+                + _PROBE_LINE % (1, 0.0, tb + 1)
+            )
+        return payloads, from_ts(1, 1), "m0"
+    raise SystemExit(f"no probe spec for BENCH_CONFIG {config!r}")
+
+
 def build_job(config, n_events, batch):
     # the first of these imports pulls in jax (seconds of wall-clock on
     # a cold interpreter): measured and attributed below, not left as
@@ -251,6 +374,12 @@ def build_job(config, n_events, batch):
     # (the <2%-overhead A/B). The setup costs measured above predate the
     # registry, so they are back-filled as stage times.
     job.telemetry.enabled = _telemetry_enabled()
+    # per-event trace sampling (telemetry/tracing.py): deterministic
+    # 1-in-N; the sink-path and latency jobs complete traces into the
+    # true end-to-end trace.e2e histogram
+    job.tracer.sample_every = int(
+        os.environ.get("BENCH_TRACE_EVERY", 1024)
+    )
     job.telemetry.add_time("input_gen", dt_input)
     job.telemetry.add_time("plan_compile", dt_compile)
     job.telemetry.add_time("job_init", dt_import + dt_env + dt_init)
@@ -266,6 +395,129 @@ def build_job(config, n_events, batch):
     with job.telemetry.span("prewarm"):
         job.prewarm_drains()
     return job
+
+
+def _drain_leg_ms(job, q):
+    """Drain request->completion percentile for counts-only jobs: no
+    rows surface, so no per-event trace can complete — the drain leg is
+    the only latency distribution those jobs produce. Deliberately NOT
+    padded with the interval-drain staleness term: counts-only jobs
+    have no consumers, so the interval drain never runs for them
+    (resident drains per segment, streaming swaps on capacity) and
+    adding a constant the job never pays would fake a floor."""
+    dh = job.telemetry.histogram("drain.total")
+    if not dh.count:
+        return None
+    return round(dh.percentile_ms(q), 3)
+
+
+def _mode_resident(config, n_events, batch, dryrun):
+    """Bounded-replay engine throughput (runtime/replay.py) — the whole
+    stream's wire tapes are pre-staged in device HBM off the clock, then
+    the plan advances with ONE device dispatch per drain segment. The
+    timed region measures the ENGINE rather than the shared tunnel's
+    per-dispatch round trips (run-to-run tunnel variance of 2-5x
+    dominated streaming-mode numbers; see BASELINE.md). Semantics are
+    identical — tests/test_replay.py asserts row-exact
+    streaming/resident agreement."""
+    from flink_siddhi_tpu.runtime.replay import ResidentReplay
+
+    t_wall0 = time.perf_counter()
+    job = build_job(config, n_events, batch)
+    rep = ResidentReplay(job)
+    rep.stage()  # host tape build + H2D + compiles: off the clock
+    # the shared tunnel stalls on minute scales (observed 2x on a
+    # single replay); the staged tapes stay in HBM, so repeat the
+    # replay and report the MEDIAN — each run still processes the
+    # full stream
+    n_runs = max(int(os.environ.get("BENCH_RUNS", 1 if dryrun else 3)), 1)
+    t0 = time.perf_counter()
+    rep.run()
+    job.flush()
+    run_times = [time.perf_counter() - t0]
+    for _ in range(n_runs - 1):
+        run_times.append(rep.rerun())
+    elapsed = float(np.median(run_times))
+    elapsed_wall = time.perf_counter() - t_wall0
+    ev_per_sec = rep.total_events / max(elapsed, 1e-9)
+    section = {
+        "events": n_events,
+        "elapsed_s": round(elapsed, 3),
+        "events_per_sec": round(ev_per_sec, 1),
+        "stage_seconds": round(rep.stage_seconds, 2),
+        "runs_elapsed_s": [round(t, 3) for t in run_times],
+        "stage_breakdown": _stage_breakdown(job, elapsed_wall),
+    }
+    return section, job, ev_per_sec
+
+
+def _mode_streaming(config, n_events, batch):
+    """The per-micro-batch dispatch loop (counts-only drains; the
+    unbounded-pipeline fast path — ROADMAP open item 8: this number now
+    rides every BENCH JSON so regressions in the streaming path stay
+    visible even though resident is the headline)."""
+    warmup_cycles = 3
+    t_wall0 = time.perf_counter()
+    job = build_job(config, n_events, batch)
+    cycles = 0
+    t_start = time.perf_counter()
+    t0 = t_start
+    counted_at = 0
+    while not job.finished:
+        job.run_cycle()
+        cycles += 1
+        if cycles == warmup_cycles:
+            t0 = time.perf_counter()
+            counted_at = job.processed_events
+    # final drain + end-of-stream flush (the device->host fetches)
+    # are part of the measured work
+    job.flush()
+    elapsed = time.perf_counter() - t0
+    measured = job.processed_events - counted_at
+    if measured <= 0:  # tiny runs: count everything + warmup wall
+        measured = job.processed_events
+        elapsed = time.perf_counter() - t_start
+    elapsed_wall = time.perf_counter() - t_wall0
+    ev_per_sec = measured / max(elapsed, 1e-9)
+    section = {
+        "events": n_events,
+        "elapsed_s": round(elapsed, 3),
+        "events_per_sec": round(ev_per_sec, 1),
+        "stage_breakdown": _stage_breakdown(job, elapsed_wall),
+    }
+    return section, job
+
+
+def _mode_sink(config, n_events, batch):
+    """The DATA path (ROADMAP: rows-materialized throughput): every
+    emitted row is fetched, decoded, and delivered to a sink callback —
+    the capacity a user consuming results actually gets, as opposed to
+    the counts-only numbers above."""
+    t_wall0 = time.perf_counter()
+    job = build_job(config, n_events, batch)
+    rows = {"n": 0}
+
+    def sink(_abs_ts, _row):
+        rows["n"] += 1
+
+    for rt in job._plans.values():
+        for sid in rt.plan.output_streams():
+            job.add_sink(sid, sink)
+    t0 = time.perf_counter()
+    while not job.finished:
+        job.run_cycle()
+    job.flush()
+    elapsed = time.perf_counter() - t0
+    elapsed_wall = time.perf_counter() - t_wall0
+    ev_per_sec = job.processed_events / max(elapsed, 1e-9)
+    section = {
+        "events": n_events,
+        "elapsed_s": round(elapsed, 3),
+        "events_per_sec": round(ev_per_sec, 1),
+        "rows_emitted": rows["n"],
+        "stage_breakdown": _stage_breakdown(job, elapsed_wall),
+    }
+    return section, job
 
 
 def main():
@@ -286,200 +538,257 @@ def main():
             config, int(os.environ.get("BENCH_BASELINE_EVENTS", 1_000_000))
         )
         return
-    warmup_cycles = 3
-    mode = os.environ.get("BENCH_MODE", "resident")
-
-    # honest-wall-clock window: everything from here to the final
-    # flush is attributed to a named telemetry stage; stage_breakdown
-    # below must cover >= 95% of this elapsed time
-    t_wall0 = time.perf_counter()
-    job = build_job(config, n_events, batch)
-
-    # Phase 1: THROUGHPUT.
-    #
-    # Default mode "resident": the bounded-replay execution path
-    # (runtime/replay.py) — the whole 10M-event stream's wire tapes are
-    # pre-staged in device HBM off the clock, then the plan advances
-    # with ONE device dispatch per drain segment. The timed region is
-    # the replay itself (segment scans + accumulator drains + the
-    # end-of-stream flush), which measures the ENGINE rather than the
-    # shared tunnel's per-dispatch round trips (run-to-run tunnel
-    # variance of 2-5x dominated streaming-mode numbers; see
-    # BASELINE.md). Semantics are identical — tests/test_replay.py
-    # asserts row-exact streaming/resident agreement, and
-    # tests/test_baseline_crosscheck.py ties the same engine to the
-    # per-event reference interpreter on the identical stream.
-    #
-    # BENCH_MODE=streaming keeps the per-micro-batch dispatch loop
-    # (counts-only drains, the long-running-pipeline fast path).
-    stage_s = None
-    if mode == "resident":
-        from flink_siddhi_tpu.runtime.replay import ResidentReplay
-
-        rep = ResidentReplay(job)
-        rep.stage()  # host tape build + H2D + compiles: off the clock
-        # the shared tunnel stalls on minute scales (observed 2x on a
-        # single replay); the staged tapes stay in HBM, so repeat the
-        # replay and report the MEDIAN — each run still processes the
-        # full stream
-        n_runs = max(int(os.environ.get("BENCH_RUNS", 1 if dryrun else 3)), 1)
-        t0 = time.perf_counter()
-        rep.run()
-        job.flush()
-        run_times = [time.perf_counter() - t0]
-        for _ in range(n_runs - 1):
-            run_times.append(rep.rerun())
-        elapsed = float(np.median(run_times))
-        measured = rep.total_events
-        stage_s = round(rep.stage_seconds, 2)
-    else:
-        cycles = 0
-        t_start = time.perf_counter()
-        t0 = t_start
-        counted_at = 0
-        while not job.finished:
-            job.run_cycle()
-            cycles += 1
-            if cycles == warmup_cycles:
-                t0 = time.perf_counter()
-                counted_at = job.processed_events
-        # final drain + end-of-stream flush (the device->host fetches)
-        # are part of the measured work
-        job.flush()
-        elapsed = time.perf_counter() - t0
-        measured = job.processed_events - counted_at
-        if measured <= 0:  # tiny runs: count everything + warmup wall
-            measured = job.processed_events
-            elapsed = time.perf_counter() - t_start
-    elapsed_wall = time.perf_counter() - t_wall0
-    ev_per_sec = measured / max(elapsed, 1e-9)
+    want_modes = [
+        m
+        for m in os.environ.get(
+            "BENCH_MODES", "resident,streaming,sink"
+        ).split(",")
+        if m
+    ]
     base = MEASURED_BASELINE.get(config, BASELINE_EVENTS_PER_SEC)
+    modes = {}
+    mode_jobs = {}
+    ev_per_sec = None
+
+    # Phase 1: THROUGHPUT, one section per execution mode. Every mode
+    # section carries its own honest-wall-clock stage_breakdown
+    # (>= 95% attribution over that mode's build..flush window).
+    if "resident" in want_modes:
+        modes["resident"], mode_jobs["resident"], ev_per_sec = (
+            _mode_resident(config, n_events, batch, dryrun)
+        )
+    if "streaming" in want_modes:
+        modes["streaming"], mode_jobs["streaming"] = _mode_streaming(
+            config, n_events, batch
+        )
+        if ev_per_sec is None:
+            ev_per_sec = modes["streaming"]["events_per_sec"]
+    if "sink" in want_modes:
+        # the materializing path is ~10x slower than counts-only; the
+        # default caps its event count so one bench run stays bounded.
+        # BENCH_SINK=1 runs the full stream (the headline-claims run).
+        sink_events = (
+            n_events
+            if os.environ.get("BENCH_SINK", "0") == "1" or dryrun
+            else min(n_events, 2_000_000)
+        )
+        modes["sink"], mode_jobs["sink"] = _mode_sink(
+            config, sink_events, batch
+        )
+        if ev_per_sec is None:
+            ev_per_sec = modes["sink"]["events_per_sec"]
+    for sec in modes.values():
+        sec["vs_baseline"] = round(sec["events_per_sec"] / base, 3)
+
+    if not modes:
+        raise SystemExit(
+            f"BENCH_MODES={os.environ.get('BENCH_MODES')!r} selects no "
+            "known mode (resident, streaming, sink)"
+        )
+    headline = (
+        modes.get("resident")
+        or modes.get("streaming")
+        or modes["sink"]
+    )
     out = {
         "metric": f"events/sec ({config}, {n_events} events)",
-        "value": round(ev_per_sec, 1),
+        "value": headline["events_per_sec"],
         "unit": "events/sec",
         # measured single-core reference interpreter (bench --baseline)
-        "vs_baseline": round(ev_per_sec / base, 3),
+        "vs_baseline": headline["vs_baseline"],
         # the historical pinned in-JVM Siddhi estimate, for continuity
         "vs_jvm_estimate": round(
-            ev_per_sec / BASELINE_EVENTS_PER_SEC, 3
+            headline["events_per_sec"] / BASELINE_EVENTS_PER_SEC, 3
         ),
-        "mode": mode,
+        "mode": "+".join(m for m in ("resident", "streaming", "sink")
+                         if m in modes),
         # provenance: which denominator vs_baseline divides by (ADVICE
         # r4: the JSON line should be self-describing off this machine)
         "baseline_source": "pinned-measurement (BASELINE.md)",
+        "schema_version": 3,
+        "modes": modes,
     }
-    if stage_s is not None:
-        out["stage_seconds"] = stage_s
-        out["runs_elapsed_s"] = [round(t, 3) for t in run_times]
-    out["stage_breakdown"] = _stage_breakdown(job, elapsed_wall)
-    out["schema_version"] = 2
+    if set(want_modes) != {"resident", "streaming", "sink"}:
+        out["partial"] = True  # profiling subset: schema gate rejects
+    if "resident" in modes:
+        # v2-era tooling compatibility: the resident section's
+        # breakdown mirrored at top level
+        out["stage_seconds"] = modes["resident"]["stage_seconds"]
+        out["runs_elapsed_s"] = modes["resident"]["runs_elapsed_s"]
+        out["stage_breakdown"] = modes["resident"]["stage_breakdown"]
 
-    # Phase 2: MATCH LATENCY at a sustainable offered load (80% of the
-    # measured throughput). At full saturation queueing latency is
-    # unbounded by Little's law — the meaningful p99 is the steady-state
-    # ingest->sink-visibility time under a load the engine keeps up
-    # with, which is how streaming latency is reported in practice.
-    # High-match-rate configs (window_groupby emits one row per EVENT;
-    # multiquery64 fans out 64 queries) would measure host row decode,
-    # not the engine — they report drain request->completion
-    # (visibility) latency from phase 1 instead.
-    measure_latency = (
-        config in ("headline", "pattern2", "filter") and not dryrun
+    # Phase 2: MATCH LATENCY at a sustainable offered load, measured
+    # THREE independent ways and reconciled:
+    #   1. paced sink samples stamped at scheduled due times
+    #      (coordinated-omission-corrected match latency — the v2
+    #      number, still the top-level p99_match_latency_ms);
+    #   2. per-event trace sampling (telemetry/tracing.py): ingest→emit
+    #      per sampled event, queue time included;
+    #   3. the OUT-OF-PROCESS prober (telemetry/prober.py): sentinel
+    #      events through a real socket source, stamped send AND
+    #      receive on the child process's own monotonic clock.
+    # At full saturation queueing latency is unbounded by Little's law —
+    # the meaningful p99 is steady-state under a load the engine keeps
+    # up with. High-match-rate configs (window_groupby emits one row per
+    # EVENT; multiquery64 fans out 64 queries) are paced lower: their
+    # data path IS host row decode, and the prober now measures that
+    # honestly instead of the old visibility-only proxy.
+    from flink_siddhi_tpu.telemetry import LatencyHistogram
+
+    high_match = config in ("window_groupby", "multiquery64")
+    cap = 100_000.0 if high_match else 1_000_000.0
+    # the latency job is a DATA-PATH job (rows decode and reach sinks),
+    # so a sustainable offered load keys off the measured sink-mode
+    # capacity, not the counts-only throughput — pacing above the data
+    # path's capacity just measures unbounded queueing (honestly, but
+    # uselessly: every number becomes the phase duration)
+    pace_base = (
+        modes.get("sink", {}).get("events_per_sec")
+        or ev_per_sec
+        or cap
     )
-    if measure_latency:
-        from flink_siddhi_tpu.telemetry import LatencyHistogram
+    lat_rate = max(min(0.5 * pace_base, cap), 10_000.0)
+    lat_rate = float(os.environ.get("BENCH_LAT_RATE", lat_rate))
+    # RTT floor probes bracket the phase (the shared tunnel drifts on
+    # minute scales); both brackets land in ONE histogram
+    rtt_hist = LatencyHistogram()
+    rtt_hist.record_many_seconds(_measure_rtt())
+    lat_hist, phases, probe = _latency_phase(config, lat_rate, dryrun)
+    rtt_hist.record_many_seconds(_measure_rtt())
 
-        # the floor every ingest->visibility sample pays on a tunneled
-        # device: one dispatch round + one drain fetch, each >= 1 RTT.
-        # Printed so the p99 claim is checkable against the tunnel's
-        # OWN tail (shared link: its p99 is many x its p50). Both RTT
-        # brackets land in ONE histogram: percentiles below come from
-        # it, not from ad-hoc array arithmetic.
-        rtt_hist = LatencyHistogram()
-        rtt_hist.record_many_seconds(_measure_rtt())
-        # offered load: capped at 1M ev/s (~2x the measured single-core
-        # baseline's throughput) and at half the full-throttle rate —
-        # the sink path (data drains over a slow d2h tunnel + host
-        # decode) has lower capacity than the counts-only throughput
-        # phase, and latency above capacity is unbounded queueing (now
-        # honestly visible since samples stamp scheduled due times),
-        # not an engine property
-        lat_rate = min(0.5 * ev_per_sec, 1_000_000.0)
-        lat_rate = float(os.environ.get("BENCH_LAT_RATE", lat_rate))
-        lat_hist, phases = _latency_phase(config, lat_rate)
-        if lat_hist is not None and lat_hist.count:
-            # RTT again AFTER the phase: the shared tunnel drifts on
-            # minute scales, so the floor brackets the measurement
-            rtt_hist.record_many_seconds(_measure_rtt())
-            out["p99_match_latency_ms"] = lat_hist.percentile_ms(99)
-            out["p50_match_latency_ms"] = lat_hist.percentile_ms(50)
-            out["latency_source"] = "telemetry_histogram"
-            out["latency_load_events_per_sec"] = round(lat_rate)
-            # the checkable decomposition: a sample's floor is one
-            # dispatch round + one drain fetch (>= 2 tunnel RTTs) +
-            # drain-interval staleness; p99-vs-floor uses the TUNNEL's
-            # own p99 because the tail of a shared link is the tail of
-            # every fetch that rides it
-            rtt50 = rtt_hist.percentile_ms(50)
-            rtt99 = rtt_hist.percentile_ms(99)
-            interval = phases.get("drain_interval_ms", 0.0)
-            floor50 = 2 * rtt50 + interval
-            floor99 = 2 * rtt99 + interval
-            out["latency_breakdown"] = {
-                "tunnel_rtt_p50_ms": rtt50,
-                "tunnel_rtt_p99_ms": rtt99,
-                "drain_p50_ms": phases.get("drain_p50_ms"),
-                "drain_p99_ms": phases.get("drain_p99_ms"),
-                "drain_wait_ready_p50_ms": phases.get(
-                    "drain_wait_ready_p50_ms"
-                ),
-                "drain_queue_p50_ms": phases.get("drain_queue_p50_ms"),
-                "drain_fetch_p50_ms": phases.get("drain_fetch_p50_ms"),
-                "drain_decode_p50_ms": phases.get(
-                    "drain_decode_p50_ms"
-                ),
-                "drain_emit_lag_p50_ms": phases.get(
-                    "drain_emit_lag_p50_ms"
-                ),
-                "drain_interval_ms": interval,
-                "floor_p50_ms": round(floor50, 1),
-                "floor_p99_ms": round(floor99, 1),
-                "p99_vs_floor": round(
-                    out["p99_match_latency_ms"] / max(floor99, 1e-6), 2
-                ),
-            }
-            # the floor the p99 ACTUALLY stands on: the measured p99 of
-            # the drain's own transport legs (readiness RTT + d2h
-            # fetch) + one dispatch RTT + interval staleness — every
-            # term printed above, every term a raw tunnel measurement
-            tr99 = phases.get("transport_p99_ms")
-            if tr99 is not None:
-                tfloor = tr99 + rtt50 + interval
-                out["latency_breakdown"]["transport_p99_ms"] = tr99
-                out["latency_breakdown"]["transport_floor_p99_ms"] = (
-                    round(tfloor, 1)
-                )
-                out["latency_breakdown"]["p99_vs_transport_floor"] = (
-                    round(
-                        out["p99_match_latency_ms"] / max(tfloor, 1e-6),
-                        2,
-                    )
-                )
-    else:
-        # high-match-rate configs (and dryrun): drain request->
-        # completion (visibility) latency from the throughput phase's
-        # own telemetry histograms, staleness-adjusted by the drain
-        # interval
-        dh = job.telemetry.histogram("drain.total")
-        if dh.count:
-            out["p99_visibility_latency_ms"] = round(
-                dh.percentile_ms(99) + job.drain_interval_ms, 1
+    report = probe.get("report")
+    prober_fields = {
+        "prober_p50_ms": report.percentile_ms(50) if report else None,
+        "prober_p99_ms": report.percentile_ms(99) if report else None,
+        "prober_pid": report.pid if report else None,
+        "prober_parent_pid": os.getpid(),
+        "prober_n_sent": report.n_sent if report else 0,
+        "prober_n_received": report.n_received if report else 0,
+        "prober_lost": len(report.lost) if report else None,
+        "prober_clock": report.clock if report else None,
+        # provenance: the prober measures the live paced serving path
+        # (socket ingest -> match visible at a sink); resident's and
+        # streaming's sections reconcile their internal numbers against
+        # this same external measurement
+        "prober_path": "paced-socket-ingest",
+    }
+    trace_p99 = probe.get("trace_p99_ms")
+    trace_p50 = probe.get("trace_p50_ms")
+
+    # per-mode latency blocks: internal (telemetry) + external (prober)
+    for name, sec in modes.items():
+        job = mode_jobs[name]
+        if name == "sink" and trace_p99 is not None:
+            tele50, tele99 = trace_p50, trace_p99
+            source = "trace_histogram (paced latency job)"
+        else:
+            tele50 = _drain_leg_ms(job, 50)
+            tele99 = _drain_leg_ms(job, 99)
+            source = "drain_histogram (drain.total request->completion)"
+        lat = {
+            "telemetry_p50_ms": tele50,
+            "telemetry_p99_ms": tele99,
+            "telemetry_source": source,
+        }
+        lat.update(prober_fields)
+        if tele99 and lat["prober_p99_ms"]:
+            lat["discrepancy_ratio"] = round(
+                lat["prober_p99_ms"] / tele99, 3
             )
-            out["p50_visibility_latency_ms"] = round(
-                dh.percentile_ms(50) + job.drain_interval_ms, 1
+        else:
+            lat["discrepancy_ratio"] = None
+        sec["latency"] = lat
+
+    if lat_hist is not None and lat_hist.count:
+        out["p99_match_latency_ms"] = lat_hist.percentile_ms(99)
+        out["p50_match_latency_ms"] = lat_hist.percentile_ms(50)
+        out["latency_source"] = "telemetry_histogram"
+        out["latency_load_events_per_sec"] = round(lat_rate)
+        # the checkable decomposition: a sample's floor is one
+        # dispatch round + one drain fetch (>= 2 tunnel RTTs) +
+        # drain-interval staleness; p99-vs-floor uses the TUNNEL's
+        # own p99 because the tail of a shared link is the tail of
+        # every fetch that rides it
+        rtt50 = rtt_hist.percentile_ms(50)
+        rtt99 = rtt_hist.percentile_ms(99)
+        interval = phases.get("drain_interval_ms", 0.0)
+        floor50 = 2 * rtt50 + interval
+        floor99 = 2 * rtt99 + interval
+        out["latency_breakdown"] = {
+            "tunnel_rtt_p50_ms": rtt50,
+            "tunnel_rtt_p99_ms": rtt99,
+            "drain_p50_ms": phases.get("drain_p50_ms"),
+            "drain_p99_ms": phases.get("drain_p99_ms"),
+            "drain_wait_ready_p50_ms": phases.get(
+                "drain_wait_ready_p50_ms"
+            ),
+            "drain_queue_p50_ms": phases.get("drain_queue_p50_ms"),
+            "drain_fetch_p50_ms": phases.get("drain_fetch_p50_ms"),
+            "drain_decode_p50_ms": phases.get("drain_decode_p50_ms"),
+            "drain_emit_lag_p50_ms": phases.get(
+                "drain_emit_lag_p50_ms"
+            ),
+            "drain_interval_ms": interval,
+            "floor_p50_ms": round(floor50, 1),
+            "floor_p99_ms": round(floor99, 1),
+            "p99_vs_floor": round(
+                out["p99_match_latency_ms"] / max(floor99, 1e-6), 2
+            ),
+            "trace_p50_ms": trace_p50,
+            "trace_p99_ms": trace_p99,
+        }
+        # the floor the p99 ACTUALLY stands on: the measured p99 of
+        # the drain's own transport legs (readiness RTT + d2h
+        # fetch) + one dispatch RTT + interval staleness — every
+        # term printed above, every term a raw tunnel measurement
+        tr99 = phases.get("transport_p99_ms")
+        if tr99 is not None:
+            tfloor = tr99 + rtt50 + interval
+            out["latency_breakdown"]["transport_p99_ms"] = tr99
+            out["latency_breakdown"]["transport_floor_p99_ms"] = (
+                round(tfloor, 1)
             )
-            out["latency_source"] = "telemetry_histogram"
+            out["latency_breakdown"]["p99_vs_transport_floor"] = (
+                round(
+                    out["p99_match_latency_ms"] / max(tfloor, 1e-6), 2
+                )
+            )
+        # RECONCILIATION: the out-of-process prober against the floor
+        # claim and the internal end-to-end numbers. A prober p99 far
+        # BELOW the claimed floor means the floor is overstated; a
+        # prober p99 far ABOVE every internal end-to-end number means
+        # the in-process accounting is understating what a user sees.
+        # Either way: say so loudly and let the schema gate reject it.
+        p_p99 = prober_fields["prober_p99_ms"]
+        if p_p99 is not None:
+            out["latency_breakdown"]["prober_p99_ms"] = p_p99
+            out["latency_breakdown"]["prober_vs_floor_p99"] = round(
+                p_p99 / max(floor99, 1e-6), 2
+            )
+            internal = [
+                v
+                for v in (
+                    out.get("p99_match_latency_ms"), trace_p99, floor99,
+                )
+                if v
+            ]
+            if p_p99 < 0.5 * floor99:
+                out["prober_contradiction"] = (
+                    f"prober p99 {p_p99}ms < 0.5x claimed floor "
+                    f"{floor99:.1f}ms: the floor claim is overstated"
+                )
+            elif internal and p_p99 > 3.0 * max(internal):
+                out["prober_contradiction"] = (
+                    f"prober p99 {p_p99}ms > 3x every in-process "
+                    f"end-to-end number (max {max(internal):.1f}ms): "
+                    "internal accounting understates user latency"
+                )
+            if "prober_contradiction" in out:
+                print(
+                    "PROBER CONTRADICTION: "
+                    + out["prober_contradiction"],
+                    file=sys.stderr,
+                )
     print(json.dumps(out))
 
 
@@ -552,14 +861,16 @@ class _PacedSource:
             return None, None, True
         now = time.perf_counter()
         out = []
-        # release every due batch, up to 4 per poll (a stall — e.g. a
+        # release every due batch, up to 3 per poll (a stall — e.g. a
         # drain fetch paying a tunnel RTT — must not throttle the
         # offered load to one batch per cycle, or the phase measures
-        # the throttle; the 4x cap keeps concats on the 1x/2x/4x tape
-        # shapes the warmup precompiled)
+        # the throttle; the 3x cap keeps concats UNDER the warmed 4x
+        # tape bucket even with a few prober sentinels merged into the
+        # same release — 4x + sentinels would cross the power-of-two
+        # boundary and compile a fresh tape shape mid-phase)
         while (
             self.i < len(self.batches)
-            and len(out) < 4
+            and len(out) < 3
             and now >= self.t0 + self.i * self.period
         ):
             out.append(self.batches[self.i])
@@ -572,22 +883,25 @@ class _PacedSource:
         return b, int(b.timestamps.max()), self.i >= len(self.batches)
 
 
-def _latency_phase(config, rate):
+def _latency_phase(config, rate, dryrun=False):
     """Steady-state ingest->sink latency at the given offered load.
     Returns (LatencyHistogram over the middle 80% of the run's
     per-batch samples, per-phase breakdown dict sourced from the
-    latency job's drain.* telemetry histograms)."""
+    latency job's drain.* telemetry histograms, probe dict with the
+    out-of-process prober report + the per-event trace percentiles)."""
     if rate <= 0:
-        return None, {}
+        return None, {}, {}
     # power-of-two micro-batch so catch-up concats (2x, 4x) land on
     # precompiled tape shapes instead of triggering mid-run compiles.
     # Sized so ONE tunnel round trip (~100 ms — every dispatch pays it
     # once drains keep d2h traffic in flight) carries >=1 period of
     # events; smaller batches just queue behind their own RTTs.
-    m = 131072
+    m = 4_096 if dryrun else 131_072
     period = m / rate
-    seconds = float(os.environ.get("BENCH_LAT_SECONDS", 6.0))
-    n_batches = max(int(seconds / period), 10)
+    seconds = float(
+        os.environ.get("BENCH_LAT_SECONDS", 1.5 if dryrun else 6.0)
+    )
+    n_batches = max(int(seconds / period), 16)
     job = build_job(config, m * n_batches, m)
     # each data drain costs ~one d2h round trip that serializes with the
     # pipeline; drains are flow-controlled (skipped while one is in
@@ -595,6 +909,14 @@ def _latency_phase(config, rate):
     # fetches onto the tunnel
     job.drain_interval_ms = float(
         os.environ.get("BENCH_LAT_DRAIN_MS", 60.0)
+    )
+    # denser trace sampling than the throughput phases: a completion
+    # needs the sampled event to also be the match-COMPLETING event
+    # (~1/50 of events for the pattern configs), and the paced phase is
+    # small — 1-in-16 yields enough completed traces for a stable p99
+    # while the stamp cost stays one vectorized mod per batch
+    job.tracer.sample_every = int(
+        os.environ.get("BENCH_LAT_TRACE_EVERY", 16)
     )
     # re-source with the paced release schedule
     src = job._sources[0]
@@ -619,6 +941,20 @@ def _latency_phase(config, rate):
         _EB.concat(batches[2:4]),
         _EB.concat(batches[4:8]),
     ]
+    # the prober's sentinels have far-future, irregular timestamps; the
+    # background's perfectly regular cadence would otherwise warm only
+    # the zero-wire-ts ('d0') tape structure, and the FIRST sentinel
+    # would widen the sticky ts kind to 'i32' — a structurally new tape
+    # and a multi-second XLA compile in the middle of the measured
+    # phase (observed: every probe RTT collapsed to the stall). One
+    # irregular warm batch pins the sticky kind to 'i32' (and the
+    # sticky capacity to the 4x bucket) OFF the clock.
+    irr = _EB.concat(batches[4:8])
+    irr_ts = irr.timestamps.copy()
+    irr_ts[-1] += 500_000_000  # break the cadence, stay within int32 ms
+    warm.append(
+        _EB(irr.stream_id, irr.schema, dict(irr.columns), irr_ts)
+    )
     job._sources = [_BS(batches[0].stream_id, batches[0].schema,
                         iter(warm))]
     job._source_wm = [-(2 ** 62)]
@@ -626,9 +962,31 @@ def _latency_phase(config, rate):
     while not job.finished:
         job.run_cycle()
     job.drain_outputs(wait=True)
-    job._sources = [_PacedSource(batches[warm_n:], period)]
-    job._source_wm = [-(2 ** 62)]
-    job._source_done = [False]
+
+    # the REAL ingest path for the out-of-process prober: a live TCP
+    # socket source on the same stream, fed by the child process. Its
+    # sentinel matches come back through a sink; both endpoints are
+    # stamped on the CHILD's monotonic clock (telemetry/prober.py).
+    from flink_siddhi_tpu.runtime.sources import SocketLineSource
+    from flink_siddhi_tpu.telemetry.prober import SideChannelProber
+
+    sock_src = SocketLineSource(
+        batches[0].stream_id, batches[0].schema, port=0,
+        ts_field="timestamp",
+    )
+    probe_period = 0.04 if dryrun else 0.05
+    n_probes = 30 if dryrun else max(int(seconds / probe_period), 60)
+    probe_timeout = 15.0 if dryrun else 30.0
+    payloads, nonce_of, probe_stream = _probe_payloads(config, n_probes)
+    prober = SideChannelProber(
+        sock_src.host, sock_src.port, payloads,
+        period_s=probe_period, timeout_s=probe_timeout,
+    )
+    job.add_sink(probe_stream, prober.make_sink(nonce_of))
+
+    job._sources = [_PacedSource(batches[warm_n:], period), sock_src]
+    job._source_wm = [-(2 ** 62)] * 2
+    job._source_done = [False, False]
     arrivals = {}
     lat = []
 
@@ -643,10 +1001,16 @@ def _latency_phase(config, rate):
             job.add_sink(out_stream, sink)
     seen = warm_n  # batch indices recovered from event ts are global
     src = job._sources[0]
+    prober.start()
+    # hard stop: if the child dies silently, do not spin forever
+    deadline = (
+        time.perf_counter() + 3 * seconds + probe_timeout + 60.0
+    )
     while not job.finished:
         before = job.processed_events
         job.run_cycle()
-        ingested = (job.processed_events - before) // m
+        delta = job.processed_events - before
+        ingested = delta // m  # probe events (a handful) never sum to m
         if ingested:
             # stamp each batch's SCHEDULED due time, not its ingest
             # time: stamping at ingest would hide queueing delay
@@ -655,9 +1019,18 @@ def _latency_phase(config, rate):
             for _ in range(ingested):
                 arrivals[seen] = src.t0 + (seen - warm_n) * period
                 seen += 1
-        else:
+        elif delta == 0:
             time.sleep(0.002)
+        if job._source_done[0] and (
+            prober.poll_result() is not None
+            or time.perf_counter() > deadline
+        ):
+            # paced stream done and the child reported (or timed out):
+            # close the socket source so the job can finish
+            sock_src.close()
     job.flush()
+    report = prober.result(timeout=probe_timeout)
+    prober.close()
     # per-leg drain percentiles come from the job's own telemetry
     # histograms (runtime/executor.py records every completed drain's
     # wait_ready/queue/fetch/decode/emit_lag/total legs) — the
@@ -683,8 +1056,17 @@ def _latency_phase(config, rate):
     tr = tel.histogram("drain.transport")
     if tr.count:
         phases["transport_p99_ms"] = tr.percentile_ms(99)
+    # the per-event trace view: sampled background events' true
+    # ingest->emit distribution from THIS job (queue time included)
+    trace_e2e = tel.histogram("trace.e2e")
+    probe = {
+        "report": report,
+        "trace_p50_ms": trace_e2e.percentile_ms(50),
+        "trace_p99_ms": trace_e2e.percentile_ms(99),
+        "trace_completed": trace_e2e.count,
+    }
     if not lat:
-        return None, phases
+        return None, phases, probe
     from flink_siddhi_tpu.telemetry import LatencyHistogram
 
     lo = warm_n + 0.1 * (seen - warm_n)  # steady-state window
@@ -692,7 +1074,7 @@ def _latency_phase(config, rate):
     samples = [t for t, b in lat if lo <= b <= hi]
     hist = LatencyHistogram()
     hist.record_many_seconds(samples or [t for t, _ in lat])
-    return hist, phases
+    return hist, phases, probe
 
 
 if __name__ == "__main__":

@@ -9,6 +9,8 @@ boundaries — the same path a control stream takes (§3.4 of the
 reference: MetadataControlEvent / OperationControlEvent).
 
 Routes (JSON in/out):
+    GET    /api/v1/metrics               -> Job.metrics() snapshot
+    GET    /api/v1/traces                -> per-event trace sampling view
     GET    /api/v1/queries               -> {"queries": [plan ids]}
     POST   /api/v1/queries   {"cql": s}  -> {"id": plan_id}
     PUT    /api/v1/queries/<id> {"cql"}  -> {"id": id}
@@ -146,6 +148,18 @@ class QueryControlService:
                     # (response schema: docs/observability.md)
                     return self._reply(
                         200, _json_safe(service.job.metrics())
+                    )
+                if parts == ["api", "v1", "traces"]:
+                    # per-event trace sampling view (telemetry/tracing):
+                    # sample rate, counters, the end-to-end histogram,
+                    # and the ring of recently-completed traces
+                    if service.job is None:
+                        return self._reply(200, {})
+                    tracer = getattr(service.job, "tracer", None)
+                    if tracer is None:
+                        return self._reply(200, {})
+                    return self._reply(
+                        200, _json_safe(tracer.snapshot())
                     )
                 tail = self._route()
                 if tail is None or tail:

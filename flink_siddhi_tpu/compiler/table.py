@@ -572,14 +572,26 @@ def compile_table_join(
     if table_outer:
         raise SiddhiQLError(
             "outer join preserving the table side is not supported: a "
-            "table has no arrival events to emit unmatched rows on "
-            "(siddhi-core likewise only emits on stream triggers)"
+            "table has no arrival events to emit unmatched rows on. "
+            "Reference behavior, siddhi-core 4.2.40 (the version "
+            "pinned by the reference repo's pom.xml): org.wso2.siddhi"
+            ".core.util.parser.JoinInputStreamParser"
+            ".populateJoinProcessors raises SiddhiAppCreationException "
+            "when a TABLE side is the join trigger — only STREAM and "
+            "WINDOW sides can trigger — and unmatched-side rows are "
+            "emitted only by triggering events, so the table-preserving "
+            "half of an outer join never fires there either"
         )
     if sside.stream_id in table_schemas:
         raise SiddhiQLError(
-            "table-table joins are not supported: a join needs a stream "
-            "side to trigger on (siddhi 4.x rejects two static sides "
-            "the same way)"
+            "table-table joins are not supported: a join needs a "
+            "stream side to trigger on. Reference behavior, "
+            "siddhi-core 4.2.40 (the version pinned by the reference "
+            "repo's pom.xml): org.wso2.siddhi.core.util.parser"
+            ".JoinInputStreamParser.parseInputStream raises "
+            "SiddhiAppCreationException when both join inputs are "
+            "static (table) sources — neither side produces the "
+            "triggering events a join runtime executes on"
         )
     if tside.windows:
         raise SiddhiQLError("windows are not valid on a table join side")

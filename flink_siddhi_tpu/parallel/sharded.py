@@ -256,6 +256,8 @@ class ShardedJob(Job):
         router = self._routers[plan.plan_id]
         with tel.span("route"):
             shards = router.route_all(involved)
+        for b in involved:
+            self.tracer.mark(b.timestamps, "route")
         # per-shard placement visibility: a skewed key distribution
         # shows up here long before it shows up as one hot shard
         tel.gauge(
@@ -285,6 +287,8 @@ class ShardedJob(Job):
             rt.states, rt.acc = rt.jitted_acc(
                 rt.states, rt.acc, stacked_tape
             )
+        for b in involved:
+            self.tracer.mark(b.timestamps, "dispatch")
         # shared no-overflow contract (Job._update_drain_hint); strip the
         # leading shard axis via shape metadata only
         self._update_drain_hint(
@@ -355,6 +359,17 @@ class ShardedJob(Job):
             shard_hists = rt._shard_decode_hists = [
                 LatencyHistogram() for _ in range(self.n_shards)
             ]
+        # per-event traces complete PER SHARD into per-shard histograms
+        # (merged by metrics() — the same cross-shard fold as the decode
+        # hists). Rate-limited streams are excluded: their rows may be
+        # thinned at emission, and a thinned row must not stop the
+        # clock — those complete post-limiter in _emit_rows instead
+        # (into the base trace.e2e, without per-shard attribution).
+        shard_trace = getattr(rt, "_shard_trace_hists", None)
+        if shard_trace is None and self.tracer.enabled:
+            shard_trace = rt._shard_trace_hists = [
+                LatencyHistogram() for _ in range(self.n_shards)
+            ]
         # merge each output's per-shard (already time-ordered) rows by
         # timestamp so sinks observe near-monotonic time across shards
         per_schema = {}
@@ -367,6 +382,14 @@ class ShardedJob(Job):
                 )
             for a in rt.plan.artifacts:
                 for schema, rows in decoded.get(a.name) or []:
+                    if (
+                        shard_trace is not None
+                        and schema.stream_id not in self._rate_limiters
+                    ):
+                        self.tracer.complete_rows(
+                            self._epoch_ms or 0, rows,
+                            hist=shard_trace[s],
+                        )
                     per_schema.setdefault(
                         schema.stream_id, (schema, [])
                     )[1].append(rows)
@@ -379,7 +402,12 @@ class ShardedJob(Job):
             else:
                 # collectors re-sort on read; skip the per-row merge
                 rows = [r for sh in shard_rows for r in sh]
-            self._emit_rows(schema, rows)
+            # traces already completed per shard above, except for
+            # rate-limited streams (completed post-limiter here)
+            self._emit_rows(
+                schema, rows,
+                trace=schema.stream_id in self._rate_limiters,
+            )
         if tel.enabled:
             # same semantics as Job's drain.total: meta check -> rows
             # emitted (the timestamp merge and sink delivery included),
@@ -426,6 +454,14 @@ class ShardedJob(Job):
             pid: [int(x) for x in r.routed]
             for pid, r in list(self._routers.items())
         }
+        # fold per-shard trace histograms into the trace view's e2e
+        m["telemetry"]["trace"] = self.tracer.snapshot(
+            extra_hists=[
+                h
+                for rt in list(self._plans.values())
+                for h in getattr(rt, "_shard_trace_hists", ())
+            ]
+        )
         return m
 
     # -- results: merge shard-interleaved output back to time order ---------

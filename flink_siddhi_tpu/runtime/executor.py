@@ -28,6 +28,7 @@ import numpy as np
 from ..compiler.plan import CompiledPlan
 from ..schema.batch import EventBatch
 from ..telemetry import MetricsRegistry
+from ..telemetry.tracing import TraceSampler
 from .sources import Source
 from .tape import bucket_size, build_wire_tape
 
@@ -319,6 +320,15 @@ class Job:
         # the jitted device path. Set .enabled = False to reduce every
         # span/record to a no-op (the bench overhead A/B switch).
         self.telemetry = MetricsRegistry()
+        # per-event trace sampling: a deterministic 1-in-N sample of
+        # events (abs_ts % sample_every == 0) is stamped at source pull
+        # and completed when a row carrying that timestamp surfaces to
+        # a collector/sink — trace.e2e is a TRUE per-event end-to-end
+        # latency histogram (queue time, device backlog, drain interval
+        # and host decode all included), not per-leg p99 arithmetic.
+        # Set sample_every=0 to disable sampling independently of the
+        # rest of the registry.
+        self.tracer = TraceSampler(self.telemetry)
 
 
     # -- plan management (dynamic control plane hooks) ----------------------
@@ -1104,8 +1114,12 @@ class Job:
             if limit and done >= limit:
                 return
 
-    def _emit_rows(self, schema, rows, rate_limit: bool = True) -> None:
-        """Shared append-to-collectors/sinks tail for all decode paths."""
+    def _emit_rows(
+        self, schema, rows, rate_limit: bool = True, trace: bool = True
+    ) -> None:
+        """Shared append-to-collectors/sinks tail for all decode paths.
+        ``trace=False``: the caller already completed these rows'
+        traces (the sharded drain's per-shard path) — skip the scan."""
         if not rows:
             return
         sid = schema.stream_id
@@ -1117,6 +1131,11 @@ class Job:
                     return
         self.output_fields.setdefault(sid, schema.field_names)
         epoch = self._epoch_ms or 0
+        if trace:
+            # rows surfacing to a consumer complete their event's trace
+            # (post-rate-limit: a thinned row is not visible, so it
+            # must not stop the clock)
+            self.tracer.complete_rows(epoch, rows)
         sinks = self._sinks.get(sid)
         self.emitted_counts[sid] = self.emitted_counts.get(sid, 0) + len(rows)
         if not sinks:
@@ -1301,6 +1320,9 @@ class Job:
             batch, wm, done = src.poll(self.batch_size)
             if batch is not None and len(batch):
                 self._pending.setdefault(src.stream_id, []).append(batch)
+                # trace sampling stamps INGEST time (pre-reorder), so a
+                # completed trace includes watermark-gate queueing
+                self.tracer.stamp_ingest(batch.timestamps)
             if wm is not None:
                 self._source_wm[i] = max(self._source_wm[i], wm)
             if done:
@@ -1479,6 +1501,10 @@ class Job:
             # block. Holding tickets (fresh jit outputs) never blocks
             # state-buffer donation.
             rt.tickets.append(self._make_ticket(rt.states))
+        # sampled events' ingest->dispatch leg (dispatch is async: this
+        # marks the point work for the event was HANDED to the device)
+        for b in involved:
+            self.tracer.mark(b.timestamps, "dispatch")
         while rt.tickets and rt.tickets[0].is_ready():
             rt.tickets.popleft()
         if len(rt.tickets) > self.max_inflight_cycles:
@@ -1599,6 +1625,10 @@ class Job:
         if drain:
             self.drain_outputs()
         wm = self._watermark()
+        telemetry = self.telemetry.snapshot()
+        # per-event trace sampling view (tracing.py): sample rate,
+        # stamp/completion counters, and the true end-to-end histogram
+        telemetry["trace"] = self.tracer.snapshot()
         return {
             "processed_events": self.processed_events,
             # list() snapshots below: the run-loop thread mutates these
@@ -1622,7 +1652,7 @@ class Job:
             # stage-attributed wall clock, latency histograms (drain.*
             # legs at least; jobs under bench add more), counters —
             # an atomic registry snapshot, safe off-thread
-            "telemetry": self.telemetry.snapshot(),
+            "telemetry": telemetry,
         }
 
     # -- results -------------------------------------------------------------

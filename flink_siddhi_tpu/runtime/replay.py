@@ -152,6 +152,13 @@ class ResidentReplay:
         if self._staged:
             with tel.span("stage.prewarm"):
                 self.job.prewarm_drains()
+        # per-event trace legs: sampled events were stamped at source
+        # pull (job._pull_sources above); mark the end of staging so a
+        # replay trace decomposes into ingest->staged (tape build + h2d
+        # + compile) and staged->emit (scan + drain + decode)
+        for ready in ready_sets:
+            for b in ready:
+                job.tracer.mark(b.timestamps, "staged")
         self.stage_seconds = time.perf_counter() - t0
 
     def _segment_cycles(self, rt: _PlanRuntime, capacity: int) -> int:

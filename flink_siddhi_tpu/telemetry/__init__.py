@@ -15,8 +15,10 @@ the measured overhead on headline replay throughput is <2%
 """
 
 from .histogram import LatencyHistogram
+from .prober import ProbeReport, SideChannelProber
 from .registry import Counter, MetricsRegistry
 from .spans import NULL_SPAN, StageTimes
+from .tracing import TraceSampler
 
 # Stage names that partition the RUN-LOOP thread's wall-clock (spans
 # opened while another span is active on the same thread accrue under
@@ -59,6 +61,9 @@ __all__ = [
     "LatencyHistogram",
     "MetricsRegistry",
     "NULL_SPAN",
+    "ProbeReport",
+    "SideChannelProber",
     "StageTimes",
     "TOP_LEVEL_STAGES",
+    "TraceSampler",
 ]

@@ -9,6 +9,22 @@ keeps "unattributed off-clock time" from ever reappearing in a
 headline number. Pre-v2 files (BENCH_r01..r05) validate against the
 legacy subset only.
 
+Schema v3 (falsifiable-latency round, bench.py ``schema_version: 3``)
+adds the multi-mode + independent-measurement contract:
+
+* ``modes`` must contain ALL of resident, streaming, sink — one bench
+  run tracks the engine path, the unbounded path, and the
+  rows-materialized data path together (a ``"partial": true`` subset
+  run is rejected: headline numbers must carry all three);
+* every mode section carries its own ``stage_breakdown`` (same >= 95%
+  coverage contract as v2) and a ``latency`` block whose
+  ``telemetry_p99_ms`` AND out-of-process ``prober_p50_ms`` /
+  ``prober_p99_ms`` are present and finite — a bench line whose
+  side-channel prober failed does not validate;
+* the prober-vs-telemetry ``discrepancy_ratio`` is reported per mode
+  (printed, not just stored), and a declared ``prober_contradiction``
+  fails validation outright.
+
 Usage:
     python scripts/check_bench_schema.py [FILES...]
     python scripts/check_bench_schema.py --require-stages FILES...
@@ -25,6 +41,7 @@ from __future__ import annotations
 
 import glob
 import json
+import math
 import os
 import sys
 from typing import List
@@ -33,8 +50,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 MIN_COVERAGE = 0.95
+V3_MODES = ("resident", "streaming", "sink")
 
 _NUM = (int, float)
+
+# informational lines (prober-vs-telemetry discrepancy ratios etc.)
+# collected during validation and printed by main()
+INFO: List[str] = []
+
+
+def _finite(v) -> bool:
+    return isinstance(v, _NUM) and math.isfinite(v)
 
 
 def _stage_names():
@@ -97,6 +123,95 @@ def validate_stage_breakdown(sb, errors: List[str], where: str) -> None:
         )
 
 
+def validate_mode_latency(
+    lat, errors: List[str], where: str, telemetry_off: bool = False
+) -> None:
+    """The v3 falsifiability contract per mode: an in-process number
+    AND an out-of-process prober number, both finite. A
+    ``BENCH_TELEMETRY=0`` overhead-A/B run is exempt from the
+    in-process half only — the prober is external and must still
+    report."""
+    if not isinstance(lat, dict):
+        errors.append(f"{where}: latency is not an object")
+        return
+    required = ["prober_p50_ms", "prober_p99_ms"]
+    if not telemetry_off:
+        required.append("telemetry_p99_ms")
+    for key in required:
+        if not _finite(lat.get(key)):
+            errors.append(
+                f"{where}: latency.{key} missing/non-finite (a failed "
+                "side-channel prober run does not validate)"
+            )
+    for key in ("prober_pid", "prober_parent_pid"):
+        if not isinstance(lat.get(key), int):
+            errors.append(f"{where}: latency.{key} missing/non-int")
+    if (
+        isinstance(lat.get("prober_pid"), int)
+        and isinstance(lat.get("prober_parent_pid"), int)
+        and lat["prober_pid"] == lat["prober_parent_pid"]
+    ):
+        errors.append(
+            f"{where}: prober_pid == prober_parent_pid — the prober "
+            "did not run in a separate OS process"
+        )
+    ratio = lat.get("discrepancy_ratio")
+    if not _finite(ratio):
+        if not telemetry_off:
+            errors.append(
+                f"{where}: latency.discrepancy_ratio missing/non-finite"
+            )
+    else:
+        INFO.append(
+            f"{where}: prober p99 {lat.get('prober_p99_ms')}ms vs "
+            f"telemetry p99 {lat.get('telemetry_p99_ms')}ms — "
+            f"discrepancy ratio {ratio}"
+        )
+
+
+def validate_v3(doc, errors: List[str], where: str) -> None:
+    if doc.get("partial"):
+        errors.append(
+            f"{where}: partial mode subset (BENCH_MODES) — headline "
+            "bench lines must carry all of "
+            + ", ".join(V3_MODES)
+        )
+    modes = doc.get("modes")
+    if not isinstance(modes, dict):
+        errors.append(f"{where}: schema v3 output lacks modes object")
+        return
+    for name in V3_MODES:
+        sec = modes.get(name)
+        if not isinstance(sec, dict):
+            errors.append(f"{where}: modes.{name} missing")
+            continue
+        mwhere = f"{where}:modes.{name}"
+        if not _finite(sec.get("events_per_sec")) or (
+            sec.get("events_per_sec", 0) <= 0
+        ):
+            errors.append(
+                f"{mwhere}: events_per_sec missing/non-positive"
+            )
+        sb = sec.get("stage_breakdown")
+        if sb is None:
+            errors.append(f"{mwhere}: stage_breakdown missing")
+        else:
+            validate_stage_breakdown(sb, errors, mwhere)
+        telemetry_off = (
+            isinstance(sb, dict) and sb.get("telemetry") == "off"
+        )
+        lat = sec.get("latency")
+        if lat is None:
+            errors.append(f"{mwhere}: latency block missing")
+        else:
+            validate_mode_latency(lat, errors, mwhere, telemetry_off)
+    if "prober_contradiction" in doc:
+        errors.append(
+            f"{where}: prober contradicts the in-process claims: "
+            f"{doc['prober_contradiction']}"
+        )
+
+
 def validate_doc(
     doc, errors: List[str], where: str, require_stages: bool = False
 ) -> None:
@@ -121,13 +236,16 @@ def validate_doc(
     ):
         if key in doc and not isinstance(doc[key], _NUM):
             errors.append(f"{where}: {key} non-numeric")
-    v2 = doc.get("schema_version", 1) >= 2
+    version = doc.get("schema_version", 1)
     if "stage_breakdown" in doc:
         validate_stage_breakdown(doc["stage_breakdown"], errors, where)
-    elif v2 or require_stages:
+    elif version >= 2 or require_stages:
         errors.append(
-            f"{where}: schema v2 output lacks stage_breakdown"
+            f"{where}: schema v{max(version, 2)} output lacks "
+            "stage_breakdown"
         )
+    if version >= 3:
+        validate_v3(doc, errors, where)
 
 
 def extract_docs(text: str, errors: List[str], path: str):
@@ -155,7 +273,10 @@ def extract_docs(text: str, errors: List[str], path: str):
             continue  # non-bench JSON-ish noise
         if isinstance(doc, dict) and "metric" in doc:
             docs.append((f"{path}:{i + 1}", doc))
-    if not docs and wrapper is None:
+    if not docs:
+        # applies to wrapper files too: a harvest whose run crashed
+        # before printing its JSON line (tail empty / noise only) must
+        # FAIL the gate, not slide through as trivially valid
         errors.append(f"{path}: no bench JSON lines found")
     return docs
 
@@ -185,6 +306,8 @@ def main(argv: List[str]) -> int:
     all_errors: List[str] = []
     for path in files:
         all_errors.extend(validate_file(path, require))
+    for note in INFO:
+        print(f"PROBER: {note}")
     for err in all_errors:
         print(f"SCHEMA ERROR: {err}")
     print(

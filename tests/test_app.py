@@ -258,6 +258,16 @@ def test_control_service_rest_roundtrip(tmp_path):
         assert status == 200
         assert m["processed_events"] > 0
         assert "ones" in m["emitted"]
+        # the per-event trace view rides the metrics snapshot...
+        trace = m["telemetry"]["trace"]
+        assert trace["sample_every"] > 0
+
+        # ...and has its own endpoint (full payload incl. recent ring)
+        status, t = call("GET", "/api/v1/traces")
+        assert status == 200
+        assert t["sample_every"] == trace["sample_every"]
+        for key in ("sampled", "completed", "pending", "e2e", "recent"):
+            assert key in t
 
         # 404 + 400 paths
         status, _ = call("GET", "/api/v1/nope")

@@ -1,12 +1,18 @@
 """scripts/check_bench_schema.py in the tier-1 lane: the BENCH JSON
 schema gate (stage_breakdown present and attributing >= 95% of elapsed
-wall-clock) validates both synthetic documents and the repo's real
-BENCH_*.json harvest files."""
+wall-clock; schema v3: all three execution modes present, each with a
+finite out-of-process prober p99 next to the telemetry p99) validates
+synthetic documents, the repo's real BENCH_*.json harvest files, AND a
+live ``bench.py --dryrun`` — the dryrun must stay schema-complete:
+three modes + a real prober child process, under the tier-1 timeout."""
 
 import glob
 import importlib.util
 import json
+import math
 import os
+import subprocess
+import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -113,6 +119,188 @@ def test_legacy_doc_passes_without_stages():
     assert errors  # unless the caller demands the new contract
 
 
+# -- schema v3: multi-mode + out-of-process prober contract ---------------
+
+
+def _v3_latency(**over):
+    lat = {
+        "telemetry_p50_ms": 60.0,
+        "telemetry_p99_ms": 95.0,
+        "telemetry_source": "trace_histogram (paced latency job)",
+        "prober_p50_ms": 76.0,
+        "prober_p99_ms": 122.0,
+        "prober_pid": 4242,
+        "prober_parent_pid": 4241,
+        "prober_n_sent": 120,
+        "prober_n_received": 119,
+        "prober_lost": 1,
+        "prober_clock": "child-monotonic",
+        "prober_path": "paced-socket-ingest",
+        "discrepancy_ratio": 1.284,
+    }
+    lat.update(over)
+    return lat
+
+
+def _v3_doc(**over):
+    base = _v2_doc()
+    sb = base["stage_breakdown"]
+    modes = {}
+    for name in ("resident", "streaming", "sink"):
+        modes[name] = {
+            "events": 200_000,
+            "elapsed_s": 1.0,
+            "events_per_sec": 200_000.0,
+            "vs_baseline": 0.4,
+            "stage_breakdown": json.loads(json.dumps(sb)),
+            "latency": _v3_latency(),
+        }
+    base["schema_version"] = 3
+    base["modes"] = modes
+    base.update(over)
+    return base
+
+
+def test_valid_v3_doc_passes():
+    errors = []
+    CHECK.validate_doc(_v3_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v3_requires_all_three_modes():
+    doc = _v3_doc()
+    del doc["modes"]["streaming"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("modes.streaming missing" in e for e in errors)
+
+
+def test_v3_partial_subset_fails():
+    doc = _v3_doc(partial=True)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("partial" in e for e in errors)
+
+
+def test_v3_missing_or_nonfinite_prober_fields_fail():
+    for bad in (
+        {"prober_p99_ms": None},
+        {"prober_p99_ms": float("nan")},
+        {"prober_p50_ms": None},
+        {"telemetry_p99_ms": None},
+        {"discrepancy_ratio": None},
+        {"discrepancy_ratio": float("inf")},
+    ):
+        doc = _v3_doc()
+        doc["modes"]["sink"]["latency"] = _v3_latency(**bad)
+        errors = []
+        CHECK.validate_doc(doc, errors, "doc")
+        assert errors, bad
+
+
+def test_v3_same_pid_means_no_separate_process():
+    doc = _v3_doc()
+    doc["modes"]["resident"]["latency"] = _v3_latency(
+        prober_pid=7, prober_parent_pid=7
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("separate OS process" in e for e in errors)
+
+
+def test_v3_mode_coverage_still_enforced():
+    doc = _v3_doc()
+    doc["modes"]["sink"]["stage_breakdown"]["stages"][
+        "stage.compile"
+    ] = 1.0
+    doc["modes"]["sink"]["stage_breakdown"]["coverage"] = 0.5
+    doc["modes"]["sink"]["stage_breakdown"]["attributed_s"] = 5.0
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "modes.sink" in e and "unattributed off-clock" in e
+        for e in errors
+    )
+
+
+def test_v3_telemetry_off_exempts_internal_half_only():
+    """A BENCH_TELEMETRY=0 overhead-A/B run has no in-process
+    histograms, but the prober is external: its fields stay
+    mandatory."""
+    doc = _v3_doc()
+    sec = doc["modes"]["streaming"]
+    sec["stage_breakdown"] = {"telemetry": "off"}
+    sec["latency"] = _v3_latency(
+        telemetry_p50_ms=None,
+        telemetry_p99_ms=None,
+        discrepancy_ratio=None,
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []
+    sec["latency"]["prober_p99_ms"] = None
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors
+
+
+def test_v3_prober_contradiction_fails():
+    doc = _v3_doc(
+        prober_contradiction="prober p99 5000ms > 3x internal claims"
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("contradicts" in e for e in errors)
+
+
+def test_v3_reports_discrepancy_ratio():
+    CHECK.INFO.clear()
+    errors = []
+    CHECK.validate_doc(_v3_doc(), errors, "doc")
+    assert errors == []
+    assert any("discrepancy ratio" in n for n in CHECK.INFO)
+
+
+def test_dryrun_emits_schema_complete_v3(tmp_path):
+    """The live contract: ``bench.py --dryrun`` (small events, one
+    replay, short paced phase) exercises resident + streaming + sink
+    AND the out-of-process prober, and its JSON line passes the v3
+    schema gate — in the tier-1 lane, under its timeout."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_EVENTS="40000",
+        BENCH_BATCH="8192",
+        BENCH_LAT_SECONDS="1.0",
+        BENCH_RUNS="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--dryrun"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = tmp_path / "BENCH_dryrun.json"
+    out.write_text(proc.stdout)
+    assert CHECK.validate_file(str(out)) == []
+    doc = [
+        json.loads(l)
+        for l in proc.stdout.splitlines()
+        if l.strip().startswith("{")
+    ][-1]
+    assert doc["schema_version"] == 3
+    assert set(doc["modes"]) == {"resident", "streaming", "sink"}
+    for name, sec in doc["modes"].items():
+        lat = sec["latency"]
+        # the prober demonstrably ran out of process, and its numbers
+        # are real finite measurements
+        assert lat["prober_pid"] != lat["prober_parent_pid"]
+        assert math.isfinite(lat["prober_p99_ms"])
+        assert math.isfinite(lat["telemetry_p99_ms"])
+        assert math.isfinite(lat["discrepancy_ratio"])
+        assert sec["stage_breakdown"]["coverage"] >= 0.95
+    assert "prober_contradiction" not in doc
+
+
 def test_repo_bench_files_validate():
     files = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
     assert files, "no BENCH_*.json harvest files in repo root"
@@ -135,3 +323,9 @@ def test_wrapper_format_extraction(tmp_path):
         json.dumps({"rc": 0, "tail": json.dumps(bad)})
     )
     assert CHECK.validate_file(str(p))
+    # a wrapper whose run crashed before printing its JSON line
+    # (noise-only / empty tail) must fail, not trivially validate
+    p.write_text(json.dumps({"rc": 1, "tail": "Traceback ...\n"}))
+    assert any(
+        "no bench JSON lines" in e for e in CHECK.validate_file(str(p))
+    )
