@@ -400,11 +400,9 @@ def build_job(config, n_events, batch):
 def _drain_leg_ms(job, q):
     """Drain request->completion percentile for counts-only jobs: no
     rows surface, so no per-event trace can complete — the drain leg is
-    the only latency distribution those jobs produce. Deliberately NOT
-    padded with the interval-drain staleness term: counts-only jobs
-    have no consumers, so the interval drain never runs for them
-    (resident drains per segment, streaming swaps on capacity) and
-    adding a constant the job never pays would fake a floor."""
+    the only latency distribution those jobs produce. Why it is not
+    padded, and what it means for the high-match configs: BASELINE.md,
+    "What the window_groupby / multiquery64 latency numbers mean"."""
     dh = job.telemetry.histogram("drain.total")
     if not dh.count:
         return None
@@ -629,10 +627,10 @@ def main():
     #      receive on the child process's own monotonic clock.
     # At full saturation queueing latency is unbounded by Little's law —
     # the meaningful p99 is steady-state under a load the engine keeps
-    # up with. High-match-rate configs (window_groupby emits one row per
-    # EVENT; multiquery64 fans out 64 queries) are paced lower: their
-    # data path IS host row decode, and the prober now measures that
-    # honestly instead of the old visibility-only proxy.
+    # up with. High-match-rate configs (window_groupby, multiquery64)
+    # are paced lower — justification lives with the numbers in
+    # BASELINE.md, "What the window_groupby / multiquery64 latency
+    # numbers mean".
     from flink_siddhi_tpu.telemetry import LatencyHistogram
 
     high_match = config in ("window_groupby", "multiquery64")

@@ -66,6 +66,9 @@ class PipelineConfig:
     chunk_bytes: int = 1 << 20  # ingest read granularity
     allowed_lateness_ms: int = 0  # bounded ts disorder in the input
     # (watermark holdback; 0 requires globally sorted ts_field)
+    compression: str = "none"  # produce-side codec for kafka:// output
+    # ('none' | 'gzip'; connectors.kafka.codecs names — needs a broker
+    # negotiating Produce >= 3, i.e. v2 record batches)
 
     def schema(self) -> StreamSchema:
         return StreamSchema(
@@ -164,6 +167,7 @@ class CEPPipeline:
                 sink = KafkaSink(
                     bootstrap, topic, list(schemas[0].field_names),
                     stream_id=out_stream,
+                    compression=cfg.compression,
                 )
                 self._kafka_sinks.append(sink)
                 job.add_sink(out_stream, sink)

@@ -1,0 +1,54 @@
+"""Kafka wire-format layer: everything between raw TCP frames and
+(offset, timestamp, key, value) tuples.
+
+Modules:
+
+* ``varint``   — zigzag varint/varlong encode/decode (v2 records)
+* ``crc32c``   — pure-Python Castagnoli CRC (RFC 3720 vectors in tests)
+* ``codecs``   — compression-codec registry (gzip via stdlib; snappy/
+  lz4/zstd are loud rejections naming the codec)
+* ``records``  — magic 0/1 message sets AND magic 2 record batches,
+  with batch-level CRC32C validated on every decode
+* ``protocol`` — request/response primitives, api keys, and
+  ApiVersions negotiation (pick Fetch/Produce versions per broker,
+  fall back to the v0 dialect for pre-0.10 brokers)
+
+``runtime/kafka.py`` composes these into the engine's KafkaSource /
+KafkaSink; tests/fake_kafka.py composes the same modules into the
+in-process broker, so every byte both sides exchange goes through one
+implementation of the format.
+"""
+
+from .codecs import (  # noqa: F401
+    CODEC_GZIP,
+    CODEC_NONE,
+    UnsupportedCodecError,
+    codec_name,
+    compress,
+    decompress,
+)
+from .crc32c import crc32c  # noqa: F401
+from .errors import BrokerClosedError, KafkaError  # noqa: F401
+from .records import (  # noqa: F401
+    CorruptBatchError,
+    decode_message_set,
+    decode_record_set,
+    encode_message_set,
+    encode_record_batch,
+)
+from .protocol import (  # noqa: F401
+    API_FETCH,
+    API_PRODUCE,
+    API_VERSIONS,
+    IMPLEMENTED,
+    ProtocolError,
+    Reader,
+    Writer,
+    negotiate,
+)
+from .varint import (  # noqa: F401
+    decode_varint,
+    decode_varlong,
+    encode_varint,
+    encode_varlong,
+)

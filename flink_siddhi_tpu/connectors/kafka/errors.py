@@ -1,0 +1,21 @@
+"""One error hierarchy for the whole Kafka stack.
+
+``except KafkaError`` at the engine boundary catches every failure
+this layer can raise — wire-format corruption, codec gaps, protocol
+parse errors, broker-reported errors and transport failures alike.
+Subclasses exist where a caller needs to *distinguish*:
+``BrokerClosedError`` (the broker accepted the connection and then
+hung up — the pre-0.10 answer to ApiVersions, and the only signal
+that may legitimately downgrade the dialect to v0) versus everything
+else (which must propagate, never silently downgrade).
+"""
+
+from __future__ import annotations
+
+
+class KafkaError(RuntimeError):
+    """Base for every error raised by the Kafka connector stack."""
+
+
+class BrokerClosedError(KafkaError):
+    """The broker closed an established connection mid-exchange."""

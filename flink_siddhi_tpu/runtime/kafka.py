@@ -2,23 +2,36 @@
 
 The reference's only deployable job is Kafka-in / Kafka-out
 (experimental CEPPipeline.scala:49-56, FlinkKafkaConsumer010/
-Producer010). This module implements the minimal broker wire protocol
-those adapters need, directly over TCP (the environment has no kafka
-client dependency, and the framework's ingest machinery wants columnar
-chunks, not a callback-per-record client anyway):
+Producer010). This module implements the broker client those adapters
+need, directly over TCP (the environment has no kafka client
+dependency, and the framework's ingest machinery wants columnar
+chunks, not a callback-per-record client anyway). All wire-format
+work — message sets, v2 record batches, varints, CRC32C, compression
+codecs, version negotiation — lives in ``connectors.kafka``; this
+module owns the connection, the request/response flow, and the
+engine-facing Source/Sink contracts.
 
-* Metadata   (api 3, v0) — partition leaders
-* ListOffsets(api 2, v0) — earliest/latest offsets
-* Fetch      (api 1, v0) — message sets, magic 0 and 1 (with ms
-  timestamps) parsed, partial trailing messages truncated
-* Produce    (api 0, v0) — CRC32 message sets, acks=1
+Per connection the client negotiates API versions (ApiVersions,
+KIP-35) and speaks the newest dialect both sides implement:
+
+* Metadata   (api 3,  v0)     — partition leaders
+* ListOffsets(api 2,  v0)     — earliest/latest offsets
+* Fetch      (api 1,  v0/v4)  — v4 returns v2 record batches (CRC32C
+  validated, gzip inflated); v0 returns magic 0/1 message sets;
+  partial trailing entries truncated either way
+* Produce    (api 0,  v0/v3)  — v3 sends v2 record batches with an
+  optional compression codec; v0 sends CRC32 message sets, acks=1
+* ApiVersions(api 18, v0)     — brokers that slam the connection are
+  taken at their word and get the v0 dialect
 
 Offsets are first-class source positions: ``KafkaSource.state_dict``
 returns the per-partition next-fetch offsets and participates in the
 engine checkpoint exactly like file byte offsets do
 (runtime/checkpoint.py), so a restarted pipeline resumes from the
 committed position — the role of the reference's Flink-managed Kafka
-offsets state. Record values are newline-free JSON (or CSV) event
+offsets state. v2 fetches return whole batches, so after a restore
+the source skips records below the committed offset instead of
+re-consuming them. Record values are newline-free JSON (or CSV) event
 payloads decoded by the same native column decoder as every other byte
 source (runtime/sources.py).
 """
@@ -28,163 +41,52 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..connectors.kafka.codecs import CODEC_NONE, codec_id
+from ..connectors.kafka.errors import BrokerClosedError, KafkaError
+from ..connectors.kafka.protocol import (
+    API_FETCH,
+    API_LIST_OFFSETS,
+    API_METADATA,
+    API_PRODUCE,
+    API_VERSIONS,
+    Reader,
+    Writer,
+    decode_api_versions_response,
+    negotiate,
+    request_header,
+)
+from ..connectors.kafka.records import (
+    decode_record_set,
+    encode_message_set,
+    encode_record_batch,
+)
 from ..schema.batch import EventBatch
 from ..schema.stream_schema import StreamSchema
 from .sources import Source
 
-API_PRODUCE = 0
-API_FETCH = 1
-API_LIST_OFFSETS = 2
-API_METADATA = 3
+__all__ = [
+    "EARLIEST",
+    "LATEST",
+    "KafkaClient",
+    "KafkaError",
+    "KafkaSink",
+    "KafkaSource",
+]
 
 EARLIEST = -2
 LATEST = -1
 
 
-class KafkaError(RuntimeError):
-    pass
-
-
-# -- wire primitives (big-endian) -----------------------------------------
-
-class _Writer:
-    def __init__(self) -> None:
-        self.parts: List[bytes] = []
-
-    def i8(self, v):
-        self.parts.append(struct.pack(">b", v))
-        return self
-
-    def i16(self, v):
-        self.parts.append(struct.pack(">h", v))
-        return self
-
-    def i32(self, v):
-        self.parts.append(struct.pack(">i", v))
-        return self
-
-    def i64(self, v):
-        self.parts.append(struct.pack(">q", v))
-        return self
-
-    def string(self, s: Optional[str]):
-        if s is None:
-            return self.i16(-1)
-        b = s.encode("utf-8")
-        self.i16(len(b))
-        self.parts.append(b)
-        return self
-
-    def bytes_(self, b: Optional[bytes]):
-        if b is None:
-            return self.i32(-1)
-        self.i32(len(b))
-        self.parts.append(b)
-        return self
-
-    def raw(self, b: bytes):
-        self.parts.append(b)
-        return self
-
-    def done(self) -> bytes:
-        return b"".join(self.parts)
-
-
-class _Reader:
-    def __init__(self, data: bytes) -> None:
-        self.data = data
-        self.pos = 0
-
-    def _take(self, n: int) -> bytes:
-        if self.pos + n > len(self.data):
-            raise KafkaError("short response")
-        out = self.data[self.pos : self.pos + n]
-        self.pos += n
-        return out
-
-    def i8(self) -> int:
-        return struct.unpack(">b", self._take(1))[0]
-
-    def i16(self) -> int:
-        return struct.unpack(">h", self._take(2))[0]
-
-    def i32(self) -> int:
-        return struct.unpack(">i", self._take(4))[0]
-
-    def i64(self) -> int:
-        return struct.unpack(">q", self._take(8))[0]
-
-    def string(self) -> Optional[str]:
-        n = self.i16()
-        return None if n < 0 else self._take(n).decode("utf-8")
-
-    def bytes_(self) -> Optional[bytes]:
-        n = self.i32()
-        return None if n < 0 else self._take(n)
-
-
-def encode_message_set(values: List[bytes], magic: int = 1,
-                       ts_ms: int = 0) -> bytes:
-    """MessageSet (pre-record-batch format): one CRC32-framed message
-    per value, null keys, no compression."""
-    w = _Writer()
-    for v in values:
-        m = _Writer()
-        m.i8(magic).i8(0)  # magic, attributes
-        if magic >= 1:
-            m.i64(ts_ms)
-        m.bytes_(None).bytes_(v)
-        body = m.done()
-        crc = zlib.crc32(body) & 0xFFFFFFFF
-        msg = struct.pack(">I", crc) + body
-        w.i64(0)  # offset (assigned by broker on produce)
-        w.i32(len(msg))
-        w.raw(msg)
-    return w.done()
-
-
-def decode_message_set(
-    data: bytes,
-) -> List[Tuple[int, Optional[int], Optional[bytes], Optional[bytes]]]:
-    """-> [(offset, ts_ms_or_None, key, value)]; a truncated trailing
-    message (Fetch v0 cuts at max_bytes) is dropped, matching client
-    convention."""
-    out = []
-    pos = 0
-    n = len(data)
-    while pos + 12 <= n:
-        offset, size = struct.unpack(">qi", data[pos : pos + 12])
-        if pos + 12 + size > n:
-            break  # partial trailing message
-        r = _Reader(data[pos + 12 : pos + 12 + size])
-        r.i32()  # crc (trusted transport; fake broker is in-process)
-        magic = r.i8()
-        attrs = r.i8()
-        if attrs & 0x07:
-            # a compressed wrapper message's value is an inner message
-            # set, not an event payload — decoding it as one would
-            # silently drop every record on the topic
-            raise KafkaError(
-                "compressed message sets are not supported; set the "
-                "producer's compression.type=none"
-            )
-        ts = r.i64() if magic >= 1 else None
-        key = r.bytes_()
-        value = r.bytes_()
-        out.append((offset, ts, key, value))
-        pos += 12 + size
-    return out
-
-
 # -- client ----------------------------------------------------------------
 
 class KafkaClient:
-    """One broker connection (v0 protocol). Thread-safe per-call."""
+    """One broker connection. Thread-safe per-call. API versions are
+    negotiated on the first request and pinned for the connection's
+    lifetime (``.negotiated`` exposes the picks)."""
 
     def __init__(
         self, host: str, port: int, client_id: str = "fst",
@@ -196,14 +98,18 @@ class KafkaClient:
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._timeout = timeout_s
+        self._versions: Optional[Dict[int, int]] = None
 
     def close(self) -> None:
         with self._lock:
-            if self._sock is not None:
-                try:
-                    self._sock.close()
-                finally:
-                    self._sock = None
+            self._close_locked()
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
 
     def _conn(self) -> socket.socket:
         if self._sock is None:
@@ -212,34 +118,28 @@ class KafkaClient:
             )
         return self._sock
 
-    def _call(self, api: int, version: int, body: bytes) -> _Reader:
+    def _call_locked(self, api: int, version: int, body: bytes) -> Reader:
+        self._corr += 1
+        corr = self._corr
+        head = request_header(api, version, corr, self.client_id)
+        frame = struct.pack(">i", len(head) + len(body)) + head + body
+        try:
+            s = self._conn()
+            s.sendall(frame)
+            raw = self._read_frame(s)
+        except OSError as e:
+            self._close_locked()
+            raise KafkaError(f"broker io error: {e}") from e
+        r = Reader(raw)
+        got = r.i32()
+        if got != corr:
+            self._close_locked()
+            raise KafkaError(f"correlation mismatch ({got} != {corr})")
+        return r
+
+    def _call(self, api: int, version: int, body: bytes) -> Reader:
         with self._lock:
-            self._corr += 1
-            corr = self._corr
-            head = (
-                _Writer()
-                .i16(api)
-                .i16(version)
-                .i32(corr)
-                .string(self.client_id)
-                .done()
-            )
-            frame = struct.pack(">i", len(head) + len(body)) + head + body
-            try:
-                s = self._conn()
-                s.sendall(frame)
-                raw = self._read_frame(s)
-            except OSError as e:
-                self.close()
-                raise KafkaError(f"broker io error: {e}") from e
-            r = _Reader(raw)
-            got = r.i32()
-            if got != corr:
-                self.close()
-                raise KafkaError(
-                    f"correlation mismatch ({got} != {corr})"
-                )
-            return r
+            return self._call_locked(api, version, body)
 
     @staticmethod
     def _read_frame(s: socket.socket) -> bytes:
@@ -247,20 +147,49 @@ class KafkaClient:
         while len(head) < 4:
             chunk = s.recv(4 - len(head))
             if not chunk:
-                raise KafkaError("broker closed connection")
+                raise BrokerClosedError("broker closed connection")
             head += chunk
         (size,) = struct.unpack(">i", head)
         out = bytearray()
         while len(out) < size:
             chunk = s.recv(min(1 << 16, size - len(out)))
             if not chunk:
-                raise KafkaError("broker closed mid-frame")
+                raise BrokerClosedError("broker closed mid-frame")
             out += chunk
         return bytes(out)
 
+    # -- version negotiation ----------------------------------------------
+    @property
+    def negotiated(self) -> Optional[Dict[int, int]]:
+        """{api: pinned version} after the first request, else None."""
+        return self._versions
+
+    def _ensure_versions_locked(self) -> Dict[int, int]:
+        if self._versions is None:
+            try:
+                r = self._call_locked(API_VERSIONS, 0, b"")
+                broker = decode_api_versions_response(r)
+            except BrokerClosedError:
+                # pre-0.10 broker (or fake in legacy mode): the request
+                # is unknown and an ESTABLISHED connection is slammed —
+                # that IS the negative answer. Drop the wedged socket;
+                # the caller's request reconnects and speaks v0
+                # throughout. Any other failure (connection refused,
+                # timeout, garbled response) propagates: a transient
+                # outage must not pin the v0 dialect for the client's
+                # lifetime.
+                self._close_locked()
+                broker = None
+            self._versions = negotiate(broker)
+        return self._versions
+
+    def api_versions(self) -> Dict[int, int]:
+        with self._lock:
+            return dict(self._ensure_versions_locked())
+
     # -- requests ---------------------------------------------------------
     def metadata(self, topics: List[str]) -> Dict:
-        w = _Writer().i32(len(topics))
+        w = Writer().i32(len(topics))
         for t in topics:
             w.string(t)
         r = self._call(API_METADATA, 0, w.done())
@@ -286,7 +215,7 @@ class KafkaClient:
     def list_offsets(
         self, topic: str, partitions: List[int], time: int = EARLIEST
     ) -> Dict[int, int]:
-        w = _Writer().i32(-1).i32(1).string(topic).i32(len(partitions))
+        w = Writer().i32(-1).i32(1).string(topic).i32(len(partitions))
         for p in partitions:
             w.i32(p).i64(time).i32(1)
         r = self._call(API_LIST_OFFSETS, 0, w.done())
@@ -312,29 +241,36 @@ class KafkaClient:
         min_bytes: int = 1,
     ) -> Dict[int, Tuple[int, List, int]]:
         """-> {partition: (high_watermark, [(offset, ts, key, value)],
-        raw_message_set_bytes)} — the raw size lets callers distinguish
-        'no data' from 'a single record larger than max_bytes'."""
-        w = (
-            _Writer()
-            .i32(-1)
-            .i32(max_wait_ms)
-            .i32(min_bytes)
-            .i32(1)
-            .string(topic)
-            .i32(len(offsets))
-        )
-        for p, off in sorted(offsets.items()):
-            w.i32(p).i64(off).i32(max_bytes)
-        r = self._call(API_FETCH, 0, w.done())
+        raw_record_set_bytes)} — the raw size lets callers distinguish
+        'no data' from 'a single entry larger than max_bytes'. With a
+        negotiated Fetch >= 4 the records arrive as v2 batches
+        (CRC32C-checked, decompressed); either way records below the
+        requested offset may appear (whole-batch/segment resends) and
+        callers must skip them."""
+        with self._lock:
+            version = self._ensure_versions_locked()[API_FETCH]
+            w = Writer().i32(-1).i32(max_wait_ms).i32(min_bytes)
+            if version >= 4:
+                w.i32(max_bytes).i8(0)  # total max_bytes, isolation=read_uncommitted
+            w.i32(1).string(topic).i32(len(offsets))
+            for p, off in sorted(offsets.items()):
+                w.i32(p).i64(off).i32(max_bytes)
+            r = self._call_locked(API_FETCH, version, w.done())
+        if version >= 4:
+            r.i32()  # throttle_time_ms
         out: Dict[int, Tuple[int, List, int]] = {}
         for _ in range(r.i32()):
             r.string()
             for _ in range(r.i32()):
                 pid, err, hw = r.i32(), r.i16(), r.i64()
-                mset = r.bytes_() or b""
+                if version >= 4:
+                    r.i64()  # last_stable_offset
+                    for _ in range(r.i32()):  # aborted_transactions
+                        r.i64(), r.i64()
+                rset = r.bytes_() or b""
                 if err:
                     raise KafkaError(f"Fetch {topic}/{pid}: error {err}")
-                out[pid] = (hw, decode_message_set(mset), len(mset))
+                out[pid] = (hw, decode_record_set(rset), len(rset))
         return out
 
     def produce(
@@ -345,25 +281,47 @@ class KafkaClient:
         acks: int = 1,
         timeout_ms: int = 10_000,
         ts_ms: int = 0,
+        compression: str = "none",
     ) -> int:
-        """-> base offset assigned by the broker."""
-        mset = encode_message_set(values, ts_ms=ts_ms)
-        w = (
-            _Writer()
-            .i16(acks)
-            .i32(timeout_ms)
-            .i32(1)
-            .string(topic)
-            .i32(1)
-            .i32(partition)
-            .bytes_(mset)
-        )
-        r = self._call(API_PRODUCE, 0, w.done())
+        """-> base offset assigned by the broker. ``compression`` is a
+        codecs.py name; anything but 'none' needs a broker speaking
+        Produce >= 3 (v2 record batches)."""
+        codec = codec_id(compression)
+        with self._lock:
+            version = self._ensure_versions_locked()[API_PRODUCE]
+            if version >= 3:
+                rset = encode_record_batch(
+                    [(ts_ms, None, v) for v in values], codec=codec
+                )
+            else:
+                if codec != CODEC_NONE:
+                    raise KafkaError(
+                        f"compression {compression!r} needs a broker "
+                        "speaking Produce >= 3 (v2 record batches); "
+                        "this broker negotiated the v0 dialect — "
+                        "produce uncompressed or upgrade the broker"
+                    )
+                rset = encode_message_set(values, ts_ms=ts_ms)
+            w = Writer()
+            if version >= 3:
+                w.string(None)  # transactional_id
+            (
+                w.i16(acks)
+                .i32(timeout_ms)
+                .i32(1)
+                .string(topic)
+                .i32(1)
+                .i32(partition)
+                .bytes_(rset)
+            )
+            r = self._call_locked(API_PRODUCE, version, w.done())
         base = -1
         for _ in range(r.i32()):
             r.string()
             for _ in range(r.i32()):
                 pid, err, off = r.i32(), r.i16(), r.i64()
+                if version >= 2:
+                    r.i64()  # log_append_time
                 if err:
                     raise KafkaError(
                         f"Produce {topic}/{pid}: error {err}"
@@ -381,7 +339,8 @@ class KafkaSource(Source):
     rows (``fmt='csv'``), decoded by the native column decoder — one
     record per event, so offsets map 1:1 to rows and the checkpointed
     position is exact. Timestamps: ``ts_field`` (epoch ms) when given,
-    else the message timestamp (magic>=1), else arrival order.
+    else the message timestamp (magic>=1 / v2 batches), else arrival
+    order.
 
     The source is unbounded (done only after ``close()`` AND the
     backlog drains), matching SocketLineSource's contract."""
@@ -449,7 +408,10 @@ class KafkaSource(Source):
 
     def _refill(self) -> None:
         """One Fetch for every partition whose fetch position is not
-        known-drained; buffered records carry (pid, offset, ts, value)."""
+        known-drained; buffered records carry (pid, offset, ts, value).
+        Records below the fetch position — legacy segment-start resends
+        AND the head of a v2 batch the committed offset landed inside —
+        are skipped, never re-consumed."""
         want = {
             p: o
             for p, o in self._fetch_pos.items()
@@ -465,7 +427,7 @@ class KafkaSource(Source):
             advanced = False
             for off, ts, _key, value in msgs:
                 if off < self._fetch_pos[pid]:
-                    continue  # v0 fetch can resend from segment start
+                    continue  # already consumed (see docstring)
                 if value is not None:
                     self._buffer.append((pid, off, ts, value))
                 self._fetch_pos[pid] = off + 1
@@ -475,8 +437,8 @@ class KafkaSource(Source):
                 and self._fetch_pos[pid] < hw
                 and raw_len > 0
             ):
-                # a non-empty message set with no complete message at
-                # max_bytes: the next record cannot fit — without this
+                # a non-empty record set with no complete entry at
+                # max_bytes: the next entry cannot fit — without this
                 # check the pipeline would spin on the same offset
                 raise KafkaError(
                     f"{self.topic}/{pid}: record at offset "
@@ -556,7 +518,9 @@ class KafkaSource(Source):
     def load_state_dict(self, d: dict) -> None:
         self.offsets = {int(p): int(o) for p, o in d["offsets"].items()}
         # fetched-but-unconsumed records are not part of the snapshot:
-        # refetch from the restored consumed position
+        # refetch from the restored consumed position (v2 fetches
+        # return the whole containing batch; _refill skips the
+        # already-consumed head)
         self._fetch_pos = dict(self.offsets)
         self._buffer = []
         self._arrival = int(d.get("arrival", 0))
@@ -567,7 +531,9 @@ class KafkaSource(Source):
 class KafkaSink:
     """Produce emitted rows to a topic as JSON objects (one per row) —
     attach with ``job.add_sink(stream, sink)``; call ``flush()`` (or use
-    the pipeline wiring, which flushes per drain) to bound batching."""
+    the pipeline wiring, which flushes per drain) to bound batching.
+    ``compression`` is a codecs.py name applied per produced batch
+    (requires a broker negotiating Produce >= 3)."""
 
     def __init__(
         self,
@@ -577,10 +543,12 @@ class KafkaSink:
         stream_id: Optional[str] = None,
         partition: int = 0,
         flush_every: int = 1024,
+        compression: str = "none",
         client: Optional[KafkaClient] = None,
     ) -> None:
         import json as _json
 
+        codec_id(compression)  # fail on unknown names at build time
         if client is None:
             host, _, port = bootstrap.partition(":")
             client = KafkaClient(host, int(port or 9092))
@@ -590,6 +558,7 @@ class KafkaSink:
         self.names = list(field_names)
         self.stream_id = stream_id
         self.flush_every = flush_every
+        self.compression = compression
         self._buf: List[bytes] = []
         self._json = _json
         self.produced = 0
@@ -612,7 +581,10 @@ class KafkaSink:
     def flush(self) -> None:
         if not self._buf:
             return
-        self.client.produce(self.topic, self.partition, self._buf)
+        self.client.produce(
+            self.topic, self.partition, self._buf,
+            compression=self.compression,
+        )
         self.produced += len(self._buf)
         self._buf = []
 

@@ -1,28 +1,86 @@
-"""In-process fake Kafka broker speaking the v0 wire protocol subset the
-engine's client uses (Metadata/ListOffsets/Fetch/Produce, MessageSet
-magic 0/1). Single node, in-memory logs, enough fidelity to test
-offset semantics: fetches honor offsets, produce appends and assigns
-base offsets, ListOffsets reports earliest/latest."""
+"""In-process fake Kafka broker for protocol-level tests.
+
+Two dialects, selected at construction:
+
+* default ("modern", a >=2.x broker): answers ApiVersions (api 18) and
+  advertises Produce up to v5 / Fetch up to v6 so the client's
+  negotiation exercises real intersection (it implements 3 and 4);
+  Produce v3 accepts v2 record batches (CRC32C validated, gzip
+  inflated — a corrupt batch gets error code 2, CORRUPT_MESSAGE);
+  Fetch v4 serves v2 batches re-encoded with ``fetch_codec`` (gzip by
+  default) and — like a real broker — returns *whole batches*: a fetch
+  offset landing mid-batch returns the batch containing it, and at
+  least one batch is always returned regardless of max_bytes (KIP-74).
+* ``legacy=True`` (a pre-0.10 broker): v0 apis only; an ApiVersions
+  request slams the connection, which is exactly how old brokers
+  answered and what the client's fallback-to-v0 path keys off.
+
+Single node, in-memory logs. Batch boundaries are remembered per
+produce/append call so whole-batch fetch semantics are honest.
+``mangle_batch`` (a bytes->bytes hook applied to every served v2
+batch) lets tests inject corruption or foreign codec flags on the
+wire without touching the log.
+"""
 
 from __future__ import annotations
 
 import socket
 import struct
 import threading
-from typing import Dict, List, Tuple
+from bisect import bisect_right
+from typing import Callable, Dict, List, Optional, Tuple
 
-from flink_siddhi_tpu.runtime.kafka import (
-    _Reader,
-    _Writer,
-    decode_message_set,
-    encode_message_set,
+from flink_siddhi_tpu.connectors.kafka.protocol import (
+    API_FETCH,
+    API_LIST_OFFSETS,
+    API_METADATA,
+    API_PRODUCE,
+    API_VERSIONS,
+    Reader,
+    Writer,
+    encode_api_versions_response,
 )
+from flink_siddhi_tpu.connectors.kafka.records import (
+    CorruptBatchError,
+    decode_record_set,
+    encode_message_set,
+    encode_record_batch,
+)
+
+ERR_CORRUPT_MESSAGE = 2
+ERR_UNKNOWN_TOPIC = 3
+
+# what the modern dialect advertises (intentionally wider than the
+# client implements: negotiation must intersect, not parrot)
+MODERN_API_VERSIONS: Dict[int, Tuple[int, int]] = {
+    API_PRODUCE: (0, 5),
+    API_FETCH: (0, 6),
+    API_LIST_OFFSETS: (0, 2),
+    API_METADATA: (0, 5),
+    API_VERSIONS: (0, 1),
+}
 
 
 class FakeBroker:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        legacy: bool = False,
+        fetch_codec: str = "gzip",
+        api_versions: Optional[Dict[int, Tuple[int, int]]] = None,
+    ) -> None:
         # (topic, partition) -> list of (ts, value)
         self.logs: Dict[Tuple[str, int], List] = {}
+        # (topic, partition) -> sorted batch start offsets; batch i
+        # covers [starts[i], starts[i+1]) (last runs to len(log))
+        self.bounds: Dict[Tuple[str, int], List[int]] = {}
+        self.legacy = legacy
+        self.fetch_codec = fetch_codec
+        self.api_versions = dict(
+            MODERN_API_VERSIONS if api_versions is None else api_versions
+        )
+        self.mangle_batch: Optional[Callable[[bytes], bytes]] = None
         self._lock = threading.Lock()
         self._server = socket.create_server((host, port))
         self._server.settimeout(0.2)
@@ -38,10 +96,14 @@ class FakeBroker:
         with self._lock:
             for p in range(partitions):
                 self.logs.setdefault((topic, p), [])
+                self.bounds.setdefault((topic, p), [])
 
     def append(self, topic: str, partition: int, values, ts_ms=0):
+        """Append values as ONE batch (one bound) — a v4 fetch of any
+        offset inside it returns the whole thing."""
         with self._lock:
             log = self.logs[(topic, partition)]
+            self.bounds.setdefault((topic, partition), []).append(len(log))
             for v in values:
                 if isinstance(v, str):
                     v = v.encode()
@@ -84,95 +146,168 @@ class FakeBroker:
                         return
                     data += chunk
                 resp = self._handle(bytes(data))
+                if resp is None:  # legacy broker: unknown api, hang up
+                    return
                 conn.sendall(struct.pack(">i", len(resp)) + resp)
         finally:
             conn.close()
 
-    def _handle(self, data: bytes) -> bytes:
-        r = _Reader(data)
+    # -- request dispatch -------------------------------------------------
+    def _handle(self, data: bytes) -> Optional[bytes]:
+        r = Reader(data)
         api, version, corr = r.i16(), r.i16(), r.i32()
         r.string()  # client_id
-        w = _Writer().i32(corr)
-        if api == 3:  # Metadata v0
-            n = r.i32()
-            topics = [r.string() for _ in range(n)]
-            with self._lock:
-                if not topics:
-                    topics = sorted({t for t, _ in self.logs})
-                w.i32(1).i32(0).string(self.host).i32(self.port)
-                w.i32(len(topics))
-                for t in topics:
-                    parts = sorted(
-                        p for (tt, p) in self.logs if tt == t
-                    )
-                    w.i16(0 if parts else 3).string(t)
-                    w.i32(len(parts))
-                    for p in parts:
-                        w.i16(0).i32(p).i32(0)
-                        w.i32(1).i32(0)  # replicas [0]
-                        w.i32(1).i32(0)  # isr [0]
-        elif api == 2:  # ListOffsets v0
-            r.i32()  # replica
-            w.i32(r_topics := r.i32())
-            for _ in range(r_topics):
-                t = r.string()
-                np_ = r.i32()
-                w.string(t).i32(np_)
-                for _ in range(np_):
-                    pid, time_, _maxn = r.i32(), r.i64(), r.i32()
-                    with self._lock:
-                        log = self.logs.get((t, pid))
-                    if log is None:
-                        w.i32(pid).i16(3).i32(0)
-                        continue
-                    off = 0 if time_ == -2 else len(log)
-                    w.i32(pid).i16(0).i32(1).i64(off)
-        elif api == 1:  # Fetch v0
-            r.i32()
-            r.i32()
-            r.i32()  # replica, max_wait, min_bytes
-            nt = r.i32()
-            w.i32(nt)
-            for _ in range(nt):
-                t = r.string()
-                np_ = r.i32()
-                w.string(t).i32(np_)
-                for _ in range(np_):
-                    pid, off, maxb = r.i32(), r.i64(), r.i32()
-                    with self._lock:
-                        log = list(self.logs.get((t, pid), ()))
-                    hw = len(log)
-                    mset = b""
-                    size = 0
-                    o = off
-                    while o < hw and size < maxb:
-                        ts, v = log[o]
-                        one = encode_message_set([v], ts_ms=ts)
-                        # stamp the real offset into the entry header
-                        one = struct.pack(">q", o) + one[8:]
-                        mset += one
-                        size += len(one)
-                        o += 1
-                    w.i32(pid).i16(0).i64(hw).bytes_(mset)
-        elif api == 0:  # Produce v0
-            r.i16()
-            r.i32()  # acks, timeout
-            nt = r.i32()
-            w.i32(nt)
-            for _ in range(nt):
-                t = r.string()
-                np_ = r.i32()
-                w.string(t).i32(np_)
-                for _ in range(np_):
-                    pid = r.i32()
-                    mset = r.bytes_() or b""
-                    msgs = decode_message_set(mset)
-                    with self._lock:
-                        log = self.logs.setdefault((t, pid), [])
-                        base = len(log)
-                        for _off, ts, _k, v in msgs:
-                            log.append((ts or 0, v))
-                    w.i32(pid).i16(0).i64(base)
+        w = Writer().i32(corr)
+        if api == API_VERSIONS:
+            if self.legacy:
+                return None  # pre-0.10: slam the connection
+            w.raw(encode_api_versions_response(self.api_versions))
+        elif api == API_METADATA:
+            self._metadata(r, w)
+        elif api == API_LIST_OFFSETS:
+            self._list_offsets(r, w)
+        elif api == API_FETCH:
+            if version not in (0, 4):
+                raise AssertionError(f"fake broker: Fetch v{version}")
+            self._fetch(r, w, version)
+        elif api == API_PRODUCE:
+            if version not in (0, 3):
+                raise AssertionError(f"fake broker: Produce v{version}")
+            self._produce(r, w, version)
         else:
+            if self.legacy:
+                return None
             raise AssertionError(f"fake broker: unsupported api {api}")
         return w.done()
+
+    def _metadata(self, r: Reader, w: Writer) -> None:
+        n = r.i32()
+        topics = [r.string() for _ in range(n)]
+        with self._lock:
+            if not topics:
+                topics = sorted({t for t, _ in self.logs})
+            w.i32(1).i32(0).string(self.host).i32(self.port)
+            w.i32(len(topics))
+            for t in topics:
+                parts = sorted(p for (tt, p) in self.logs if tt == t)
+                w.i16(0 if parts else ERR_UNKNOWN_TOPIC).string(t)
+                w.i32(len(parts))
+                for p in parts:
+                    w.i16(0).i32(p).i32(0)
+                    w.i32(1).i32(0)  # replicas [0]
+                    w.i32(1).i32(0)  # isr [0]
+
+    def _list_offsets(self, r: Reader, w: Writer) -> None:
+        r.i32()  # replica
+        w.i32(r_topics := r.i32())
+        for _ in range(r_topics):
+            t = r.string()
+            np_ = r.i32()
+            w.string(t).i32(np_)
+            for _ in range(np_):
+                pid, time_, _maxn = r.i32(), r.i64(), r.i32()
+                with self._lock:
+                    log = self.logs.get((t, pid))
+                if log is None:
+                    w.i32(pid).i16(ERR_UNKNOWN_TOPIC).i32(0)
+                    continue
+                off = 0 if time_ == -2 else len(log)
+                w.i32(pid).i16(0).i32(1).i64(off)
+
+    # -- fetch ------------------------------------------------------------
+    def _fetch(self, r: Reader, w: Writer, version: int) -> None:
+        r.i32(), r.i32(), r.i32()  # replica, max_wait, min_bytes
+        if version >= 4:
+            r.i32(), r.i8()  # total max_bytes, isolation_level
+            w.i32(0)  # throttle_time_ms
+        nt = r.i32()
+        w.i32(nt)
+        for _ in range(nt):
+            t = r.string()
+            np_ = r.i32()
+            w.string(t).i32(np_)
+            for _ in range(np_):
+                pid, off, maxb = r.i32(), r.i64(), r.i32()
+                with self._lock:
+                    log = list(self.logs.get((t, pid), ()))
+                    bounds = list(self.bounds.get((t, pid), ()))
+                hw = len(log)
+                if version >= 4:
+                    rset = self._serve_batches(log, bounds, off, maxb)
+                    w.i32(pid).i16(0).i64(hw)
+                    w.i64(hw)  # last_stable_offset
+                    w.i32(0)  # aborted_transactions
+                    w.bytes_(rset)
+                else:
+                    rset = self._serve_messages(log, off, maxb)
+                    w.i32(pid).i16(0).i64(hw).bytes_(rset)
+
+    @staticmethod
+    def _serve_messages(log, off: int, maxb: int) -> bytes:
+        """v0 dialect: one legacy message per record, byte-capped."""
+        mset = b""
+        o = off
+        while o < len(log) and len(mset) < maxb:
+            ts, v = log[o]
+            one = encode_message_set([v], ts_ms=ts)
+            # stamp the real offset into the entry header
+            one = struct.pack(">q", o) + one[8:]
+            mset += one
+            o += 1
+        return mset
+
+    def _serve_batches(self, log, bounds, off: int, maxb: int) -> bytes:
+        """v4 dialect: whole v2 batches, starting with the batch that
+        CONTAINS the fetch offset; always at least one batch."""
+        if off >= len(log) or not bounds:
+            return b""
+        from flink_siddhi_tpu.connectors.kafka.codecs import codec_id
+
+        i = max(bisect_right(bounds, off) - 1, 0)
+        out = b""
+        while i < len(bounds) and (not out or len(out) < maxb):
+            start = bounds[i]
+            end = bounds[i + 1] if i + 1 < len(bounds) else len(log)
+            entries = [(ts, None, v) for ts, v in log[start:end]]
+            batch = encode_record_batch(
+                entries,
+                base_offset=start,
+                codec=codec_id(self.fetch_codec),
+            )
+            if self.mangle_batch is not None:
+                batch = self.mangle_batch(batch)
+            out += batch
+            i += 1
+        return out
+
+    # -- produce ----------------------------------------------------------
+    def _produce(self, r: Reader, w: Writer, version: int) -> None:
+        if version >= 3:
+            r.string()  # transactional_id
+        r.i16(), r.i32()  # acks, timeout
+        nt = r.i32()
+        w.i32(nt)
+        for _ in range(nt):
+            t = r.string()
+            np_ = r.i32()
+            w.string(t).i32(np_)
+            for _ in range(np_):
+                pid = r.i32()
+                rset = r.bytes_() or b""
+                try:
+                    msgs = decode_record_set(rset)
+                    err = 0
+                except CorruptBatchError:
+                    msgs, err = [], ERR_CORRUPT_MESSAGE
+                with self._lock:
+                    log = self.logs.setdefault((t, pid), [])
+                    base = len(log)
+                    if msgs:
+                        self.bounds.setdefault((t, pid), []).append(base)
+                    for _off, ts, _k, v in msgs:
+                        log.append((ts or 0, v))
+                w.i32(pid).i16(err).i64(base)
+                if version >= 2:
+                    w.i64(-1)  # log_append_time
+        if version >= 1:
+            w.i32(0)  # throttle_time_ms

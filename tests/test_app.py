@@ -312,12 +312,16 @@ def _kafka_events(n, start=0):
     ]
 
 
-def test_kafka_pipeline_roundtrip():
+@pytest.mark.parametrize("legacy", [False, True], ids=["v2", "v0"])
+def test_kafka_pipeline_roundtrip(legacy):
     """kafka://in -> filter CEP -> kafka://out, the reference's only
-    deployable job shape, against an in-process v0-protocol broker."""
+    deployable job shape, against the in-process broker in BOTH
+    dialects: modern (negotiated Fetch v4 / Produce v3, gzip'd v2
+    record batches both ways) and legacy (pre-0.10 v0 message sets,
+    ApiVersions slams the connection)."""
     from tests.fake_kafka import FakeBroker
 
-    broker = FakeBroker()
+    broker = FakeBroker(legacy=legacy)
     try:
         broker.create_topic("in")
         broker.create_topic("out")
@@ -335,6 +339,7 @@ def test_kafka_pipeline_roundtrip():
             ts_field="timestamp",
             time_mode="processing",
             batch_size=64,
+            compression="none" if legacy else "gzip",
         )
         pipe = CEPPipeline(cfg)
         job = pipe.build()
@@ -417,6 +422,84 @@ def test_kafka_offsets_resume_across_restart(tmp_path):
         job2.flush()
         job2.drain_outputs()
         # exactly once: 140 events total, no duplicates, no gaps
+        assert len(seen) == 140
+        prices = sorted(p for _, p in seen)
+        assert prices == [float(i) for i in range(140)]
+    finally:
+        broker.close()
+
+
+def test_kafka_v2_gzip_resume_mid_batch(tmp_path):
+    """Checkpointed-offset resume over v2+gzip with the committed
+    offset landing MID-BATCH: the topic holds one 100-record gzip'd
+    record batch, the checkpoint commits at offset 64, and a v4 fetch
+    from 64 returns the WHOLE batch — the restarted source must skip
+    the 64 already-consumed records, not re-emit or drop them."""
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.connectors.kafka.protocol import API_FETCH
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.kafka import KafkaClient, KafkaSource
+    from tests.fake_kafka import FakeBroker
+
+    broker = FakeBroker()  # serves fetches as gzip'd v2 batches
+    try:
+        broker.create_topic("t")
+        producer = KafkaClient(broker.host, broker.port)
+        # one produce call = ONE v2 batch of 100 records on the log
+        producer.produce(
+            "t", 0, [e.encode() for e in _kafka_events(100)],
+            compression="gzip",
+        )
+        producer.close()
+        assert broker.bounds[("t", 0)] == [0]
+        schema = PipelineConfig(
+            stream_id="S", fields=FIELDS, cql="", input_path="x",
+            output_path="x",
+        ).schema()
+        cql = "from S select id, price insert into o"
+        seen = []
+
+        def build_job():
+            src = KafkaSource(
+                "S", schema, broker.bootstrap, "t",
+                ts_field="timestamp",
+            )
+            plan = compile_plan(cql, {"S": schema})
+            job = Job(
+                [plan], [src], batch_size=32,
+                time_mode="processing", retain_results=False,
+            )
+            job.add_sink("o", lambda ts, row: seen.append(row))
+            return job, src
+
+        ckpt = str(tmp_path / "ckpt")
+        job1, src1 = build_job()
+        assert src1.client.api_versions()[API_FETCH] == 4
+        while job1.processed_events < 48:
+            job1.run_cycle()
+        job1.save_checkpoint(ckpt)
+        committed = src1.offsets[0]
+        assert 0 < committed < 100  # the point of the test: mid-batch
+        taken_at = len(seen)
+        # a second gzip'd batch lands after the snapshot
+        producer2 = KafkaClient(broker.host, broker.port)
+        producer2.produce(
+            "t", 0, [e.encode() for e in _kafka_events(40, start=100)],
+            compression="gzip",
+        )
+        producer2.close()
+        # simulate the failure: everything after the checkpoint is lost
+        del seen[taken_at:]
+
+        job2, src2 = build_job()
+        job2.restore(ckpt)
+        assert src2.offsets == {0: committed}
+        src2.close()
+        while not job2.finished:
+            job2.run_cycle()
+        job2.flush()
+        job2.drain_outputs()
+        # exactly once across the batch boundary AND the mid-batch seam
         assert len(seen) == 140
         prices = sorted(p for _, p in seen)
         assert prices == [float(i) for i in range(140)]
