@@ -18,6 +18,8 @@ axis and sharded across a key axis with ``shard_map`` over a ``jax.sharding.Mesh
 
 from .api.cep import SiddhiCEP, CEPEnvironment
 from .api.stream import ExecutionStream, Row
+from .compiler.output import ColumnBatch
+from .runtime.executor import ColumnarSink
 from .schema.types import AttributeType
 from .schema.stream_schema import StreamSchema
 from .schema.batch import EventBatch
@@ -33,6 +35,8 @@ __version__ = "0.1.0"
 __all__ = [
     "SiddhiCEP",
     "CEPEnvironment",
+    "ColumnBatch",
+    "ColumnarSink",
     "ExecutionStream",
     "Row",
     "AttributeType",

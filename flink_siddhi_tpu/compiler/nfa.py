@@ -1400,7 +1400,13 @@ class ChainPatternArtifact:
         P = self.pool
         cfg = self._cfg()
         C = len(spec.proj_fns)
-        S = jax.lax.axis_size(axis_name)
+        # jax.lax.axis_size is a later-jax export; psum of a python 1
+        # folds to the same static mesh-axis size on 0.4.x
+        S = (
+            jax.lax.axis_size(axis_name)
+            if hasattr(jax.lax, "axis_size")
+            else int(jax.lax.psum(1, axis_name))
+        )
         sidx = jax.lax.axis_index(axis_name)
 
         preds = jnp.stack(_element_preds(spec, tape, state["enabled"]))
@@ -1582,6 +1588,52 @@ class ChainPatternArtifact:
             else [(t, ()) for t in ts_list]
         )
         return [(schema, rows)]
+
+    def decode_packed_columns(
+        self, n: int, block: "np.ndarray", lookup_np=None
+    ):
+        """Columnar twin of :meth:`decode_packed` (the sink fast lane):
+        same emission_order permutation and lazy-ordinal semantics, but
+        the product is typed numpy columns — lazy values resolve through
+        the ring's vectorized ``lookup_np`` instead of a per-value loop."""
+        from .output import ColumnBatch, emission_order
+        from .select import _lazy_column_np
+
+        schema = self.output_schema
+        if not self.lazy_pairs:
+            return [(schema, schema.decode_packed_columns(n, block))]
+        _rows, row_of, ts_row, ts_ord_row = self._row_plan()
+        if ts_row is not None:
+            ts_arr = np.asarray(block[ts_row, :n]).astype(np.int64)
+        else:
+            ords = np.asarray(block[ts_ord_row, :n])
+            tvals = (
+                lookup_np("@ts", ords)
+                if lookup_np is not None
+                else np.full(n, None, dtype=object)
+            )
+            if tvals.dtype == object:  # evicted ordinals decode ts 0
+                ts_arr = np.asarray(
+                    [0 if v is None else int(v) for v in tvals.tolist()],
+                    np.int64,
+                )
+            else:
+                ts_arr = tvals.astype(np.int64)
+        order = emission_order(ts_arr, n)
+        ts_out = ts_arr[order]
+        cols = {}
+        for c, f in enumerate(schema.fields):
+            raw = np.asarray(block[row_of[c], :n])[order]
+            src = self.spec.proj_srcs[c]
+            if src is not None and src in self.lazy_pairs:
+                cols[f.name] = _lazy_column_np(
+                    raw, f, lookup_np, self.spec.cap_src_key[src]
+                )
+            else:
+                if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
+                    raw = raw.view(np.float32)
+                cols[f.name] = f.decode_column_np(raw)
+        return [(schema, ColumnBatch(ts_out, cols))]
 
     @property
     def flush_is_noop(self) -> bool:

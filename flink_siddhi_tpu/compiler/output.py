@@ -11,6 +11,16 @@ Two device emission layouts exist:
   (stateless select/filter queries, per-event window outputs);
 * ``buffered``: a fixed-capacity match buffer + count (pattern matches,
   batch-window flushes).
+
+Two host decode products exist for each layout:
+
+* per-row ``decode_*`` -> ``[(ts, row_tuple), ...]`` — the historical
+  path, still the default and the compatibility oracle;
+* columnar ``decode_*_columns`` -> :class:`ColumnBatch` — the sink fast
+  lane: typed numpy column arrays in emission order, zero per-row Python
+  tuples (string decode is one ``np.take`` over the table's values
+  array). ``tests/test_output_columnar.py`` pins the two paths to
+  identical data.
 """
 
 from __future__ import annotations
@@ -22,6 +32,47 @@ import numpy as np
 
 from ..schema.strings import StringTable
 from ..schema.types import AttributeType
+
+
+@dataclass
+class ColumnBatch:
+    """One columnar emission batch: relative timestamps (int64, already
+    in emission order) plus one typed numpy array per output field.
+    The unit the columnar sink fast lane delivers — sinks receive
+    ``(abs_ts_array, cols)`` without any row tuples materializing."""
+
+    ts: np.ndarray  # int64 rel-ms timestamps, emission order
+    cols: Dict[str, np.ndarray]  # field name -> decoded column array
+
+    def __len__(self) -> int:
+        return int(self.ts.shape[0])
+
+    def take(self, idx) -> "ColumnBatch":
+        idx = np.asarray(idx)
+        return ColumnBatch(
+            self.ts[idx], {k: v[idx] for k, v in self.cols.items()}
+        )
+
+    @staticmethod
+    def concat(parts: Sequence["ColumnBatch"]) -> "ColumnBatch":
+        if len(parts) == 1:
+            return parts[0]
+        return ColumnBatch(
+            np.concatenate([p.ts for p in parts]),
+            {
+                k: np.concatenate([p.cols[k] for p in parts])
+                for k in parts[0].cols
+            },
+        )
+
+    def rows(self) -> List[Tuple[int, Tuple[Any, ...]]]:
+        """Materialize ``(rel_ts, row_tuple)`` pairs — the per-row
+        compatibility view (fallback delivery to row sinks attached
+        alongside columnar ones, and the equivalence oracle)."""
+        ts_list = self.ts.tolist()
+        col_lists = [v.tolist() for v in self.cols.values()]
+        rows = zip(*col_lists) if col_lists else ((),) * len(ts_list)
+        return list(zip(ts_list, map(tuple, rows)))
 
 
 @dataclass(frozen=True)
@@ -57,6 +108,30 @@ class OutputField:
             return arr.astype(np.float64).tolist()
         return arr.tolist()
 
+    def decode_column_np(self, arr: np.ndarray) -> np.ndarray:
+        """Whole-column decode that STOPS at a typed numpy array (the
+        columnar sink fast lane): no python lists, no per-value loop.
+        Encoded strings decode via ONE ``np.take`` over the table's
+        materialized values array; out-of-range codes decode None,
+        matching ``StringTable.value``."""
+        if self.table is not None:
+            vals = self.table.values_array()
+            codes = np.asarray(arr).astype(np.int64, copy=False)
+            if vals.size == 0:
+                return np.full(codes.shape, None, dtype=object)
+            ok = (codes >= 0) & (codes < vals.size)
+            out = vals[np.where(ok, codes, 0)]  # fancy index: a copy
+            if not bool(ok.all()):
+                out[~ok] = None
+            return out
+        if self.atype == AttributeType.BOOL:
+            return np.asarray(arr).astype(bool)
+        if self.atype in (AttributeType.INT, AttributeType.LONG):
+            return np.asarray(arr).astype(np.int64)
+        if self.atype in (AttributeType.FLOAT, AttributeType.DOUBLE):
+            return np.asarray(arr).astype(np.float64)
+        return np.asarray(arr)
+
 
 @dataclass
 class OutputSchema:
@@ -88,6 +163,20 @@ class OutputSchema:
         rows = zip(*col_lists) if col_lists else ((),) * idx.size
         return list(zip(ts_list, map(tuple, rows)))
 
+    def decode_aligned_columns(
+        self, mask: np.ndarray, ts: np.ndarray, cols: Sequence[np.ndarray]
+    ) -> ColumnBatch:
+        """Columnar twin of :meth:`decode_aligned` (tape order kept)."""
+        idx = np.nonzero(np.asarray(mask))[0]
+        ts_out = np.asarray(ts)[idx].astype(np.int64)
+        return ColumnBatch(
+            ts_out,
+            {
+                f.name: f.decode_column_np(np.asarray(c)[idx])
+                for f, c in zip(self.fields, cols)
+            },
+        )
+
     def decode_packed_block(
         self, n: int, block: np.ndarray, data_row: int = 1
     ) -> List[Tuple[int, Tuple[Any, ...]]]:
@@ -118,6 +207,41 @@ class OutputSchema:
         ]
         rows = zip(*col_lists) if col_lists else ((),) * n
         return list(zip(ts_list, map(tuple, rows)))
+
+    def decode_packed_columns(
+        self, n: int, block: np.ndarray, data_row: int = 1
+    ) -> ColumnBatch:
+        """Columnar twin of :meth:`decode_packed_block`."""
+        cols = []
+        for j, f in enumerate(self.fields):
+            raw = block[data_row + j, :n]
+            if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
+                raw = raw.view(np.float32)
+            cols.append(raw)
+        return self.decode_columns(n, block[0, :n], cols)
+
+    def decode_columns(
+        self, count: int, ts: np.ndarray, cols: Sequence[np.ndarray]
+    ) -> ColumnBatch:
+        """Columnar twin of :meth:`decode_buffered`: the same
+        ``emission_order`` permutation, but the product is typed numpy
+        column arrays — zero per-row tuples. String-table lookups are
+        one vectorized ``np.take`` per encoded field."""
+        n = int(count)
+        if n == 0:
+            return ColumnBatch(
+                np.empty(0, np.int64),
+                {f.name: np.empty(0, object) for f in self.fields},
+            )
+        ts_arr = np.asarray(ts)[:n]
+        order = emission_order(ts_arr, n)
+        return ColumnBatch(
+            ts_arr[order].astype(np.int64),
+            {
+                f.name: f.decode_column_np(np.asarray(c)[:n][order])
+                for f, c in zip(self.fields, cols)
+            },
+        )
 
 
 def emission_order(ts, n: int):

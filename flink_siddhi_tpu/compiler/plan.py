@@ -334,12 +334,20 @@ class CompiledPlan:
         }
 
     def drain_decode(self, counts: np.ndarray, data: np.ndarray,
-                     lookup=None) -> Dict[str, List]:
+                     lookup=None, columnar_streams=frozenset(),
+                     lookup_np=None) -> Dict[str, List]:
         """Host side of a drain: unpack the fetched buffer slice into
-        per-artifact lists of (output_schema, decoded rows). ``data`` is
-        ``buf[:, :max(counts)]`` already on host. Stacked multi-query
+        per-artifact lists of (output_schema, decoded payload). ``data``
+        is ``buf[:, :max(counts)]`` already on host. Stacked multi-query
         artifacts route their rows to each member's own stream;
-        ``lookup`` resolves lazy-projected ordinals."""
+        ``lookup`` resolves lazy-projected ordinals.
+
+        A payload is a row list by default; for artifacts whose output
+        stream is in ``columnar_streams`` (every consumer opted into the
+        columnar protocol — see Job._columnar_streams) and that support
+        a columnar decode, it is a :class:`ColumnBatch` instead —
+        zero per-row tuples. ``lookup_np`` is the vectorized ring
+        resolver the columnar path uses."""
         out: Dict[str, List] = {}
         for ai, (a, (row0, n_rows)) in enumerate(
             zip(self.artifacts, self.acc_layout())
@@ -350,15 +358,30 @@ class CompiledPlan:
                 continue
             block = data[row0:row0 + n_rows, :n]
             if hasattr(a, "decode_packed"):
-                if getattr(a, "wants_lookup", False):
+                # columnar only for artifacts declaring the hook — their
+                # output_schema is a plain attribute (groups route to
+                # many streams and may not expose one; they stay rows)
+                if hasattr(a, "decode_packed_columns") and (
+                    a.output_schema.stream_id in columnar_streams
+                ):
+                    out[a.name] = a.decode_packed_columns(
+                        n, block, lookup_np=lookup_np
+                    )
+                elif getattr(a, "wants_lookup", False):
                     out[a.name] = a.decode_packed(n, block, lookup=lookup)
                 else:
                     out[a.name] = a.decode_packed(n, block)
                 continue
-            out[a.name] = [(
-                a.output_schema,
-                a.output_schema.decode_packed_block(n, block),
-            )]
+            if a.output_schema.stream_id in columnar_streams:
+                out[a.name] = [(
+                    a.output_schema,
+                    a.output_schema.decode_packed_columns(n, block),
+                )]
+            else:
+                out[a.name] = [(
+                    a.output_schema,
+                    a.output_schema.decode_packed_block(n, block),
+                )]
         return out
 
     @property

@@ -148,6 +148,49 @@ class SelectArtifact:
         )
         return [(schema, rows)]
 
+    def decode_packed_columns(
+        self, n: int, block: "np.ndarray", lookup_np=None
+    ):
+        """Columnar twin of :meth:`decode_packed` (the sink fast lane):
+        lazy ordinal rows resolve through the ring's vectorized
+        ``lookup_np`` and every column stays a numpy array."""
+        from .output import ColumnBatch, emission_order
+
+        schema = self.output_schema
+        if not self.lazy_pairs:
+            return [(schema, schema.decode_packed_columns(n, block))]
+        lazy = set(self.lazy_pairs)
+        order = emission_order(block[0], n)
+        ts_out = np.asarray(block[0, :n])[order].astype(np.int64)
+        cols = {}
+        for c, f in enumerate(schema.fields):
+            raw = np.asarray(block[1 + c, :n])[order]
+            src = self.proj_srcs[c]
+            if src is not None and src in lazy:
+                cols[f.name] = _lazy_column_np(raw, f, lookup_np, src)
+            else:
+                if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
+                    raw = raw.view(np.float32)
+                cols[f.name] = f.decode_column_np(raw)
+        return [(schema, ColumnBatch(ts_out, cols))]
+
+
+def _lazy_column_np(ords, field, lookup_np, key) -> "np.ndarray":
+    """Resolve one lazy-projected ordinal column to values (vectorized
+    ring gather); evicted ordinals stay None, and encoded fields map
+    code->value through the table in one np.take."""
+    if lookup_np is None:
+        return np.full(len(ords), None, dtype=object)
+    vals = lookup_np(key, ords)
+    if field.table is None:
+        return vals
+    if vals.dtype == object:  # misses present: keep None-capable dtype
+        out = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals.tolist()):
+            out[i] = None if v is None else field.table.value(int(v))
+        return out
+    return field.decode_column_np(vals)
+
 
 def apply_lazy_select(artifact: SelectArtifact):
     """Late materialization for a stateless query: plain-reference select

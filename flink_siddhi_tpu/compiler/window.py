@@ -414,6 +414,45 @@ class SlidingWindowArtifact:
         )
         return [(schema, rows)]
 
+    def decode_packed_columns(self, n: int, block: "np.ndarray",
+                              lookup_np=None):
+        """Columnar twin of :meth:`decode_packed`: group codes decode
+        through an object-array LUT in one fancy index instead of a
+        per-value loop."""
+        schema = self.output_schema
+        gcp = self.group_code_proj
+        if not gcp or all(g is None for g in gcp):
+            return [(schema, schema.decode_packed_columns(n, block))]
+        from .output import ColumnBatch, emission_order
+
+        order = emission_order(block[0], n)
+        ts_out = np.asarray(block[0, :n])[order].astype(np.int64)
+        cache = getattr(self, "_lut_cache", None)
+        if cache is None:
+            cache = self._lut_cache = {}
+        arr_cache = getattr(self, "_lut_arr_cache", None)
+        if arr_cache is None:
+            arr_cache = self._lut_arr_cache = {}
+        cols = {}
+        for c, f in enumerate(schema.fields):
+            raw = np.asarray(block[1 + c, :n])[order]
+            gi = gcp[c]
+            if gi is not None:
+                lut = cache.setdefault(c, [])
+                for i in range(len(lut), len(self.encoder)):
+                    lut.append(f.decode(self.encoder.value(i)[gi]))
+                arr = arr_cache.get(c)
+                if arr is None or len(arr) != len(lut):
+                    arr = np.empty(len(lut), dtype=object)
+                    arr[:] = lut
+                    arr_cache[c] = arr
+                cols[f.name] = arr[raw.astype(np.int64)]
+            else:
+                if np.dtype(f.atype.device_dtype) == np.dtype(np.float32):
+                    raw = raw.view(np.float32)
+                cols[f.name] = f.decode_column_np(raw)
+        return [(schema, ColumnBatch(ts_out, cols))]
+
     # -- blocked (sort-free) sliding aggregation ---------------------------
     def _step_blocked(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         """Windowed per-group sums with ZERO sorts.

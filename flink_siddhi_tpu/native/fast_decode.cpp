@@ -13,7 +13,11 @@
 // Field kinds: 0 = int64, 1 = double, 2 = string (-> int64 code), 3 = bool.
 
 #include <algorithm>
+#include <cerrno>
 #include <charconv>
+#if !defined(__cpp_lib_to_chars)
+#include <locale.h>  // newlocale / strtod_l for the strtod fallback
+#endif
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -29,6 +33,14 @@ namespace {
 // invalid, diverging from the Python fallback. from_chars is also bounded
 // by an explicit end pointer (the input buffer is not NUL-terminated).
 // Out-of-range magnitudes are treated as parse failures (invalid row).
+// GCC 10's libstdc++ ships integer from_chars only (__cpp_lib_to_chars
+// unset); the fallback copies the bounded token (NUL-terminated, heap
+// copy for tokens that outgrow the stack buffer so nothing truncates)
+// and parses with strtod_l pinned to a process-independent "C" numeric
+// locale, so a comma-decimal embedding process parses identically to
+// the from_chars build. Only if newlocale itself fails does it fall
+// back to plain locale-sensitive strtod.
+#if defined(__cpp_lib_to_chars)
 inline bool parse_f64(const char* p, const char* end, double& v,
                       const char*& ep) {
     auto r = std::from_chars(p, end, v, std::chars_format::general);
@@ -36,6 +48,51 @@ inline bool parse_f64(const char* p, const char* end, double& v,
     ep = r.ptr;
     return true;
 }
+#else
+inline bool parse_f64(const char* p, const char* end, double& v,
+                      const char*& ep) {
+    static const locale_t c_loc =
+        newlocale(LC_NUMERIC_MASK, "C", static_cast<locale_t>(0));
+    // match the from_chars grammar exactly, not strtod's wider one:
+    // no leading whitespace (ALL isspace forms — strtod also skips
+    // \r \v \f \n), no '+', and a hex prefix parses as the leading
+    // "0" only (from_chars stops at 'x'; strtod would eat a whole
+    // hexfloat)
+    if (p == end || *p == ' ' || *p == '\t' || *p == '\r' ||
+        *p == '\v' || *p == '\f' || *p == '\n' || *p == '+')
+        return false;
+    {
+        const char* q = p + (*p == '-' ? 1 : 0);
+        if (q + 1 < end && q[0] == '0' &&
+            (q[1] == 'x' || q[1] == 'X')) {
+            v = (*p == '-') ? -0.0 : 0.0;
+            ep = q + 1;
+            return true;
+        }
+    }
+    char buf[64];
+    std::string big;
+    const size_t n = static_cast<size_t>(end - p);
+    const char* src;
+    if (n < sizeof(buf)) {
+        std::memcpy(buf, p, n);
+        buf[n] = '\0';
+        src = buf;
+    } else {
+        big.assign(p, n);
+        src = big.c_str();
+    }
+    errno = 0;
+    char* out = nullptr;
+    double parsed = c_loc != static_cast<locale_t>(0)
+                        ? strtod_l(src, &out, c_loc)
+                        : std::strtod(src, &out);
+    if (out == src || errno == ERANGE) return false;
+    v = parsed;
+    ep = p + (out - src);
+    return true;
+}
+#endif
 
 inline bool parse_i64(const char* p, const char* end, long long& v,
                       const char*& ep) {

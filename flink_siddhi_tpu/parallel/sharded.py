@@ -287,6 +287,9 @@ class ShardedJob(Job):
             rt.states, rt.acc = rt.jitted_acc(
                 rt.states, rt.acc, stacked_tape
             )
+            rt.acc_dirty = True
+            if rt.dirty_since is None:
+                rt.dirty_since = time.monotonic()
         for b in involved:
             self.tracer.mark(b.timestamps, "dispatch")
         # shared no-overflow contract (Job._update_drain_hint); strip the
@@ -325,6 +328,9 @@ class ShardedJob(Job):
     def _drain_plan_body(self, rt: _PlanRuntime) -> None:
         if rt.acc is None or not rt.plan.artifacts:
             return
+        t_dirty = rt.dirty_since
+        rt.acc_dirty = False
+        rt.dirty_since = None
         t_req = time.monotonic()
         meta = np.asarray(rt.acc["meta"])  # (shards, 2, A) — one fetch
         counts, overflow = meta[:, 0], meta[:, 1]
@@ -412,7 +418,14 @@ class ShardedJob(Job):
             # same semantics as Job's drain.total: meta check -> rows
             # emitted (the timestamp merge and sink delivery included),
             # so the metric is comparable across job kinds
-            tel.record_seconds("drain.total", time.monotonic() - t_req)
+            now = time.monotonic()
+            tel.record_seconds("drain.total", now - t_req)
+            if t_dirty is not None and self._has_consumers(rt):
+                # same contract as Job: age of the oldest undrained
+                # match when its drain completed — consumer-visible
+                # drains only (capacity swaps of unobserved plans are
+                # not the scheduler's report card)
+                tel.record_seconds("drain.staleness", now - t_dirty)
             tel.inc("drains.completed")
 
     def flush(self) -> None:

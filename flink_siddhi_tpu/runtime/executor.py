@@ -67,10 +67,11 @@ class _PlanRuntime:
     # swapped, no step since): a drain request then skips entirely —
     # each needless drain costs a d2h round trip on a tunneled device
     acc_dirty: bool = False
-    # predicted drain width (bucketed): the data slice is dispatched at
-    # request time at this width so its compute is done before the fetch
-    # thread reads it — a misprediction pays one extra slice round trip
-    fetch_width: int = 1024
+    # when the live accumulator FIRST became dirty after a swap: the
+    # age of the oldest undrained match. The deadline drain scheduler
+    # keys off it (next drain due = dirty_since + drain_interval_ms)
+    # and drain.staleness records it per completed drain
+    dirty_since: Optional[float] = None
 
 
 class _LazyRing:
@@ -137,6 +138,65 @@ class _LazyRing:
                     out[j] = v
             return out
 
+    def lookup_np(self, key: str, ords) -> np.ndarray:
+        """Vectorized ordinal resolve for the columnar sink fast lane:
+        same gather as :meth:`lookup`, but the product stays a numpy
+        array — typed when every ordinal hits, object-dtype with None
+        holes when any was evicted past the ring horizon."""
+        with self._lock:
+            ords = np.asarray(ords, dtype=np.int64)
+            n = len(ords)
+            if n == 0 or not self.starts:
+                self.missed += n
+                return np.full(n, None, dtype=object)
+            starts = np.asarray(self.starts, dtype=np.int64)
+            lens = np.asarray(self.lens, dtype=np.int64)
+            idx = np.searchsorted(starts, ords, side="right") - 1
+            safe = np.clip(idx, 0, None)
+            ok = (idx >= 0) & (ords - starts[safe] < lens[safe])
+            offs = ords - starts[safe]
+            out = None
+            found = np.zeros(n, dtype=bool)
+            for i in np.unique(idx[ok]).tolist():
+                sel = np.nonzero(ok & (idx == i))[0]
+                entry = self.cols[i]
+                if key not in entry:
+                    continue
+                col = entry[key]
+                if out is None:
+                    out = np.zeros(n, dtype=col.dtype)
+                out[sel] = col[offs[sel]]
+                found[sel] = True
+            self.missed += int(n - found.sum())
+            if out is None:
+                return np.full(n, None, dtype=object)
+            if not bool(found.all()):
+                obj = out.astype(object)
+                obj[~found] = None
+                return obj
+            return out
+
+
+class ColumnarSink:
+    """Protocol/base for sinks opting into the columnar fast lane.
+
+    A sink exposing ``accept_columns(ts, cols)`` receives whole emission
+    batches as ``(abs_ts int64 ndarray, {field_name: ndarray})`` with
+    ``emission_order`` already applied — zero per-row tuples ever
+    materialize on streams where EVERY attached sink is columnar (and
+    host retention is off). On streams that still decode row-wise
+    (mixed consumers, side-channel artifacts, retained results), the
+    runtime converts once per emission batch and calls
+    ``accept_columns`` with object-dtype columns, so a columnar sink
+    observes identical data either way (the tier-1 equivalence test
+    pins this). Duck-typed: any object with ``accept_columns`` counts;
+    subclassing this base is optional."""
+
+    def accept_columns(
+        self, ts: np.ndarray, cols: Dict[str, np.ndarray]
+    ) -> None:
+        raise NotImplementedError
+
 
 class _OutputRateLimiter:
     """Host emission-layer rate limiter (``output [all|last|first] every
@@ -157,7 +217,52 @@ class _OutputRateLimiter:
         self.snapshot_keys = tuple(snapshot_keys or ())
         self.cur: Dict = {}
 
+    def _normalize_buf_rows(self) -> None:
+        """A stream can change lanes mid-flight (a row sink attached via
+        add_sink drops it off the columnar lane): column fragments the
+        other lane buffered are lifted to ``(ts, row)`` pairs so chunk
+        accounting continues exactly where it left off."""
+        from ..compiler.output import ColumnBatch
+
+        if any(isinstance(b, ColumnBatch) for b in self.buf):
+            self.buf = [
+                r
+                for b in self.buf
+                for r in (
+                    b.rows() if isinstance(b, ColumnBatch) else [b]
+                )
+            ]
+
+    def _normalize_buf_columns(self, field_names) -> None:
+        """Inverse lane switch: row-era ``(ts, row)`` entries become
+        single-row ColumnBatches (order preserved) so concat/take on
+        the columnar path stay uniform."""
+        from ..compiler.output import ColumnBatch
+
+        def lift(entry):
+            if isinstance(entry, ColumnBatch):
+                return entry
+            ts, row = entry
+            return ColumnBatch(
+                np.asarray([ts], dtype=np.int64),
+                {
+                    name: np.asarray([val], dtype=object)
+                    for name, val in zip(field_names, row)
+                },
+            )
+
+        if not all(isinstance(b, ColumnBatch) for b in self.buf):
+            self.buf = [lift(b) for b in self.buf]
+
     def feed(self, rows: List) -> List:
+        # normalize only when rows are actually absorbed into the
+        # buffer: a flush (idle interval poll or elapsed deadline)
+        # releases buffered entries AS-IS — _emit_pending/flush route
+        # ColumnBatch entries through the columnar emit path, so a
+        # columnar-lane buffer never explodes into per-row tuples just
+        # to be rebuilt into columns for its own sinks
+        if self.buf and rows:
+            self._normalize_buf_rows()
         if self.mode == "snapshot":
             # roll the interval BEFORE absorbing, as in time mode: rows
             # arriving after a deadline belong to the new interval
@@ -214,6 +319,73 @@ class _OutputRateLimiter:
                     out.append(r)
             return out
         self.buf.extend(rows)
+        return flushed
+
+    def feed_columns(self, cb) -> List:
+        """Columnar twin of :meth:`feed`: account a whole ColumnBatch
+        with index arithmetic and array slices — no row tuples. Events
+        and time modes only (snapshot needs per-group latest rows and
+        is excluded from the columnar lane by Job._columnar_streams).
+        Parity with the row path is pinned by tests."""
+        n = len(cb)
+        if self.buf:
+            self._normalize_buf_columns(list(cb.cols))
+        if self.mode == "events":
+            pos0 = self.count
+            self.count += n
+            pos = (pos0 + np.arange(n, dtype=np.int64)) % self.n
+            if self.which == "first":
+                sel = np.nonzero(pos == 0)[0]
+                return [cb.take(sel)] if sel.size else []
+            if self.which == "last":
+                sel = np.nonzero(pos == self.n - 1)[0]
+                out = [cb.take(sel)] if sel.size else []
+                if n and (pos0 + n) % self.n != 0:
+                    # an incomplete chunk's latest row waits for flush()
+                    self.buf = [cb.take(np.array([n - 1]))]
+                elif sel.size:
+                    self.buf = []
+                return out
+            # all: release through the end of the last COMPLETE chunk
+            complete = np.nonzero(pos == self.n - 1)[0]
+            if not complete.size:
+                if n:
+                    self.buf.append(cb)
+                return []
+            cut = int(complete[-1]) + 1
+            parts = list(self.buf) + ([cb.take(np.arange(cut))]
+                                      if cut else [])
+            self.buf = (
+                [cb.take(np.arange(cut, n))] if cut < n else []
+            )
+            from ..compiler.output import ColumnBatch
+
+            return [ColumnBatch.concat(parts)] if parts else []
+        # time mode: same deadline-roll-before-absorb contract as feed()
+        now = time.monotonic()
+        if self.deadline is None:
+            self.deadline = now + self.ms / 1e3
+        flushed: List = []
+        if now >= self.deadline:
+            if self.which != "first":
+                flushed = (
+                    self.buf if self.which == "all" else self.buf[-1:]
+                )
+            self.buf = []
+            self.deadline = now + self.ms / 1e3
+        if self.which == "first":
+            out = list(flushed)
+            if not self.buf and n:
+                head = cb.take(np.array([0]))
+                self.buf = [head]
+                out.append(head)
+            return out
+        if n:
+            if self.which == "last":
+                # only the latest row can ever surface: keep just it
+                self.buf = [cb.take(np.array([n - 1]))]
+            else:
+                self.buf.append(cb)
         return flushed
 
     def flush(self) -> List:
@@ -288,10 +460,12 @@ class Job:
         # drain the device accumulators at least every N cycles so a
         # long-running job can't overflow them (2 fetches per plan per drain)
         self.drain_every_cycles = 256
-        # bound match-visibility latency: a FULL drain (decode whatever has
-        # accumulated, not just a capacity check) at least this often. Each
-        # full drain costs a host sync (~one tunnel RTT), so this knob trades
-        # p99 match latency against pipeline depth.
+        # bound match-visibility latency: the STALENESS BUDGET of the
+        # deadline drain scheduler — a plan's accumulated matches are
+        # drained when the oldest reaches this age (dirty_since +
+        # interval; see run_cycle). Each drain costs d2h round trips,
+        # so this knob trades p99 match latency against tunnel traffic.
+        # None disables scheduled drains (capacity swaps still happen).
         self.drain_interval_ms = 500.0
         self._last_full_drain = time.monotonic()
         self._cycles_since_drain = 0
@@ -313,9 +487,11 @@ class Job:
         # telemetry: stage-attributed wall clock + latency histograms +
         # counters, snapshotted by metrics()/REST readers. Each drain's
         # request->completion decomposition (wait_ready: request ->
-        # packed array computed on device; queue: ready -> fetch thread
-        # picks it up; fetch: d2h transfer; decode: host decode;
-        # emit_lag; total) lands in the drain.* histograms. All records
+        # count prefix computed on device; queue: ready -> fetch thread
+        # picks it up; fetch: d2h transfers, fetch_meta the count-prefix
+        # half; decode: host decode; emit_lag; total; staleness: age of
+        # the oldest undrained match) lands in the drain.* histograms.
+        # All records
         # happen at batch/drain boundaries on the host — never inside
         # the jitted device path. Set .enabled = False to reduce every
         # span/record to a no-op (the bench overhead A/B switch).
@@ -705,12 +881,13 @@ class Job:
             with self.telemetry.span("flush"):
                 rt.states, outputs = self._flush_fn(rt)(rt.states)
                 if outputs:
+                    lazy = getattr(rt, "lazy", None)
                     self._decode_outputs(
                         rt.plan, outputs, only=set(outputs),
-                        lookup=(
-                            rt.lazy.lookup
-                            if getattr(rt, "lazy", None) is not None
-                            else None
+                        lookup=lazy.lookup if lazy is not None else None,
+                        columnar_streams=self._columnar_streams(rt),
+                        lookup_np=(
+                            lazy.lookup_np if lazy is not None else None
                         ),
                     )
         # stream end: rate-limited output still buffered surfaces now
@@ -808,31 +985,59 @@ class Job:
         """Latency-bounding drain pass over plans someone observes
         (overridden by ShardedJob, whose drains are synchronous).
 
+        Admission is STALENESS-ORDERED and backlog-aware: only plans
+        whose oldest undrained match has reached the staleness budget
+        are candidates, the stalest goes first, and a shared pending
+        budget (MAX_PENDING_DRAINS across all plans) stops admission
+        before the fetch backlog itself becomes match latency — under
+        pressure the budget goes to the plans that need it most, not
+        round-robin.
+
         Flow control: at most TWO drains in flight per plan. One is too
-        few — a drain pays a readiness round trip (the pack program
-        behind queued device work) and then a fetch round trip, and
+        few — a drain pays a readiness round trip (the count-prefix
+        behind queued device work) and then the fetch phases, and
         serializing them makes the visibility cadence their SUM; with
         two, drain k+1's readiness wait overlaps drain k's fetch, so
         the cadence approaches one fetch duration. More than two only
         grows a backlog whose depth becomes match latency on a slow
         d2h tunnel."""
+        now = time.monotonic()
+        interval_s = (self.drain_interval_ms or 0.0) / 1e3
         for rt in self._plans.values():
             self._drain_poll(rt)
-            if len(rt.drain_q) >= 2:
-                continue
-            if self._has_consumers(rt):
-                self._drain_request(rt)
-                self._drain_poll(rt)
+        budget = self.MAX_PENDING_DRAINS - sum(
+            len(rt.drain_q) for rt in self._plans.values()
+        )
+        cands = [
+            rt
+            for rt in self._plans.values()
+            if rt.dirty_since is not None
+            and now - rt.dirty_since >= interval_s
+            and len(rt.drain_q) < 2
+            and self._has_consumers(rt)
+        ]
+        cands.sort(key=lambda rt: rt.dirty_since)  # stalest first
+        for rt in cands:
+            if budget <= 0:
+                break
+            self._drain_request(rt)
+            self._drain_poll(rt)
+            budget -= 1
+
+    # smallest data-fetch bucket: bounds the pack-program count to
+    # log2(capacity/64) shapes while letting a sparse drain's transfer
+    # shrink to ~64 columns instead of the old 1024 floor
+    MIN_FETCH_WIDTH = 64
 
     def prewarm_drains(
         self, widths: Optional[Sequence[int]] = None
     ) -> None:
-        """Compile the bucketed packed-drain programs up front — EVERY
-        power-of-two width fetch prediction can land on, by default. A
-        first compile at a new width mid-run stalls the pipeline for
-        seconds on a tunneled device; prewarming moves that out of the
-        steady-state loop (benchmarks / latency-sensitive pipelines
-        call this once at startup)."""
+        """Compile the bucketed data-slice programs up front — EVERY
+        power-of-two width the count-sized fetch can land on, by
+        default. A first compile at a new width mid-run stalls the
+        pipeline for seconds on a tunneled device; prewarming moves
+        that out of the steady-state loop (benchmarks /
+        latency-sensitive pipelines call this once at startup)."""
         for rt in self._plans.values():
             if rt.acc is None or not rt.plan.artifacts:
                 continue
@@ -841,20 +1046,23 @@ class Job:
             if ws is None:
                 # every power of two up to the full accumulator width
                 ws = []
-                w = 1024
+                w = self.MIN_FETCH_WIDTH
                 while w < cap:
                     ws.append(w)
                     w <<= 1
                 ws.append(cap)
             for w in ws:
                 if w <= cap:
-                    self._pack_drain(rt, rt.acc, w)  # compile; drop result
+                    self._pack_data(rt, rt.acc, w)  # compile; drop result
 
     @staticmethod
-    def _pack_drain(rt: _PlanRuntime, acc: Dict, width: int):
-        """ONE device array holding [meta | buf[:, :width]] flattened —
-        a d2h fetch on a tunneled device pays ~one RTT regardless of
-        size, so meta and data must cross in a single transfer, not two."""
+    def _pack_data(rt: _PlanRuntime, acc: Dict, width: int):
+        """The data half of a two-phase drain: one device array holding
+        ``buf[:, :width]``, dispatched only AFTER the count prefix came
+        back, with ``width`` bucketed from the ACTUAL max match count —
+        the transfer is sized to what was matched, never to a predicted
+        width (the old fast path shipped a >=1024-wide slice on every
+        drain and paid an extra round trip on misprediction)."""
         jits = getattr(rt, "pack_jits", None)
         if jits is None:
             jits = rt.pack_jits = {}
@@ -862,14 +1070,7 @@ class Job:
         if fn is None:
             def pack(a, _w=width):
                 rows = a["buf"].shape[0]
-                return jnp.concatenate(
-                    [
-                        a["meta"].ravel(),
-                        jax.lax.slice(
-                            a["buf"], (0, 0), (rows, _w)
-                        ).ravel(),
-                    ]
-                )
+                return jax.lax.slice(a["buf"], (0, 0), (rows, _w))
 
             fn = jits[width] = jax.jit(pack)
         return fn(acc)
@@ -877,10 +1078,12 @@ class Job:
     def _drain_request(self, rt: _PlanRuntime) -> None:
         """Swap the device accumulator for a fresh one and queue the
         swapped-out copy for fetching. The entry stays in a cheap
-        "waiting for the device" stage until its packed array is_ready —
-        polled for free from the run loop — and only then goes to the
-        fetch thread, which therefore only ever pays transfer time,
-        never a block-on-unfinished-compute stall."""
+        "waiting for the device" stage until its meta (count-prefix)
+        array is_ready — polled for free from the run loop — and only
+        then goes to the fetch thread. The fetch is TWO-PHASE: the tiny
+        count prefix crosses first, then the data slice is dispatched
+        at a width bucketed from the actual max count (zero matches =
+        zero data transfer; see _fetch_acc)."""
         if rt.acc is None or not rt.plan.artifacts:
             return
         if not rt.acc_dirty:
@@ -888,31 +1091,54 @@ class Job:
         old = rt.acc
         rt.acc = rt.jitted_init_acc()
         rt.acc_dirty = False
-        if not self._has_consumers(rt):
-            # no-consumer fast path: nobody observes the rows (no sinks,
-            # retention off), so only the counts cross the wire — the
-            # data transfer AND the host decode are skipped entirely.
-            # The swap itself still happens (overflow accounting).
-            rt.drain_q.append(
-                {"acc": old, "packed": None, "width": 0,
-                 "t_req": time.monotonic()}
-            )
-            self._advance_ready(rt)
-            if len(rt.drain_q) > self.MAX_PENDING_DRAINS:
-                self._drain_poll(rt, block=True, limit=1)
-            return
-        width = min(max(rt.fetch_width, 1024), rt.plan.acc_capacity())
-        # dispatch the packed meta+data array NOW at the predicted width:
-        # by fetch time it is computed, so the fetch thread's asarray
-        # pays transfer time only — and exactly ONE d2h round trip
-        packed = self._pack_drain(rt, old, width)
+        t_dirty = rt.dirty_since
+        rt.dirty_since = None
+        want = self._has_consumers(rt)
+        # no-consumer entries (want=False) fetch counts only — the data
+        # phase AND the host decode are skipped entirely; the swap
+        # itself still happens (overflow accounting)
         rt.drain_q.append(
-            {"acc": old, "packed": packed, "width": width,
-             "t_req": time.monotonic()}
+            {
+                "acc": old,
+                "want": want,
+                # which output streams decode columnar (all consumers
+                # opted in): resolved at request time so a sink attached
+                # mid-flight (add_sink drains first) cannot race
+                "columnar": self._columnar_streams(rt) if want else
+                frozenset(),
+                "t_req": time.monotonic(),
+                # staleness is the deadline scheduler's report card:
+                # only consumer-visible drains contribute (unconsumed
+                # plans reach here via capacity swaps the scheduler
+                # deliberately never bounds)
+                "t_dirty": t_dirty if want else None,
+            }
         )
         self._advance_ready(rt)
         if len(rt.drain_q) > self.MAX_PENDING_DRAINS:
             self._drain_poll(rt, block=True, limit=1)
+
+    def _columnar_streams(self, rt: _PlanRuntime) -> frozenset:
+        """Output streams of this plan whose rows never need to exist:
+        host retention off, every attached sink speaks the columnar
+        protocol, and any rate limiter can account batches (snapshot
+        mode keys per-group rows, so it stays on the row path)."""
+        if self.retain_results:
+            return frozenset()
+        out = set()
+        for sid in rt.plan.output_streams():
+            sinks = self._sinks.get(sid)
+            if not sinks:
+                continue
+            if not all(
+                hasattr(s, "accept_columns") for s in sinks
+            ):
+                continue
+            lim = self._rate_limiters.get(sid)
+            if lim is not None and lim.mode == "snapshot":
+                continue
+            out.add(sid)
+        return frozenset(out)
 
     def _has_consumers(self, rt: _PlanRuntime) -> bool:
         """Whether any host-side consumer observes this plan's rows."""
@@ -924,30 +1150,26 @@ class Job:
         )
 
     def _advance_ready(self, rt: _PlanRuntime) -> None:
-        """Promote waiting entries whose packed array (or bare meta, for
-        counts-only drains) is ready to fetch jobs (FIFO: stop at the
-        first not-ready entry). Eager promotion (blocking on the packed
-        array from the fetch thread) was measured on the tunnel and
-        does NOT help: the readiness round trip just moves into fetch-
-        thread queueing (the drain-leg decomposition, now the drain.*
-        histograms, showed wait_ready ~0 but queue
-        ~230ms), while the gated form lets two in-flight drains
-        pipeline readiness against fetch."""
+        """Promote waiting entries whose meta (count-prefix) array is
+        ready to fetch jobs (FIFO: stop at the first not-ready entry).
+        Meta readiness implies the whole accumulator's step work
+        retired (same program execution), so the fetch thread's data
+        phase pays pack+transfer only, never a block-on-unfinished-
+        compute stall. Eager promotion (blocking from the fetch thread)
+        was measured on the tunnel and does NOT help: the readiness
+        round trip just moves into fetch-thread queueing (wait_ready ~0
+        but queue ~230ms), while the gated form lets two in-flight
+        drains pipeline readiness against fetch."""
         for entry in rt.drain_q:
             if "fut" in entry:
                 continue
-            gate = (
-                entry["packed"]
-                if entry["packed"] is not None
-                else entry["acc"]["meta"]
-            )
-            if not gate.is_ready():
+            if not entry["acc"]["meta"].is_ready():
                 break
             entry["t_ready"] = time.monotonic()
             entry["stages"] = {}
             entry["fut"] = self._fetch_pool.submit(
                 self._fetch_acc, rt, entry.pop("acc"),
-                entry.pop("packed"), entry.pop("width"),
+                entry.pop("want"), entry.pop("columnar"),
                 entry["stages"],
             )
 
@@ -968,50 +1190,48 @@ class Job:
         return pool
 
     @staticmethod
-    def _fetch_acc(rt: _PlanRuntime, acc: Dict, packed, width: int,
+    def _fetch_acc(rt: _PlanRuntime, acc: Dict, want: bool,
+                   columnar: frozenset,
                    stages: Optional[Dict] = None):
-        """Fetch-thread body: the packed [meta | data-slice] array is
-        already computed, so ONE asarray pays one d2h round trip for the
-        whole drain; decode also happens here so the run loop only
-        emits. Bucketed widths keep the pack program count to a handful
-        of shapes (a distinct shape per drain would compile a fresh
-        program every time, ~1s each on a tunneled device)."""
+        """Fetch-thread body — the TWO-PHASE count-prefix fetch. Phase
+        one transfers the tiny meta array (per-artifact counts +
+        overflow). Phase two, only when matches exist and a consumer
+        wants them, dispatches the data slice at a width bucketed from
+        the ACTUAL max count and transfers exactly that — an empty
+        drain never touches the data buffer, a sparse one ships a
+        64-wide slice instead of the old predicted >=1024. Bucketed
+        widths keep the pack-program count to a handful of shapes (a
+        distinct shape per drain would compile a fresh program every
+        time, ~1s each on a tunneled device). Decode also happens here
+        so the run loop only emits."""
         if stages is not None:
             stages["t_fetch0"] = time.monotonic()
-        a_count = max(len(rt.plan.artifacts), 1)
-        if packed is None:  # no-consumer fast path: counts only
-            meta = np.asarray(acc["meta"])
-            if stages is not None:
-                stages["t_dec0"] = stages["t_fetch1"] = time.monotonic()
-            return meta[0], meta[1], None
-        arr = np.asarray(packed)
-        meta = arr[: 2 * a_count].reshape(2, a_count)
+        meta = np.asarray(acc["meta"])  # phase one: the count prefix
         counts, overflow = meta[0], meta[1]
         max_n = int(counts.max()) if counts.size else 0
-        rt.fetch_width = min(
-            bucket_size(max(max_n, 1), minimum=1024),
-            rt.plan.acc_capacity(),
-        )
-        if max_n == 0:
+        if stages is not None:
+            stages["t_meta"] = time.monotonic()
+        if not want or max_n == 0:
             # stamp the leg ends: falling back to the run-loop poll
             # time would record idle poll latency as transfer time in
             # the drain.fetch / drain.transport histograms
             if stages is not None:
                 stages["t_dec0"] = stages["t_fetch1"] = time.monotonic()
             return counts, overflow, None
-        if max_n > width:  # misprediction: pay one extra slice fetch
-            data = np.asarray(acc["buf"][:, :rt.fetch_width])[:, :max_n]
-        else:
-            data = arr[2 * a_count :].reshape(-1, width)[:, :max_n]
+        width = min(
+            bucket_size(max_n, minimum=Job.MIN_FETCH_WIDTH),
+            rt.plan.acc_capacity(),
+        )
+        # phase two: count-sized data slice (pack dispatch + transfer)
+        data = np.asarray(Job._pack_data(rt, acc, width))[:, :max_n]
         if stages is not None:
             stages["t_dec0"] = time.monotonic()
+        lazy = getattr(rt, "lazy", None)
         decoded = rt.plan.drain_decode(
             counts, data,
-            lookup=(
-                rt.lazy.lookup
-                if getattr(rt, "lazy", None) is not None
-                else None
-            ),
+            lookup=lazy.lookup if lazy is not None else None,
+            columnar_streams=columnar,
+            lookup_np=lazy.lookup_np if lazy is not None else None,
         )
         if stages is not None:
             stages["t_fetch1"] = time.monotonic()
@@ -1031,11 +1251,7 @@ class Job:
                 if not block:
                     return
                 # block path (results/flush/checkpoint): force the wait
-                jax.block_until_ready(
-                    entry["packed"]
-                    if entry["packed"] is not None
-                    else entry["acc"]["meta"]
-                )
+                jax.block_until_ready(entry["acc"]["meta"])
                 self._advance_ready(rt)
                 entry = rt.drain_q[0]
             fut = entry["fut"]
@@ -1055,16 +1271,27 @@ class Job:
                 legs = {
                     "wait_ready": t_rdy - t_req,
                     "queue": t_f0 - t_rdy,
-                    "fetch": t_d0 - t_f0,  # d2h transfer only
+                    "fetch": t_d0 - t_f0,  # d2h only: meta + data phase
                     "decode": t_f1 - t_d0,  # host decode only
                     "emit_lag": now - t_f1,
                     "total": now - t_req,
                 }
+                # two-phase split: the count-prefix transfer alone
+                # (drain.fetch minus it is the count-sized data phase)
+                t_meta = st.get("t_meta")
+                if t_meta is not None:
+                    legs["fetch_meta"] = t_meta - t_f0
                 # per-leg latency distributions: these histograms (not
                 # ad-hoc lists) are what the bench's latency breakdown
                 # and /api/v1/metrics report
                 for leg, dt in legs.items():
                     tel.record_seconds(f"drain.{leg}", dt)
+                # staleness: age of the plan's OLDEST undrained match
+                # when its drain completed — the number the deadline
+                # scheduler exists to bound (~interval + drain time)
+                t_dirty = done_entry.get("t_dirty")
+                if t_dirty is not None:
+                    tel.record_seconds("drain.staleness", now - t_dirty)
                 # transport = the raw tunnel legs of one drain
                 # (readiness round trip + d2h transfer, decode excluded)
                 tel.record_seconds(
@@ -1096,9 +1323,14 @@ class Job:
                     )
                     rt._lazy_miss_warned = lazy.missed
             if decoded is not None:
+                from ..compiler.output import ColumnBatch
+
                 for a in rt.plan.artifacts:
-                    for schema, rows in decoded.get(a.name) or []:
-                        self._emit_rows(schema, rows)
+                    for schema, payload in decoded.get(a.name) or []:
+                        if isinstance(payload, ColumnBatch):
+                            self._emit_columns(schema, payload)
+                        else:
+                            self._emit_rows(schema, payload)
             else:
                 # counts-only drain (no consumers / empty): keep the
                 # emitted counters truthful. Stacked groups attribute to
@@ -1153,15 +1385,83 @@ class Job:
             if self.retain_results
             else None
         )
+        # a columnar sink attached to a stream that still decodes
+        # row-wise (mixed consumers, side-channel artifacts, retained
+        # results) gets the batch converted ONCE per emission — it
+        # observes identical data on either lane (tier-1 equivalence)
+        col_sinks = [
+            s for s in sinks if hasattr(s, "accept_columns")
+        ]
+        row_sinks = [s for s in sinks if not hasattr(s, "accept_columns")]
         # sink delivery time is its own (nested) stage: callbacks are
         # user code whose cost must be visible in the breakdown
         with self.telemetry.span("sink"):
-            for rel_ts, row in rows:
-                abs_ts = epoch + rel_ts
-                if bucket is not None:
-                    bucket.append((abs_ts, row))
-                for sink in sinks:
-                    sink(abs_ts, row)
+            if col_sinks:
+                abs_ts = np.fromiter(
+                    (epoch + r[0] for r in rows), np.int64, len(rows)
+                )
+                cols: Dict[str, np.ndarray] = {}
+                for i, name in enumerate(schema.field_names):
+                    c = np.empty(len(rows), dtype=object)
+                    for j, r in enumerate(rows):
+                        c[j] = r[1][i]
+                    cols[name] = c
+                for sink in col_sinks:
+                    sink.accept_columns(abs_ts, cols)
+            if row_sinks or bucket is not None:
+                for rel_ts, row in rows:
+                    abs_ts = epoch + rel_ts
+                    if bucket is not None:
+                        bucket.append((abs_ts, row))
+                    for sink in row_sinks:
+                        sink(abs_ts, row)
+
+    def _emit_columns(
+        self, schema, cb, rate_limit: bool = True
+    ) -> None:
+        """The columnar sink fast lane's emission tail: the batch stays
+        columnar end to end — counts, traces, rate limiting and sink
+        delivery all account arrays, never row tuples. Reached only for
+        streams where _columnar_streams approved every consumer (the
+        per-row _emit_rows path above is the fallback and the oracle)."""
+        if not len(cb):
+            return
+        sid = schema.stream_id
+        if rate_limit:
+            limiter = self._rate_limiters.get(sid)
+            if limiter is not None:
+                for part in limiter.feed_columns(cb):
+                    self._emit_columns(schema, part, rate_limit=False)
+                return
+        self.output_fields.setdefault(sid, schema.field_names)
+        epoch = self._epoch_ms or 0
+        # rows surfacing to a consumer complete their event's trace
+        # (post-rate-limit, same contract as the row path)
+        self.tracer.complete_ts(epoch, cb.ts)
+        self.emitted_counts[sid] = (
+            self.emitted_counts.get(sid, 0) + len(cb)
+        )
+        sinks = self._sinks.get(sid)
+        if self.retain_results:
+            # the columnar gate excludes retained jobs; this defensive
+            # path (direct _emit_columns callers) must not lose rows
+            self.collected.setdefault(sid, []).extend(
+                (epoch + rel_ts, row) for rel_ts, row in cb.rows()
+            )
+        if not sinks:
+            return
+        abs_ts = cb.ts + np.int64(epoch)
+        with self.telemetry.span("sink"):
+            rows = None
+            for sink in sinks:
+                acc = getattr(sink, "accept_columns", None)
+                if acc is not None:
+                    acc(abs_ts, cb.cols)
+                else:  # defensive: gate guarantees none, stay correct
+                    if rows is None:
+                        rows = cb.rows()
+                    for t, (_rel, row) in zip(abs_ts.tolist(), rows):
+                        sink(t, row)
 
     @property
     def finished(self) -> bool:
@@ -1225,23 +1525,34 @@ class Job:
             for rt in self._plans.values():
                 self._drain_poll(rt)
         now = time.monotonic()
-        interval_due = (
-            self.drain_interval_ms is not None
-            and (now - self._last_full_drain) * 1000.0
-            >= self.drain_interval_ms
-        )
-        if interval_due:
-            # latency-bounding drain: START surfacing accumulated matches
-            # (swap + async fetch riding behind queued device work) even
-            # on idle cycles — a stalled source must not delay visibility
-            # of matches already produced. Plans NOBODY observes (no
-            # sinks, retention off) skip it: each drain costs a d2h round
-            # trip on the tunnel, and with no consumer there is no
+        if self.drain_interval_ms is not None:
+            interval_s = self.drain_interval_ms / 1e3
+            # DEADLINE-driven drain scheduling: the next drain is due
+            # when the OLDEST undrained accumulator's matches reach the
+            # staleness budget (dirty_since + interval) — not on a fixed
+            # metronome whose phase is unrelated to how stale visible
+            # matches already are. Fires on idle cycles too: a stalled
+            # source must not delay visibility of matches already
+            # produced. Plans NOBODY observes (no sinks, retention off)
+            # never set a deadline: each drain costs a d2h round trip
+            # on the tunnel, and with no consumer there is no
             # visibility to bound — their capacity swaps below suffice.
-            with tel.span("drain"):
-                self._interval_drain()
-                self._poll_rate_limiters()
-            self._last_full_drain = time.monotonic()
+            due = None
+            for rt in self._plans.values():
+                t0 = rt.dirty_since
+                if t0 is not None and self._has_consumers(rt):
+                    t = t0 + interval_s
+                    if due is None or t < due:
+                        due = t
+            if due is not None and now >= due:
+                with tel.span("drain"):
+                    self._interval_drain()
+            # time-mode rate limiters emit on their own schedule; poll
+            # them on the fixed cadence (they hold host-side rows only)
+            if now - self._last_full_drain >= interval_s:
+                with tel.span("drain"):
+                    self._poll_rate_limiters()
+                self._last_full_drain = time.monotonic()
         if ready and self._cycles_since_drain >= min(
             self.drain_every_cycles,
             min(self._drain_hints.values(), default=self.drain_every_cycles),
@@ -1270,14 +1581,26 @@ class Job:
             self._emit_pending(sid, limiter.feed([]))
 
     def _emit_pending(self, sid: str, pending: List) -> None:
-        """Emit limiter-released rows to ``sid``'s first output schema
-        (bypassing the limiter — these rows already passed it)."""
+        """Emit limiter-released output to ``sid``'s first output schema
+        (bypassing the limiter — it already passed it). Entries are
+        ``(ts, row)`` pairs or ColumnBatch fragments, depending on
+        which lane fed the limiter."""
         if not pending:
             return
+        from ..compiler.output import ColumnBatch
+
         for rt in self._plans.values():
             schemas = rt.plan.output_streams().get(sid)
             if schemas:
-                self._emit_rows(schemas[0], pending, rate_limit=False)
+                rows = [p for p in pending
+                        if not isinstance(p, ColumnBatch)]
+                if rows:
+                    self._emit_rows(schemas[0], rows, rate_limit=False)
+                for p in pending:
+                    if isinstance(p, ColumnBatch):
+                        self._emit_columns(
+                            schemas[0], p, rate_limit=False
+                        )
                 return
 
     def _pull_control(self) -> None:
@@ -1494,6 +1817,8 @@ class Job:
             # (flush/results/periodic check)
             rt.states, rt.acc = rt.jitted_acc(rt.states, rt.acc, tape)
             rt.acc_dirty = True
+            if rt.dirty_since is None:
+                rt.dirty_since = time.monotonic()
             # sliding-window backpressure: a tiny non-donated "ticket"
             # is derived from the new state each cycle; completed
             # tickets retire via is_ready polling (free), and only when
@@ -1543,17 +1868,29 @@ class Job:
         self._drain_hints[plan.plan_id] = cap_cycles
 
     def _decode_outputs(
-        self, plan: CompiledPlan, outputs: Dict, only=None, lookup=None
+        self, plan: CompiledPlan, outputs: Dict, only=None, lookup=None,
+        columnar_streams=frozenset(), lookup_np=None,
     ) -> None:
+        from ..compiler.output import ColumnBatch
+
         for a in plan.artifacts:
             if only is not None and a.name not in only:
                 continue
             out = outputs[a.name]
             schema = a.output_schema
+            columnar = schema.stream_id in columnar_streams
             if a.output_mode == "aligned":
                 mask, ts, cols = out
                 mask = np.asarray(mask)
                 if not mask.any():
+                    continue
+                if columnar:
+                    self._emit_columns(
+                        schema,
+                        schema.decode_aligned_columns(
+                            mask, np.asarray(ts), cols
+                        ),
+                    )
                     continue
                 rows = schema.decode_aligned(mask, np.asarray(ts), cols)
             elif a.output_mode == "packed":
@@ -1567,19 +1904,40 @@ class Job:
                     continue
                 block = np.asarray(block)
                 if hasattr(a, "decode_packed"):
-                    if getattr(a, "wants_lookup", False):
+                    if columnar and hasattr(a, "decode_packed_columns"):
+                        decoded = a.decode_packed_columns(
+                            int(count), block, lookup_np=lookup_np
+                        )
+                    elif getattr(a, "wants_lookup", False):
                         decoded = a.decode_packed(
                             int(count), block, lookup=lookup
                         )
                     else:
                         decoded = a.decode_packed(int(count), block)
-                    for sch, rows in decoded:
-                        self._emit_rows(sch, rows)
+                    for sch, payload in decoded:
+                        if isinstance(payload, ColumnBatch):
+                            self._emit_columns(sch, payload)
+                        else:
+                            self._emit_rows(sch, payload)
+                    continue
+                if columnar:
+                    self._emit_columns(
+                        schema,
+                        schema.decode_packed_columns(int(count), block),
+                    )
                     continue
                 rows = schema.decode_packed_block(int(count), block)
             else:  # buffered
                 count, ts, cols = out
                 if int(count) == 0:
+                    continue
+                if columnar:
+                    self._emit_columns(
+                        schema,
+                        schema.decode_columns(
+                            int(count), np.asarray(ts), cols
+                        ),
+                    )
                     continue
                 rows = schema.decode_buffered(
                     int(count), np.asarray(ts), cols

@@ -238,6 +238,8 @@ class ResidentReplay:
                         rt.states, rt.acc, seg
                     )
                     rt.acc_dirty = True
+                    if rt.dirty_since is None:
+                        rt.dirty_since = time.monotonic()
                 with tel.span("replay.drain"):
                     job._drain_request(rt)
                     job._drain_poll(rt)
@@ -299,6 +301,7 @@ class ResidentReplay:
                 )
                 rt.acc = rt.jitted_init_acc()
                 rt.acc_dirty = False
+                rt.dirty_since = None
             # host-side emission state resets too: a carried rate-
             # limiter phase (chunk position / buffered rows / deadlines)
             # would make the second run's flush emit at different
@@ -452,6 +455,8 @@ class ShardedResidentReplay(ResidentReplay):
                         rt.states, rt.acc, seg
                     )
                     rt.acc_dirty = True
+                    if rt.dirty_since is None:
+                        rt.dirty_since = time.monotonic()
                 with tel.span("replay.drain"):
                     # ShardedJob drains synchronously
                     job._drain_plan(rt)

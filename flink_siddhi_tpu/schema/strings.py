@@ -20,6 +20,7 @@ class StringTable:
     def __init__(self) -> None:
         self._codes: Dict[Any, int] = {}
         self._values: List[Any] = []
+        self._values_arr: np.ndarray = None  # cache for values_array()
 
     def __len__(self) -> int:
         return len(self._values)
@@ -56,6 +57,26 @@ class StringTable:
     def decode(self, codes: np.ndarray) -> List[Any]:
         return [self.value(int(c)) for c in codes]
 
+    def values_array(self) -> np.ndarray:
+        """The interned values as one object-dtype array, for vectorized
+        whole-column decode (``np.take`` in the columnar sink fast lane).
+        The table is append-only, so the cache is valid exactly while its
+        length matches; a grown table rebuilds it lazily. Rebuild runs on
+        the fetch thread while the run loop may be interning: the length
+        is snapshotted ONCE and only that prefix is copied (appends are
+        atomic under the GIL), so a concurrent intern can never push the
+        copy out of bounds — and any code in drained device data was
+        interned before its batch dispatched, hence always < n."""
+        arr = self._values_arr
+        vals = self._values
+        n = len(vals)
+        if arr is None or len(arr) != n:
+            arr = np.empty(n, dtype=object)
+            for i in range(n):
+                arr[i] = vals[i]
+            self._values_arr = arr
+        return arr
+
     # -- checkpoint support -------------------------------------------------
     def state_dict(self) -> dict:
         return {"values": list(self._values)}
@@ -71,5 +92,6 @@ class StringTable:
         every schema of an environment, so identity must be preserved)."""
         self._codes.clear()
         self._values.clear()
+        self._values_arr = None  # same length != same values after restore
         for v in state["values"]:
             self.intern(v)

@@ -160,11 +160,31 @@ class TraceSampler:
             return
         with self._lock:
             if not self._pending:
-                return
+                return  # common steady state: skip the O(rows) fromiter
         rel = np.fromiter(
             (r[0] for r in rows), dtype=np.int64, count=len(rows)
         )
-        abs_ts = rel + int(epoch_ms)
+        self.complete_ts(epoch_ms, rel, hist=hist)
+
+    def complete_ts(
+        self,
+        epoch_ms: int,
+        rel_ts,
+        hist: Optional[LatencyHistogram] = None,
+    ) -> None:
+        """Complete traces for an emitted batch given only its relative
+        timestamps (the columnar sink fast lane: no row tuples exist to
+        iterate). Same first-completion-wins semantics as
+        :meth:`complete_rows`, which delegates here."""
+        if not self.enabled:
+            return
+        rel = np.asarray(rel_ts)
+        if rel.size == 0:
+            return
+        with self._lock:
+            if not self._pending:
+                return
+        abs_ts = rel.astype(np.int64) + int(epoch_ms)
         idx = np.nonzero(self._mask(abs_ts))[0]
         if idx.size == 0:
             return

@@ -25,6 +25,22 @@ adds the multi-mode + independent-measurement contract:
   (printed, not just stored), and a declared ``prober_contradiction``
   fails validation outright.
 
+Schema v4 (columnar-sink + tail-aware-drain round, bench.py
+``schema_version: 4``) adds the rows-materialized + p99-target
+contract:
+
+* ``modes.sink`` must carry a finite positive ``rows_materialized_ev_s``
+  (events/sec through the path where every emitted row reaches a
+  consumer), a ``rows_emitted`` count, and ``columnar: true`` — the
+  sink mode must drive the columnar fast lane, not the row fallback;
+* ``p99_target.verdict`` must be ``p99_le_500ms`` (p99 <= 500 ms at a
+  >= 1M ev/s offered load) or ``p99_le_2x_prober`` (p99 <= 2x the
+  out-of-process prober's under-load p99). ``missed`` — failing BOTH
+  targets — is rejected loudly, as is a line missing the block;
+* ``drain_staleness.p50_ms`` / ``p99_ms`` must be present and finite:
+  the deadline drain scheduler's staleness leg must actually have
+  recorded (a scheduler that never ran produces no line).
+
 Usage:
     python scripts/check_bench_schema.py [FILES...]
     python scripts/check_bench_schema.py --require-stages FILES...
@@ -212,6 +228,66 @@ def validate_v3(doc, errors: List[str], where: str) -> None:
         )
 
 
+V4_VERDICTS = ("p99_le_500ms", "p99_le_2x_prober")
+
+
+def validate_v4(doc, errors: List[str], where: str) -> None:
+    """The columnar-sink + tail-aware-drain contract (on top of v3)."""
+    sink = (doc.get("modes") or {}).get("sink")
+    if isinstance(sink, dict):
+        swhere = f"{where}:modes.sink"
+        rm = sink.get("rows_materialized_ev_s")
+        if not _finite(rm) or rm <= 0:
+            errors.append(
+                f"{swhere}: rows_materialized_ev_s missing/non-positive "
+                "(schema v4 requires the measured data-path ev/s)"
+            )
+        if not isinstance(sink.get("rows_emitted"), int):
+            errors.append(f"{swhere}: rows_emitted missing/non-int")
+        if sink.get("columnar") is not True:
+            errors.append(
+                f"{swhere}: columnar must be true — the sink mode must "
+                "drive the columnar fast lane, not the row fallback"
+            )
+    tgt = doc.get("p99_target")
+    if not isinstance(tgt, dict):
+        errors.append(
+            f"{where}: p99_target block missing (schema v4 requires "
+            "the latency-target verdict)"
+        )
+    else:
+        verdict = tgt.get("verdict")
+        if verdict not in V4_VERDICTS:
+            errors.append(
+                f"{where}: p99_target.verdict {verdict!r} — the line "
+                f"fails BOTH latency targets (need one of "
+                f"{', '.join(V4_VERDICTS)}: p99 "
+                f"{tgt.get('p99_ms')}ms at "
+                f"{tgt.get('offered_load_events_per_sec')} ev/s, "
+                f"prober p99 {tgt.get('prober_p99_ms')}ms)"
+            )
+        elif not _finite(tgt.get("p99_ms")):
+            errors.append(f"{where}: p99_target.p99_ms missing/non-finite")
+        else:
+            INFO.append(
+                f"{where}: p99 target met via {verdict} — p99 "
+                f"{tgt.get('p99_ms')}ms at "
+                f"{tgt.get('offered_load_events_per_sec')} ev/s offered"
+            )
+    st = doc.get("drain_staleness")
+    if not isinstance(st, dict):
+        errors.append(
+            f"{where}: drain_staleness block missing (schema v4 "
+            "requires the deadline drain scheduler's staleness stats)"
+        )
+    else:
+        for key in ("p50_ms", "p99_ms"):
+            if not _finite(st.get(key)):
+                errors.append(
+                    f"{where}: drain_staleness.{key} missing/non-finite"
+                )
+
+
 def validate_doc(
     doc, errors: List[str], where: str, require_stages: bool = False
 ) -> None:
@@ -246,6 +322,8 @@ def validate_doc(
         )
     if version >= 3:
         validate_v3(doc, errors, where)
+    if version >= 4:
+        validate_v4(doc, errors, where)
 
 
 def extract_docs(text: str, errors: List[str], path: str):

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""The multiquery64 predicate-class-bucketing experiment (ROADMAP 6).
+
+``docs/multiquery64.md`` claims the 64-query stack's throughput bound
+is the per-(event, query) state advance — pure HBM traffic LINEAR in
+Q — and that everything else amortizes. If that linear-HBM hypothesis
+holds, two things must be measurably true:
+
+1. **scaling**: one Q-query stack's event rate satisfies
+   ``rate(Q) * Q ~= const`` once Q is past the amortized per-event
+   overhead (tape expansion, masking, ts reconstruction);
+2. **bucketing is a wash**: splitting the 64 queries into B stacked
+   plans of 64/B — bucketed by PREDICATE CLASS of the first element
+   (first-literal id mod B), so each bucket is a narrower [Q/B] lane
+   advance over the same events — does not beat the single 64-stack:
+   the total lane-advances are identical, and bucketing only adds
+   per-plan fixed overhead (B tape expansions, B dispatch chains).
+
+If instead bucketing WINS, the per-event fixed costs — not the linear
+[Q, E] advance — were the real bound and the doc's analysis is wrong.
+
+This script measures both, resident-replay mode, counts-only, identical
+synthetic stream (bench.make_batches), and prints one JSON line per
+variant. Verdict and measured numbers are recorded in
+docs/multiquery64.md.
+
+Env knobs: EXP_EVENTS (default 500_000), EXP_BATCH (default 131_072),
+EXP_RUNS (median-of-N replays, default 3), EXP_VARIANTS (comma subset).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/experiment_mq64.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+)
+os.environ.setdefault(
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2"
+)
+
+
+def _queries():
+    """The bench's exact 64 two-step patterns (bench._config_cql)."""
+    out = []
+    for q in range(64):
+        a, b = q % 50, (q * 7 + 1) % 50
+        out.append(
+            (
+                a,
+                f"from every s1 = inputStream[id == {a}] -> "
+                f"s2 = inputStream[id == {b}] "
+                f"select s1.timestamp as t1, s2.timestamp as t2 "
+                f"insert into m{q}",
+            )
+        )
+    return out
+
+
+def _variants():
+    qs = _queries()
+    v = {
+        # scaling sweep: one stacked plan of the first Q queries
+        "stack8": [[t for _, t in qs[:8]]],
+        "stack16": [[t for _, t in qs[:16]]],
+        "stack32": [[t for _, t in qs[:32]]],
+        "stack64": [[t for _, t in qs]],
+    }
+    # predicate-class bucketing: first-element literal id mod B
+    for buckets in (4, 8):
+        groups = [[] for _ in range(buckets)]
+        for a, text in qs:
+            groups[a % buckets].append(text)
+        v[f"bucketed{buckets}x{64 // buckets}"] = [
+            g for g in groups if g
+        ]
+    return v
+
+
+def run_variant(name, plan_texts, n_events, batch, n_runs):
+    import bench
+    from flink_siddhi_tpu import CEPEnvironment
+    from flink_siddhi_tpu.compiler.config import EngineConfig
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.replay import ResidentReplay
+    from flink_siddhi_tpu.runtime.sources import BatchSource
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    env = CEPEnvironment(batch_size=batch, time_mode="processing")
+    schema = StreamSchema(
+        [
+            ("id", AttributeType.INT),
+            ("name", AttributeType.STRING),
+            ("price", AttributeType.DOUBLE),
+            ("timestamp", AttributeType.LONG),
+        ],
+        shared_strings=env.shared_strings,
+    )
+    batches = bench.make_batches(
+        n_events, batch, schema, "inputStream", n_ids=50
+    )
+    src = BatchSource("inputStream", schema, iter(batches))
+    # fixed compile-window cap across ALL variants (the 64-stack picks
+    # this cap automatically at Q>=16; pinning it keeps the per-chunk
+    # dispatch count comparable between stack and bucket variants)
+    ecfg = EngineConfig(max_tape_capacity=131_072)
+    t0 = time.perf_counter()
+    plans = [
+        compile_plan(
+            "; ".join(texts), {"inputStream": schema},
+            plan_id=f"{name}:{i}", config=ecfg,
+        )
+        for i, texts in enumerate(plan_texts)
+    ]
+    compile_s = time.perf_counter() - t0
+    job = Job(
+        plans, [src], batch_size=batch, time_mode="processing",
+        retain_results=False,
+    )
+    rep = ResidentReplay(job)
+    rep.stage()
+    t0 = time.perf_counter()
+    rep.run()
+    job.flush()
+    runs = [time.perf_counter() - t0]
+    for _ in range(n_runs - 1):
+        runs.append(rep.rerun())
+    elapsed = float(np.median(runs))
+    n_queries = sum(len(t) for t in plan_texts)
+    rate = rep.total_events / max(elapsed, 1e-9)
+    return {
+        "variant": name,
+        "plans": len(plan_texts),
+        "queries": n_queries,
+        "events": n_events,
+        "elapsed_s": round(elapsed, 3),
+        "runs_elapsed_s": [round(t, 3) for t in runs],
+        "events_per_sec": round(rate, 1),
+        "query_events_per_sec": round(rate * n_queries, 1),
+        "compile_s": round(compile_s, 2),
+        "stage_s": round(rep.stage_seconds, 2),
+        "emitted_total": int(sum(job.emitted_counts.values())),
+    }
+
+
+def main() -> int:
+    n_events = int(os.environ.get("EXP_EVENTS", 500_000))
+    batch = int(os.environ.get("EXP_BATCH", 131_072))
+    n_runs = max(int(os.environ.get("EXP_RUNS", 3)), 1)
+    variants = _variants()
+    want = os.environ.get("EXP_VARIANTS")
+    if want:
+        keys = [k for k in want.split(",") if k in variants]
+    else:
+        keys = list(variants)
+    results = []
+    for name in keys:
+        r = run_variant(name, variants[name], n_events, batch, n_runs)
+        results.append(r)
+        print(json.dumps(r), flush=True)
+    # cross-variant sanity: every variant advancing all 64 queries over
+    # the same stream must produce the same match counts
+    full = [r for r in results if r["queries"] == 64]
+    if len(full) > 1:
+        counts = {r["emitted_total"] for r in full}
+        if len(counts) != 1:
+            print(
+                f"MATCH-COUNT MISMATCH across 64-query variants: "
+                f"{sorted((r['variant'], r['emitted_total']) for r in full)}",
+                file=sys.stderr,
+            )
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Drain-leg microprofiler: iterate on tail latency WITHOUT a bench run.
+
+Runs N drains against a live job under a configurable device backlog
+and prints per-leg p50/p99 from the runtime's own ``drain.*``
+histograms (runtime/executor.py records every completed drain's
+wait_ready / queue / fetch_meta / fetch / decode / emit_lag / total /
+staleness / transport legs) — the decomposition the tail-aware drain
+scheduler attacks, produced in seconds instead of a full bench cycle.
+
+Each profiled drain: dispatch ``PROF_BACKLOG_CYCLES`` device cycles
+WITHOUT draining (the backlog the count-prefix readiness gate must
+ride behind), then issue one drain request and poll it to completion.
+
+Env knobs:
+  PROF_CONFIG          bench config (default: filter — a row-heavy
+                       data path; see bench._config_cql)
+  PROF_EVENTS          total events staged (default 2_000_000)
+  PROF_BATCH           micro-batch size (default 65_536)
+  PROF_DRAINS          profiled drains (default 30)
+  PROF_BACKLOG_CYCLES  device cycles dispatched per drain (default 2)
+  PROF_SINK            1 = attach a columnar sink (data-path drains,
+                       the default); 0 = counts-only drains
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/profile_drain.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+)
+
+LEGS = (
+    "drain.total",
+    "drain.staleness",
+    "drain.wait_ready",
+    "drain.queue",
+    "drain.fetch_meta",
+    "drain.fetch",
+    "drain.decode",
+    "drain.emit_lag",
+    "drain.transport",
+)
+
+
+def main() -> int:
+    config = os.environ.get("PROF_CONFIG", "filter")
+    n_events = int(os.environ.get("PROF_EVENTS", 2_000_000))
+    batch = int(os.environ.get("PROF_BATCH", 65_536))
+    n_drains = int(os.environ.get("PROF_DRAINS", 30))
+    backlog = int(os.environ.get("PROF_BACKLOG_CYCLES", 2))
+    want_sink = os.environ.get("PROF_SINK", "1") == "1"
+
+    import bench
+
+    job = bench.build_job(config, n_events, batch)
+    job.drain_interval_ms = None  # manual drains only: we ARE the pacer
+    rows = {"n": 0}
+    if want_sink:
+        class _Sink:
+            def accept_columns(self, ts, cols):
+                rows["n"] += len(ts)
+
+        for rt in job._plans.values():
+            for sid in rt.plan.output_streams():
+                job.add_sink(sid, _Sink())
+
+    # warm: a couple of cycles + one full drain compiles every program
+    for _ in range(2):
+        job.run_cycle()
+    job.drain_outputs(wait=True)
+    job.telemetry = type(job.telemetry)()  # fresh registry: warm excluded
+    from flink_siddhi_tpu.telemetry.tracing import TraceSampler
+
+    job.tracer = TraceSampler(job.telemetry, sample_every=0)
+
+    done = 0
+    t0 = time.perf_counter()
+    while done < n_drains and not job.finished:
+        for _ in range(backlog):
+            if job.finished:
+                break
+            job.run_cycle()
+        for rt in job._plans.values():
+            job._drain_request(rt)
+            job._drain_poll(rt, block=True)
+        done += 1
+    elapsed = time.perf_counter() - t0
+
+    out = {
+        "config": config,
+        "drains": done,
+        "backlog_cycles": backlog,
+        "batch": batch,
+        "data_path": want_sink,
+        "rows_emitted": rows["n"],
+        "elapsed_s": round(elapsed, 3),
+        "legs": {},
+    }
+    for name in LEGS:
+        h = job.telemetry.histogram(name)
+        if not h.count:
+            continue
+        out["legs"][name] = {
+            "count": h.count,
+            "p50_ms": h.percentile_ms(50),
+            "p99_ms": h.percentile_ms(99),
+        }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -261,10 +261,93 @@ def test_v3_reports_discrepancy_ratio():
     assert any("discrepancy ratio" in n for n in CHECK.INFO)
 
 
-def test_dryrun_emits_schema_complete_v3(tmp_path):
+# -- schema v4: columnar sink + tail-aware drain contract ------------------
+
+
+def _v4_doc(**over):
+    doc = _v3_doc()
+    doc["schema_version"] = 4
+    doc["modes"]["sink"].update(
+        rows_materialized_ev_s=200_000.0,
+        rows_emitted=4096,
+        rows_per_sec=4096.0,
+        columnar=True,
+    )
+    doc["p99_target"] = {
+        "p99_ms": 120.0,
+        "offered_load_events_per_sec": 1_000_000,
+        "p99_le_500ms_at_1M": True,
+        "p99_le_2x_prober": True,
+        "prober_p99_ms": 122.0,
+        "verdict": "p99_le_500ms",
+    }
+    doc["drain_staleness"] = {
+        "p50_ms": 80.0, "p99_ms": 140.0, "count": 33,
+    }
+    doc.update(over)
+    return doc
+
+
+def test_valid_v4_doc_passes():
+    errors = []
+    CHECK.validate_doc(_v4_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v4_requires_rows_materialized_and_columnar():
+    for strip in (
+        "rows_materialized_ev_s", "rows_emitted", "columnar",
+    ):
+        doc = _v4_doc()
+        del doc["modes"]["sink"][strip]
+        errors = []
+        CHECK.validate_doc(doc, errors, "doc")
+        assert errors, strip
+    doc = _v4_doc()
+    doc["modes"]["sink"]["columnar"] = False  # row fallback: rejected
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("columnar" in e for e in errors)
+
+
+def test_v4_missed_verdict_fails_loudly():
+    doc = _v4_doc()
+    doc["p99_target"]["verdict"] = "missed"
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("fails BOTH latency targets" in e for e in errors)
+    doc = _v4_doc()
+    del doc["p99_target"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("p99_target" in e for e in errors)
+
+
+def test_v4_requires_finite_drain_staleness():
+    doc = _v4_doc()
+    del doc["drain_staleness"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("drain_staleness" in e for e in errors)
+    doc = _v4_doc()
+    doc["drain_staleness"]["p99_ms"] = None
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("drain_staleness.p99_ms" in e for e in errors)
+
+
+def test_v3_era_docs_unaffected_by_v4_gate():
+    """BENCH_r01..r05 harvests predate v4; the new requirements apply
+    from schema_version 4 only."""
+    errors = []
+    CHECK.validate_doc(_v3_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_dryrun_emits_schema_complete_v4(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
     replay, short paced phase) exercises resident + streaming + sink
-    AND the out-of-process prober, and its JSON line passes the v3
+    AND the out-of-process prober, and its JSON line passes the v4
     schema gate — in the tier-1 lane, under its timeout."""
     env = dict(os.environ)
     env.update(
@@ -287,7 +370,7 @@ def test_dryrun_emits_schema_complete_v3(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 3
+    assert doc["schema_version"] == 4
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]
@@ -299,6 +382,17 @@ def test_dryrun_emits_schema_complete_v3(tmp_path):
         assert math.isfinite(lat["discrepancy_ratio"])
         assert sec["stage_breakdown"]["coverage"] >= 0.95
     assert "prober_contradiction" not in doc
+    # the v4 additions ride the same dryrun line: the columnar sink
+    # lane really materialized rows, the latency verdict passed one of
+    # the two targets, and the deadline scheduler recorded staleness
+    sink = doc["modes"]["sink"]
+    assert sink["columnar"] is True
+    assert sink["rows_materialized_ev_s"] > 0
+    assert sink["rows_emitted"] > 0
+    assert doc["p99_target"]["verdict"] in (
+        "p99_le_500ms", "p99_le_2x_prober",
+    )
+    assert math.isfinite(doc["drain_staleness"]["p99_ms"])
 
 
 def test_repo_bench_files_validate():
