@@ -20,6 +20,7 @@ from .api.cep import SiddhiCEP, CEPEnvironment
 from .api.stream import ExecutionStream, Row
 from .compiler.output import ColumnBatch
 from .runtime.executor import ColumnarSink
+from .runtime.supervisor import RestartBudgetExceeded, Supervisor
 from .schema.types import AttributeType
 from .schema.stream_schema import StreamSchema
 from .schema.batch import EventBatch
@@ -46,4 +47,6 @@ __all__ = [
     "MetadataControlEvent",
     "OperationControlEvent",
     "CONTROL_STREAM",
+    "RestartBudgetExceeded",
+    "Supervisor",
 ]

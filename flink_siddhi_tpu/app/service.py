@@ -11,6 +11,11 @@ reference: MetadataControlEvent / OperationControlEvent).
 Routes (JSON in/out):
     GET    /api/v1/metrics               -> Job.metrics() snapshot
     GET    /api/v1/traces                -> per-event trace sampling view
+    GET    /api/v1/health                -> supervisor liveness: alive +
+                                           last-checkpoint age + restart
+                                           count (Supervisor.health();
+                                           503 once the restart budget
+                                           is exhausted)
     GET    /api/v1/queries               -> {"queries": [plan ids]}
     POST   /api/v1/queries   {"cql": s}  -> {"id": plan_id}
     PUT    /api/v1/queries/<id> {"cql"}  -> {"id": id}
@@ -104,10 +109,12 @@ class QueryControlService:
         host: str = "127.0.0.1",
         port: int = 0,
         validate=None,  # callable(cql) raising on bad queries
+        supervisor=None,  # runtime.supervisor.Supervisor for /health
     ) -> None:
         self.control = control
         self.job = job
         self.validate = validate
+        self.supervisor = supervisor
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -140,6 +147,30 @@ class QueryControlService:
 
             def do_GET(self):
                 parts = [p for p in self.path.split("/") if p]
+                if parts == ["api", "v1", "health"]:
+                    # liveness + checkpoint freshness + restart count.
+                    # 200 while supervised-and-alive (or merely
+                    # unsupervised); 503 once the restart budget is
+                    # exhausted — a probe can alert on status alone.
+                    sup = service.supervisor
+                    if sup is not None:
+                        payload = _json_safe(sup.health())
+                        return self._reply(
+                            200 if payload.get("alive") else 503,
+                            payload,
+                        )
+                    if service.job is not None:
+                        return self._reply(200, {
+                            "alive": True,
+                            "supervised": False,
+                            "finished": bool(service.job.finished),
+                            "processed_events": int(
+                                service.job.processed_events
+                            ),
+                        })
+                    return self._reply(
+                        200, {"alive": True, "supervised": False}
+                    )
                 if parts == ["api", "v1", "metrics"]:
                     if service.job is None:
                         return self._reply(200, {})

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jax_compat import shard_map as _shard_map_compat
+
 _LOG = logging.getLogger(__name__)
 
 _BLOCK = 1024  # lanes per grid step (bounded VMEM sweep)
@@ -180,7 +182,7 @@ def warmup_shard() -> bool:
             # kernel's out_shape carries no vma annotation, and the
             # per-shard body uses no collectives the checker would guard
             f = jax.jit(
-                jax.shard_map(
+                _shard_map_compat(
                     lambda x: _RUN(x[0])[None],
                     mesh=mesh,
                     in_specs=P("@pallas_probe"),

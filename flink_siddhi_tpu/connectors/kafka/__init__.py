@@ -12,6 +12,10 @@ Modules:
 * ``protocol`` — request/response primitives, api keys, and
   ApiVersions negotiation (pick Fetch/Produce versions per broker,
   fall back to the v0 dialect for pre-0.10 brokers)
+* ``errors``   — one KafkaError hierarchy with the retryable-vs-fatal
+  taxonomy (``is_retryable`` / ``is_connection_error``)
+* ``retry``    — RetryPolicy: exponential backoff, deterministic
+  seeded jitter, bounded attempts, per-call deadline
 
 ``runtime/kafka.py`` composes these into the engine's KafkaSource /
 KafkaSink; tests/fake_kafka.py composes the same modules into the
@@ -28,7 +32,16 @@ from .codecs import (  # noqa: F401
     decompress,
 )
 from .crc32c import crc32c  # noqa: F401
-from .errors import BrokerClosedError, KafkaError  # noqa: F401
+from .errors import (  # noqa: F401
+    BrokerClosedError,
+    BrokerErrorResponse,
+    BrokerIOError,
+    KafkaError,
+    RETRYABLE_BROKER_CODES,
+    is_connection_error,
+    is_retryable,
+)
+from .retry import RetryPolicy  # noqa: F401
 from .records import (  # noqa: F401
     CorruptBatchError,
     decode_message_set,

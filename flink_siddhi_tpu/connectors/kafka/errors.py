@@ -1,4 +1,5 @@
-"""One error hierarchy for the whole Kafka stack.
+"""One error hierarchy for the whole Kafka stack, with an explicit
+retryable-vs-fatal taxonomy.
 
 ``except KafkaError`` at the engine boundary catches every failure
 this layer can raise — wire-format corruption, codec gaps, protocol
@@ -8,6 +9,29 @@ Subclasses exist where a caller needs to *distinguish*:
 hung up — the pre-0.10 answer to ApiVersions, and the only signal
 that may legitimately downgrade the dialect to v0) versus everything
 else (which must propagate, never silently downgrade).
+
+Taxonomy: every error carries a ``retryable`` flag, declared at the
+class site (or computed from the broker error code), so retry policy
+and error semantics live in one place:
+
+* **retryable** — a retry against the same broker can legitimately
+  succeed: transport failures (``BrokerIOError``, ``BrokerClosedError``
+  — the connection is re-established and API versions re-negotiated),
+  wire corruption (``CorruptBatchError`` — a re-fetch of the same
+  offset may produce clean bytes; on-the-wire corruption is
+  indistinguishable from a flaky link), and the broker error codes
+  Kafka itself marks retriable (leader elections, metadata
+  propagation, timeouts — ``RETRYABLE_BROKER_CODES``).
+* **fatal** — retrying cannot change the outcome: protocol parse
+  errors (``ProtocolError`` — the dialect itself is broken),
+  unsupported codecs, offset-out-of-range, oversized messages,
+  authorization failures. These propagate immediately.
+
+Produce retries are **at-least-once**: a request that failed after the
+broker appended it is re-sent on retry (no idempotent-producer
+sequence numbers). Exactly-once output therefore lives a layer up, in
+the supervisor's checkpoint-commit protocol (runtime/supervisor.py),
+not in the produce path.
 """
 
 from __future__ import annotations
@@ -16,6 +40,86 @@ from __future__ import annotations
 class KafkaError(RuntimeError):
     """Base for every error raised by the Kafka connector stack."""
 
+    #: Whether a retry of the failed call can legitimately succeed.
+    #: Class-level default; subclasses override (or compute from a
+    #: broker error code). ``is_retryable`` is the single reader.
+    retryable: bool = False
+
 
 class BrokerClosedError(KafkaError):
     """The broker closed an established connection mid-exchange."""
+
+    retryable = True
+
+
+class BrokerIOError(KafkaError):
+    """Transport-level failure (socket error, timeout, correlation
+    desync). The connection is torn down; a retry reconnects and
+    re-runs ApiVersions negotiation."""
+
+    retryable = True
+
+
+# Broker error codes Kafka's own Errors table marks retriable: another
+# attempt against the (possibly re-elected) broker can succeed.
+RETRYABLE_BROKER_CODES = {
+    2: "CORRUPT_MESSAGE",  # re-fetch may produce clean bytes
+    3: "UNKNOWN_TOPIC_OR_PARTITION",  # metadata still propagating
+    5: "LEADER_NOT_AVAILABLE",
+    6: "NOT_LEADER_FOR_PARTITION",
+    7: "REQUEST_TIMED_OUT",
+    9: "REPLICA_NOT_AVAILABLE",
+    13: "NETWORK_EXCEPTION",
+    14: "COORDINATOR_LOAD_IN_PROGRESS",
+    15: "COORDINATOR_NOT_AVAILABLE",
+    16: "NOT_COORDINATOR",
+    19: "NOT_ENOUGH_REPLICAS",
+    20: "NOT_ENOUGH_REPLICAS_AFTER_APPEND",
+}
+
+# Named fatal codes (for messages only — ANY code not in the retryable
+# table is treated as fatal, named or not).
+FATAL_BROKER_CODES = {
+    1: "OFFSET_OUT_OF_RANGE",
+    4: "INVALID_FETCH_SIZE",
+    10: "MESSAGE_TOO_LARGE",
+    17: "INVALID_TOPIC_EXCEPTION",
+    18: "RECORD_LIST_TOO_LARGE",
+    29: "TOPIC_AUTHORIZATION_FAILED",
+    30: "GROUP_AUTHORIZATION_FAILED",
+    31: "CLUSTER_AUTHORIZATION_FAILED",
+}
+
+
+def broker_code_name(code: int) -> str:
+    return (
+        RETRYABLE_BROKER_CODES.get(code)
+        or FATAL_BROKER_CODES.get(code)
+        or f"error {code}"
+    )
+
+
+class BrokerErrorResponse(KafkaError):
+    """The broker answered the request with a non-zero error code."""
+
+    def __init__(self, message: str, code: int, api: str = "") -> None:
+        super().__init__(message)
+        self.code = int(code)
+        self.api = api
+
+    @property
+    def retryable(self) -> bool:  # type: ignore[override]
+        return self.code in RETRYABLE_BROKER_CODES
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """The taxonomy's single reader: whether a retry of the failed
+    call can legitimately succeed. Non-Kafka exceptions are fatal."""
+    return bool(getattr(exc, "retryable", False))
+
+
+def is_connection_error(exc: BaseException) -> bool:
+    """Whether the failure invalidated the connection itself — the
+    retry must reconnect AND re-run ApiVersions negotiation (a pinned
+    dialect must not outlive the connection it was negotiated on)."""
+    return isinstance(exc, (BrokerClosedError, BrokerIOError))

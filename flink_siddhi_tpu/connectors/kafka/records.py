@@ -55,7 +55,15 @@ DecodedRecord = Tuple[int, Optional[int], Optional[bytes], Optional[bytes]]
 
 
 class CorruptBatchError(KafkaError):
-    """A record set failed structural or checksum validation."""
+    """A record set failed structural or checksum validation.
+
+    Retryable: corruption observed on the wire is indistinguishable
+    from a flaky link — re-fetching the same offsets may produce clean
+    bytes (and the fake broker's ``mangle_batch`` faults are exactly
+    that shape). Persistent log corruption surfaces as retry
+    exhaustion, not as a silent skip."""
+
+    retryable = True
 
 
 # -- magic 0/1 message sets ------------------------------------------------

@@ -29,6 +29,7 @@ import time
 
 from ..compiler.plan import CompiledPlan
 from ..runtime.executor import Job, _PlanRuntime
+from ..utils.jax_compat import shard_map as _shard_map_compat
 from ..runtime.tape import build_tape, bucket_size
 from ..schema.batch import EventBatch
 from ..telemetry import LatencyHistogram
@@ -83,7 +84,7 @@ def make_sharded_step(plan: CompiledPlan, mesh) -> callable:
         expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         return expand(new_states), expand(outputs)
 
-    smapped = jax.shard_map(
+    smapped = _shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
@@ -124,7 +125,7 @@ def make_sharded_step_acc(
         expand = lambda t: jax.tree.map(lambda x: jnp.asarray(x)[None], t)
         return expand(new_states), expand(new_acc)
 
-    smapped = jax.shard_map(
+    smapped = _shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),

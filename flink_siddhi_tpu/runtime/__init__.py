@@ -1,4 +1,17 @@
 from .tape import Tape, TapeSpec, build_tape
 from .executor import Job
+from .supervisor import (
+    CheckpointsUnreadableError,
+    RestartBudgetExceeded,
+    Supervisor,
+)
 
-__all__ = ["Tape", "TapeSpec", "build_tape", "Job"]
+__all__ = [
+    "Tape",
+    "TapeSpec",
+    "build_tape",
+    "Job",
+    "CheckpointsUnreadableError",
+    "RestartBudgetExceeded",
+    "Supervisor",
+]

@@ -16,13 +16,18 @@ contract the reference left open:
   SiddhiStreamOperator.java:71-91) and undelivered control events;
 * source positions, for sources that expose ``state_dict``.
 
-A snapshot is a plain picklable dict; ``save``/``load`` write one file.
+A snapshot is a plain picklable dict; ``save``/``load`` write one file
+(atomic replace, keep-last-K rotation, stale-temp sweep; ``load``
+deserializes under a safelisting unpickler — numpy scalars/arrays,
+builtin containers and the engine's own control events only).
 Restore targets a freshly built job over the SAME plans (same CQL): device
 state shapes are validated against the running plans' initialized states.
 """
 
 from __future__ import annotations
 
+import glob
+import logging
 import os
 import pickle
 from typing import Any, Dict
@@ -34,9 +39,21 @@ from ..schema.batch import EventBatch
 
 FORMAT_VERSION = 1
 
+_LOG = logging.getLogger(__name__)
+
 
 def _to_numpy(tree):
     return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def jnp_owned_copy(x):
+    """An OWNED device-side copy of an already-placed array
+    (sharding-preserving: elementwise copy runs where the shards
+    live). See the restore path below for why aliasing the snapshot's
+    host buffers is not an option."""
+    import jax.numpy as jnp
+
+    return jnp.copy(x)
 
 
 def _first_string_table(job):
@@ -198,13 +215,27 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
         # place restored host arrays on device NOW (with the plan's sharding
         # in a sharded job): leaving numpy in rt.states makes the first
         # post-restore step's donate_argnums unusable (extra copy + JAX
-        # 'donated buffers were not usable' warning)
+        # 'donated buffers were not usable' warning).
+        #
+        # The device-side copy after placement is LOAD-BEARING, not
+        # belt-and-braces: on the CPU backend device_put zero-copies
+        # suitably-aligned numpy arrays, so without it the device state
+        # would alias the unpickled snapshot's host buffers. Those
+        # buffers die with the snapshot dict right after restore
+        # returns, while the donate_argnums step still considers the
+        # aliased memory its own — observed as nondeterministic garbage
+        # in restored sharded group tables (and occasional hard aborts
+        # in the shard_map step) under the fault-injection
+        # double-recovery tests. Copying AFTER device_put (not before)
+        # keeps the sharded placement: each shard copies on its own
+        # device instead of the whole state staging through device 0.
         sharding = getattr(job, "_state_sharding", None)
-        rt.states = (
+        placed = (
             jax.device_put(restored_states, sharding)
             if sharding is not None
             else jax.device_put(restored_states)
         )
+        rt.states = jax.tree_util.tree_map(jnp_owned_copy, placed)
         rt.enabled = prec["enabled"]
         # output accumulators are drained pre-snapshot, never checkpointed
         if getattr(rt, "acc", None) is not None:
@@ -286,20 +317,107 @@ def _check_compatible(ref, restored, plan_id: str) -> None:
             )
 
 
-def save(job, path: str) -> None:
-    # atomic replace: a crash mid-write (the exact failure checkpoints
-    # exist to survive) must not destroy the previous good checkpoint
+def checkpoint_generations(path: str, keep: int) -> list:
+    """The rotation chain, newest first: ``path`` (latest), then
+    ``path.1`` .. ``path.<keep-1>`` (older). Restore candidates in
+    this order — a crash between the rotation renames and the final
+    replace can leave only ``path.1`` on disk (see ``save``)."""
+    return [path] + [f"{path}.{i}" for i in range(1, max(int(keep), 1))]
+
+
+def save(job, path: str, keep: int = 1) -> None:
+    """Checkpoint ``job`` to ``path`` atomically, with keep-last-K
+    rotation and crash hygiene:
+
+    * the snapshot is written to ``path.tmp.<pid>`` + fsync, then
+      ``os.replace``d over ``path`` — a crash mid-write never destroys
+      the previous good checkpoint;
+    * ``keep > 1`` rotates existing generations first (``path`` ->
+      ``path.1`` -> ... -> ``path.<keep-1>``, oldest dropped), so K
+      known-good snapshots survive even a checkpoint that replaces
+      ``path`` with something a later bug cannot read. Between the
+      rotation rename and the final replace there is a window where
+      ``path`` does not exist — restorers walk
+      ``checkpoint_generations`` instead of assuming the head;
+    * stale ``path.tmp.*`` siblings (a previous writer died mid-write)
+      are swept AFTER the successful replace. Single-writer contract:
+      the supervisor is the only writer of a given path — a concurrent
+      second writer's tmp file would be swept as stale.
+    """
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         pickle.dump(snapshot_job(job), f, protocol=pickle.HIGHEST_PROTOCOL)
         f.flush()
         os.fsync(f.fileno())
+    if keep > 1 and os.path.exists(path):
+        gens = checkpoint_generations(path, keep)
+        for i in range(len(gens) - 1, 0, -1):
+            if os.path.exists(gens[i - 1]):
+                os.replace(gens[i - 1], gens[i])
     os.replace(tmp, path)
+    for stale in glob.glob(f"{glob.escape(path)}.tmp.*"):
+        # ours was just renamed away; anything left is a dead writer's
+        try:
+            os.remove(stale)
+            _LOG.warning(
+                "swept stale checkpoint temp file %s (a previous "
+                "writer crashed mid-save)", stale,
+            )
+        except OSError:
+            pass  # another sweeper raced us; the goal state is reached
+
+
+# Unpickling a checkpoint executes whatever constructors the stream
+# names. ``save`` only ever emits numpy scalars/arrays, builtin
+# containers, and this engine's own control events — so ``load``
+# admits exactly those and rejects everything else loudly, instead of
+# being a trusting pickle.load (the reference's control wire format
+# had the same hole and worse: Class.forName on attacker payload,
+# ControlEventSchema.java:30-41).
+_SAFE_BUILTINS = {
+    "dict", "list", "tuple", "set", "frozenset", "bytes", "bytearray",
+    "str", "int", "float", "complex", "bool", "slice", "range",
+}
+_SAFE_NUMPY = {
+    # numpy 2.x pickle globals (+ the numpy 1.x module aliases below)
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+}
+
+
+class _CheckpointUnpickler(pickle.Unpickler):
+    def find_class(self, module: str, name: str):
+        if module == "builtins" and name in _SAFE_BUILTINS:
+            return super().find_class(module, name)
+        if (module, name) in _SAFE_NUMPY:
+            return super().find_class(module, name)
+        # the engine's own control events ride checkpoints
+        # (snapshot_job: control_pending / dynamic-plan replay)
+        if module == "flink_siddhi_tpu.control.events":
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"checkpoint names {module}.{name}, which is not on the "
+            "restore safelist (numpy scalars/arrays, builtin "
+            "containers, control events). A checkpoint produced by "
+            "save() never contains it — the file is corrupt, from a "
+            "different engine version, or hostile."
+        )
+
+
+def safe_load_snapshot(fileobj) -> Dict[str, Any]:
+    """Deserialize a checkpoint stream under the safelist."""
+    return _CheckpointUnpickler(fileobj).load()
 
 
 def load(job, path: str) -> None:
-    """Restore from ``save``'s file. The file is trusted input (pickle);
-    the reference's control wire format had the same property and worse
-    (Class.forName on payload, ControlEventSchema.java:30-41)."""
+    """Restore from ``save``'s file, via the safelisting unpickler —
+    a checkpoint that names any class outside the engine's own
+    snapshot vocabulary is rejected loudly, not instantiated."""
     with open(path, "rb") as f:
-        restore_job(job, pickle.load(f))
+        restore_job(job, safe_load_snapshot(f))

@@ -505,6 +505,33 @@ class Job:
         # Set sample_every=0 to disable sampling independently of the
         # rest of the registry.
         self.tracer = TraceSampler(self.telemetry)
+        # graceful degradation: bound the host reorder/pending backlog.
+        # None = unbounded (historical behavior). With a bound, an
+        # overload degrades by POLICY instead of OOMing the host:
+        #   'block'       — stop pulling sources while over the bound
+        #                   (backpressure: the backlog stays in the
+        #                   broker / OS socket buffers / file, where it
+        #                   belongs; pulls resume as the watermark
+        #                   releases events to the device);
+        #   'drop_oldest' — shed the oldest pending batches, loudly
+        #                   (faults.shed_events counter + .shed_events
+        #                   + a rate-limited warning). Oldest-first
+        #                   because under watermark gating the oldest
+        #                   rows are the ones a 'block' stall would
+        #                   starve on anyway; shedding them lets the
+        #                   stream keep moving at the cost of missed
+        #                   (counted) matches.
+        self.max_pending_events: Optional[int] = None
+        self.shed_policy: str = "block"  # 'block' | 'drop_oldest'
+        self.shed_events = 0  # total events ever shed (also a counter)
+        self._shed_warned_at = -1e9  # monotonic ts of the last warning
+        # fault visibility: sources that can report state/transport
+        # faults (KafkaSource retry counters, _DecodedLinesSource
+        # degraded positions) mirror them into this job's registry
+        for src in self._sources:
+            bind = getattr(src, "bind_telemetry", None)
+            if bind is not None:
+                bind(self.telemetry)
 
 
     # -- plan management (dynamic control plane hooks) ----------------------
@@ -1636,21 +1663,85 @@ class Job:
         wms = self._source_wm + self._control_wm
         return min(wms) if wms else MAX_WM
 
+    def _pending_total(self) -> int:
+        return sum(len(b) for bs in self._pending.values() for b in bs)
+
     def _pull_sources(self) -> None:
+        # graceful degradation (see __init__): over the pending bound,
+        # 'block' stops pulling every source EXCEPT the watermark
+        # laggards — the sources pinning the min watermark must keep
+        # polling or the backlog could never release (single-source
+        # jobs therefore keep pulling: their own watermark IS the min).
+        over = (
+            self.max_pending_events is not None
+            and self._pending_total() >= self.max_pending_events
+        )
+        block = over and self.shed_policy == "block"
+        if block:
+            wm = self._watermark()
         for i, src in enumerate(self._sources):
             if self._source_done[i]:
                 continue
-            batch, wm, done = src.poll(self.batch_size)
+            if block and self._source_wm[i] > wm:
+                self.telemetry.inc("faults.backpressure_blocks")
+                continue
+            batch, swm, done = src.poll(self.batch_size)
             if batch is not None and len(batch):
                 self._pending.setdefault(src.stream_id, []).append(batch)
                 # trace sampling stamps INGEST time (pre-reorder), so a
                 # completed trace includes watermark-gate queueing
                 self.tracer.stamp_ingest(batch.timestamps)
-            if wm is not None:
-                self._source_wm[i] = max(self._source_wm[i], wm)
+            if swm is not None:
+                self._source_wm[i] = max(self._source_wm[i], swm)
             if done:
                 self._source_done[i] = True
                 self._source_wm[i] = MAX_WM
+        if (
+            self.max_pending_events is not None
+            and self.shed_policy == "drop_oldest"
+        ):
+            self._shed_pending()
+
+    def _shed_pending(self) -> None:
+        """'drop_oldest' enforcement: shed whole pending batches,
+        oldest event time first, until the backlog is within bounds —
+        louder than an OOM, cheaper than per-row surgery (a shed may
+        overshoot by up to one batch)."""
+        total = self._pending_total()
+        if total <= self.max_pending_events:
+            return
+        shed = 0
+        while total > self.max_pending_events:
+            sid = min(
+                (s for s, bs in self._pending.items() if bs),
+                key=lambda s: int(self._pending[s][0].timestamps.min())
+                if len(self._pending[s][0])
+                else MAX_WM,
+                default=None,
+            )
+            if sid is None:
+                break
+            batch = self._pending[sid].pop(0)
+            if not self._pending[sid]:
+                del self._pending[sid]
+            total -= len(batch)
+            shed += len(batch)
+        if shed:
+            self.shed_events += shed
+            self.telemetry.inc("faults.shed_events", shed)
+            # rate-limited: under sustained overload a shed happens
+            # every cycle — the counters carry the exact total; the
+            # log line only needs to keep saying it is still happening
+            now = time.monotonic()
+            if now - self._shed_warned_at >= 1.0:
+                self._shed_warned_at = now
+                _LOG.warning(
+                    "pending backlog over max_pending_events=%d: shed "
+                    "%d oldest events (%d total shed so far); matches "
+                    "they would have produced are LOST — raise the "
+                    "bound or switch shed_policy to 'block'",
+                    self.max_pending_events, shed, self.shed_events,
+                )
 
     def _release_ready(self) -> List[EventBatch]:
         """Watermark gate: release per-stream prefixes with ts <= min
@@ -1954,12 +2045,15 @@ class Job:
         self.drain_outputs()
         return snapshot_job(self)
 
-    def save_checkpoint(self, path: str) -> None:
+    def save_checkpoint(self, path: str, keep: int = 1) -> None:
+        """``keep > 1`` retains the K latest checkpoint generations
+        (path, path.1, ..; checkpoint.save rotation) so a restore can
+        fall back past a checkpoint a crash made unreadable."""
         from .checkpoint import save
 
         # same contract as snapshot(): surface accumulated emissions first
         self.drain_outputs()
-        save(self, path)
+        save(self, path, keep=keep)
 
     def restore(self, snapshot_or_path) -> None:
         import os

@@ -1,0 +1,92 @@
+"""Deterministic process-fault injection for supervised jobs.
+
+Failure is a first-class, injected, measured input here (PAPERS.md #4:
+claims only count under load the system survives — the same standard
+applied to recovery). This module is the process-death half of the
+harness, shared by the property tests (tests/faults.py re-exports it
+next to the wire-fault ``FaultSchedule``) and by ``bench.py --fault``
+(the measured-recovery block) — one implementation, so the debris a
+"dying writer" leaves and the pull-boundary crash semantics cannot
+drift between the tests and the bench.
+
+:class:`CrashPlan` + :func:`wrap_job` inject crashes into a SUPERVISED
+job: at scheduled source-pull boundaries (mode-agnostic: streaming
+``run_cycle`` and resident ``stage`` both pull), and killed
+MID-checkpoint — a half-written ``*.tmp.*`` sibling is left behind
+(exactly what a process death between the temp write and the atomic
+replace leaves) and the crash raises BEFORE the replace, so the
+previous good generation survives. The plan's counters live OUTSIDE
+the job, so the schedule keeps advancing across supervisor restarts:
+"crash at pulls 5 and 12" means the 5th and 12th pulls of the
+supervised LIFETIME.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+__all__ = ["CrashPlan", "InjectedCrash", "wrap_job"]
+
+
+class InjectedCrash(RuntimeError):
+    """The fault harness killed the job (simulated process death)."""
+
+
+class CrashPlan:
+    """Deterministic process-death schedule for a supervised job.
+
+    ``at_pulls``: crash when the supervised lifetime's Nth source
+    pull happens (1-based; ``_pull_sources`` is the micro-batch
+    boundary in streaming mode and the staging loop in resident
+    mode). ``at_checkpoints``: kill the Nth checkpoint attempt
+    (1-based) mid-write — a garbage ``*.tmp.*`` sibling appears (as a
+    dying writer leaves) and the crash fires BEFORE the atomic
+    replace, so the previous good generation survives."""
+
+    def __init__(
+        self,
+        at_pulls: Sequence[int] = (),
+        at_checkpoints: Sequence[int] = (),
+    ) -> None:
+        self.at_pulls = frozenset(int(i) for i in at_pulls)
+        self.at_checkpoints = frozenset(int(i) for i in at_checkpoints)
+        self.pulls = 0
+        self.checkpoints = 0
+        self.crashes = 0
+
+    def tick_pull(self) -> None:
+        self.pulls += 1
+        if self.pulls in self.at_pulls:
+            self.crashes += 1
+            raise InjectedCrash(f"killed at source pull {self.pulls}")
+
+    def tick_checkpoint(self, path: str) -> None:
+        self.checkpoints += 1
+        if self.checkpoints in self.at_checkpoints:
+            self.crashes += 1
+            # the debris a real mid-write death leaves: a partial temp
+            # file next to the (untouched) previous good checkpoint
+            with open(f"{path}.tmp.999999", "wb") as f:
+                f.write(b"partial checkpoint debris")
+            raise InjectedCrash(
+                f"killed mid-checkpoint {self.checkpoints}"
+            )
+
+
+def wrap_job(job, plan: CrashPlan):
+    """Arm a freshly built job with ``plan``'s crash points (instance-
+    level wraps; the plan itself persists across factory rebuilds)."""
+    orig_pull = job._pull_sources
+    orig_save = job.save_checkpoint
+
+    def pull_sources():
+        plan.tick_pull()
+        return orig_pull()
+
+    def save_checkpoint(path, keep=1):
+        plan.tick_checkpoint(path)
+        return orig_save(path, keep=keep)
+
+    job._pull_sources = pull_sources
+    job.save_checkpoint = save_checkpoint
+    return job

@@ -38,15 +38,28 @@ source (runtime/sources.py).
 
 from __future__ import annotations
 
+import dataclasses
+import itertools
+import logging
 import socket
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..connectors.kafka.codecs import CODEC_NONE, codec_id
-from ..connectors.kafka.errors import BrokerClosedError, KafkaError
+from ..connectors.kafka.errors import (
+    BrokerClosedError,
+    BrokerErrorResponse,
+    BrokerIOError,
+    KafkaError,
+    broker_code_name,
+    is_connection_error,
+    is_retryable,
+)
+from ..connectors.kafka.retry import RetryPolicy
 from ..connectors.kafka.protocol import (
     API_FETCH,
     API_LIST_OFFSETS,
@@ -69,28 +82,57 @@ from ..schema.stream_schema import StreamSchema
 from .sources import Source
 
 __all__ = [
+    "DEFAULT_RETRY",
     "EARLIEST",
     "LATEST",
     "KafkaClient",
     "KafkaError",
     "KafkaSink",
     "KafkaSource",
+    "RetryPolicy",
 ]
 
 EARLIEST = -2
 LATEST = -1
+
+_LOG = logging.getLogger(__name__)
+
+# Every client retries by default: transient transport failures and
+# retryable broker codes (errors.RETRYABLE_BROKER_CODES) reconnect,
+# re-negotiate and re-issue; fatal errors propagate on the first hit.
+# Pass ``retry=None`` for the raw single-attempt client.
+DEFAULT_RETRY = RetryPolicy(
+    max_attempts=5, base_delay_ms=20.0, max_delay_ms=2_000.0
+)
+
+# Clients that take the shared default get a per-client jitter seed:
+# identically-seeded policies produce identical backoff sequences, so
+# N clients failing together would retry in lockstep against the
+# recovering broker — the stampede the jitter exists to prevent.
+# Deterministic per process (a plain counter), distinct per client.
+_CLIENT_SEQ = itertools.count()
 
 
 # -- client ----------------------------------------------------------------
 
 class KafkaClient:
     """One broker connection. Thread-safe per-call. API versions are
-    negotiated on the first request and pinned for the connection's
-    lifetime (``.negotiated`` exposes the picks)."""
+    negotiated on the first request and pinned for the CONNECTION's
+    lifetime (``.negotiated`` exposes the picks) — a reconnect after a
+    transport failure re-runs ApiVersions, so a transient outage can
+    never silently pin the v0 dialect for the client's lifetime.
+
+    ``retry`` (default :data:`DEFAULT_RETRY`) wraps every request in
+    exponential backoff with deterministic seeded jitter; each
+    retry/reconnect increments a ``faults.kafka.*`` counter, surfaced
+    through ``fault_counts`` and (once ``bind_telemetry`` is called —
+    the Job does this for every Kafka source) the job's telemetry
+    registry."""
 
     def __init__(
         self, host: str, port: int, client_id: str = "fst",
         timeout_s: float = 10.0,
+        retry: Optional[RetryPolicy] = DEFAULT_RETRY,
     ) -> None:
         self.host, self.port = host, int(port)
         self.client_id = client_id
@@ -99,12 +141,69 @@ class KafkaClient:
         self._lock = threading.Lock()
         self._timeout = timeout_s
         self._versions: Optional[Dict[int, int]] = None
+        if retry is DEFAULT_RETRY:  # see _CLIENT_SEQ above
+            retry = dataclasses.replace(
+                retry,
+                seed=hash((host, int(port), next(_CLIENT_SEQ)))
+                & 0x7FFFFFFF,
+            )
+        self.retry = retry
+        # client-lifetime fault counters (faults.kafka.*); mirrored
+        # into a bound MetricsRegistry so retries show up next to the
+        # job's other telemetry
+        self.fault_counts: Dict[str, int] = {}
+        self._telemetry = None
+
+    # -- fault accounting --------------------------------------------------
+    def bind_telemetry(self, registry) -> None:
+        """Mirror fault counters into a job's MetricsRegistry. Counts
+        accumulated before binding (e.g. retries during bootstrap
+        metadata) are replayed so the registry view is complete."""
+        self._telemetry = registry
+        if registry is not None:
+            for name, n in self.fault_counts.items():
+                registry.inc(name, n)
+
+    def _note_fault(self, name: str, n: int = 1) -> None:
+        self.fault_counts[name] = self.fault_counts.get(name, 0) + n
+        if self._telemetry is not None:
+            self._telemetry.inc(name, n)
+
+    def _retrying(self, op: str, fn):
+        """Run one request op under the retry policy: connection-level
+        failures tear down the socket AND the negotiated versions
+        (reconnect => renegotiate), every retry counts."""
+        if self.retry is None:
+            return fn()
+
+        def on_retry(exc, attempt, delay_ms):
+            self._note_fault("faults.kafka.retries")
+            self._note_fault(f"faults.kafka.{op}.retries")
+            if is_connection_error(exc):
+                with self._lock:
+                    self._close_locked()  # drops _versions: renegotiate
+                self._note_fault("faults.kafka.reconnects")
+            _LOG.warning(
+                "kafka %s to %s:%d failed (attempt %d, retrying in "
+                "%.0fms): %s", op, self.host, self.port, attempt,
+                delay_ms, exc,
+            )
+
+        return self.retry.call(fn, classify=is_retryable, on_retry=on_retry)
 
     def close(self) -> None:
         with self._lock:
             self._close_locked()
 
     def _close_locked(self) -> None:
+        # teardown ALWAYS implies renegotiation: a pinned dialect must
+        # not outlive the connection it was negotiated on. Resetting
+        # here (not only in the retry hook) covers the paths where
+        # on_retry never fires — the final exhausted attempt,
+        # retry=None clients, an explicit close(), and a v0 dialect
+        # wrongly concluded from transiently-slammed ApiVersions that
+        # then "works" (real brokers serve the legacy APIs happily).
+        self._versions = None
         if self._sock is not None:
             try:
                 self._sock.close()
@@ -129,12 +228,14 @@ class KafkaClient:
             raw = self._read_frame(s)
         except OSError as e:
             self._close_locked()
-            raise KafkaError(f"broker io error: {e}") from e
+            raise BrokerIOError(f"broker io error: {e}") from e
         r = Reader(raw)
         got = r.i32()
         if got != corr:
+            # request/response desync: the socket is unusable, but a
+            # reconnect re-syncs — transport-level, hence retryable
             self._close_locked()
-            raise KafkaError(f"correlation mismatch ({got} != {corr})")
+            raise BrokerIOError(f"correlation mismatch ({got} != {corr})")
         return r
 
     def _call(self, api: int, version: int, body: bytes) -> Reader:
@@ -166,20 +267,47 @@ class KafkaClient:
 
     def _ensure_versions_locked(self) -> Dict[int, int]:
         if self._versions is None:
-            try:
-                r = self._call_locked(API_VERSIONS, 0, b"")
-                broker = decode_api_versions_response(r)
-            except BrokerClosedError:
-                # pre-0.10 broker (or fake in legacy mode): the request
-                # is unknown and an ESTABLISHED connection is slammed —
-                # that IS the negative answer. Drop the wedged socket;
-                # the caller's request reconnects and speaks v0
-                # throughout. Any other failure (connection refused,
-                # timeout, garbled response) propagates: a transient
-                # outage must not pin the v0 dialect for the client's
-                # lifetime.
-                self._close_locked()
-                broker = None
+            # A pre-0.10 broker answers ApiVersions by slamming the
+            # ESTABLISHED connection — but so does a transient fault
+            # that drops the connection mid-response. The two are
+            # distinguishable only by retrying: a legacy broker slams
+            # EVERY attempt (deterministically), a transient fault
+            # passes on a later one. Only all-attempts-slammed
+            # concludes the v0 dialect; any other failure (connection
+            # refused, timeout, garbled response) propagates — a
+            # transient outage must not pin v0. And since EVERY
+            # teardown resets ``_versions`` (``_close_locked``), even
+            # a wrong conclusion lasts one connection, not the
+            # client's life.
+            attempts = self.retry.max_attempts if self.retry else 1
+            # constant SHORT backoff, not the exponential sequence:
+            # these sleeps run under self._lock (every other call on
+            # this client gates on the negotiated versions anyway, so
+            # waiting on the lock == waiting on negotiation), and the
+            # outer per-op retry already owns real backoff — this
+            # inner loop exists only to distinguish a legacy broker
+            # (slams EVERY attempt) from a transient fault (passes on
+            # a later one). Exponential growth here would multiply
+            # under the outer retry into seconds of lock-held sleep.
+            delay_s = (
+                min(self.retry.base_delay_ms, 50.0) / 1e3
+                if self.retry
+                else 0.0
+            )
+            broker = None
+            for i in range(max(attempts, 1)):
+                try:
+                    r = self._call_locked(API_VERSIONS, 0, b"")
+                    broker = decode_api_versions_response(r)
+                    break
+                except BrokerClosedError:
+                    self._close_locked()
+                    broker = None
+                    if i < attempts - 1:
+                        self._note_fault(
+                            "faults.kafka.negotiation.retries"
+                        )
+                        time.sleep(delay_s)
             self._versions = negotiate(broker)
         return self._versions
 
@@ -189,6 +317,9 @@ class KafkaClient:
 
     # -- requests ---------------------------------------------------------
     def metadata(self, topics: List[str]) -> Dict:
+        return self._retrying("metadata", lambda: self._metadata_once(topics))
+
+    def _metadata_once(self, topics: List[str]) -> Dict:
         w = Writer().i32(len(topics))
         for t in topics:
             w.string(t)
@@ -215,6 +346,14 @@ class KafkaClient:
     def list_offsets(
         self, topic: str, partitions: List[int], time: int = EARLIEST
     ) -> Dict[int, int]:
+        return self._retrying(
+            "list_offsets",
+            lambda: self._list_offsets_once(topic, partitions, time),
+        )
+
+    def _list_offsets_once(
+        self, topic: str, partitions: List[int], time: int
+    ) -> Dict[int, int]:
         w = Writer().i32(-1).i32(1).string(topic).i32(len(partitions))
         for p in partitions:
             w.i32(p).i64(time).i32(1)
@@ -226,8 +365,10 @@ class KafkaClient:
                 pid, err = r.i32(), r.i16()
                 offs = [r.i64() for _ in range(r.i32())]
                 if err:
-                    raise KafkaError(
-                        f"ListOffsets {topic}/{pid}: error {err}"
+                    raise BrokerErrorResponse(
+                        f"ListOffsets {topic}/{pid}: error {err} "
+                        f"({broker_code_name(err)})",
+                        code=err, api="ListOffsets",
                     )
                 out[pid] = offs[0] if offs else 0
         return out
@@ -247,6 +388,21 @@ class KafkaClient:
         (CRC32C-checked, decompressed); either way records below the
         requested offset may appear (whole-batch/segment resends) and
         callers must skip them."""
+        return self._retrying(
+            "fetch",
+            lambda: self._fetch_once(
+                topic, offsets, max_bytes, max_wait_ms, min_bytes
+            ),
+        )
+
+    def _fetch_once(
+        self,
+        topic: str,
+        offsets: Dict[int, int],
+        max_bytes: int,
+        max_wait_ms: int,
+        min_bytes: int,
+    ) -> Dict[int, Tuple[int, List, int]]:
         with self._lock:
             version = self._ensure_versions_locked()[API_FETCH]
             w = Writer().i32(-1).i32(max_wait_ms).i32(min_bytes)
@@ -269,7 +425,11 @@ class KafkaClient:
                         r.i64(), r.i64()
                 rset = r.bytes_() or b""
                 if err:
-                    raise KafkaError(f"Fetch {topic}/{pid}: error {err}")
+                    raise BrokerErrorResponse(
+                        f"Fetch {topic}/{pid}: error {err} "
+                        f"({broker_code_name(err)})",
+                        code=err, api="Fetch",
+                    )
                 out[pid] = (hw, decode_record_set(rset), len(rset))
         return out
 
@@ -285,7 +445,31 @@ class KafkaClient:
     ) -> int:
         """-> base offset assigned by the broker. ``compression`` is a
         codecs.py name; anything but 'none' needs a broker speaking
-        Produce >= 3 (v2 record batches)."""
+        Produce >= 3 (v2 record batches).
+
+        Retried produce is AT-LEAST-ONCE: a request that failed after
+        the broker appended it (e.g. the ack was lost to a connection
+        drop) is re-sent whole — there are no idempotent-producer
+        sequence numbers. Exactly-once output lives a layer up, in the
+        supervisor's checkpoint-commit protocol."""
+        return self._retrying(
+            "produce",
+            lambda: self._produce_once(
+                topic, partition, values, acks, timeout_ms, ts_ms,
+                compression,
+            ),
+        )
+
+    def _produce_once(
+        self,
+        topic: str,
+        partition: int,
+        values: List[bytes],
+        acks: int,
+        timeout_ms: int,
+        ts_ms: int,
+        compression: str,
+    ) -> int:
         codec = codec_id(compression)
         with self._lock:
             version = self._ensure_versions_locked()[API_PRODUCE]
@@ -323,8 +507,10 @@ class KafkaClient:
                 if version >= 2:
                     r.i64()  # log_append_time
                 if err:
-                    raise KafkaError(
-                        f"Produce {topic}/{pid}: error {err}"
+                    raise BrokerErrorResponse(
+                        f"Produce {topic}/{pid}: error {err} "
+                        f"({broker_code_name(err)})",
+                        code=err, api="Produce",
                     )
                 base = off
         return base
@@ -405,6 +591,12 @@ class KafkaSource(Source):
     def close(self) -> None:
         """Stop consuming after the current backlog drains."""
         self._closed = True
+
+    def bind_telemetry(self, registry) -> None:
+        """Mirror the client's faults.kafka.* counters into the job's
+        registry (Job.__init__ calls this for every source that has
+        it)."""
+        self.client.bind_telemetry(registry)
 
     def _refill(self) -> None:
         """One Fetch for every partition whose fetch position is not
@@ -577,6 +769,9 @@ class KafkaSink:
         )
         if len(self._buf) >= self.flush_every:
             self.flush()
+
+    def bind_telemetry(self, registry) -> None:
+        self.client.bind_telemetry(registry)
 
     def flush(self) -> None:
         if not self._buf:

@@ -10,9 +10,13 @@ releases watermark-complete prefixes to the device).
 
 from __future__ import annotations
 
+import logging
+
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
+
+_LOG = logging.getLogger(__name__)
 
 from ..schema.batch import EventBatch
 from ..schema.stream_schema import StreamSchema
@@ -114,6 +118,45 @@ class BatchSource(Source):
             return None, np.iinfo(np.int64).max, True
         wm = int(batch.timestamps.max()) if len(batch) else None
         return batch, wm, False
+
+
+class ReplayBatchSource(BatchSource):
+    """BatchSource over an in-memory Sequence of prebuilt EventBatches
+    with an EXACT, checkpointable replay position — the
+    supervised-recovery analog of ListSource for the zero-per-record
+    ingest path (``bench.py --fault`` and supervised replay runs
+    restore mid-stream through it). The iterator-backed parent stays
+    non-checkpointable: an iterator has no position to restore."""
+
+    def __init__(
+        self,
+        stream_id: str,
+        schema: StreamSchema,
+        batches: Sequence[EventBatch],
+    ) -> None:
+        super().__init__(stream_id, schema, iter(()))
+        self._batches = list(batches)
+        self._pos = 0
+
+    def poll(self, max_events: int):
+        if self._pos >= len(self._batches):
+            return None, np.iinfo(np.int64).max, True
+        batch = self._batches[self._pos]
+        self._pos += 1
+        done = self._pos >= len(self._batches)
+        wm = (
+            np.iinfo(np.int64).max
+            if done
+            else (int(batch.timestamps.max()) if len(batch) else None)
+        )
+        return batch, wm, done
+
+    # -- checkpoint support -------------------------------------------------
+    def state_dict(self) -> dict:
+        return {"pos": self._pos}
+
+    def load_state_dict(self, d: dict) -> None:
+        self._pos = int(d["pos"])
 
 
 class ControlListSource:
@@ -263,6 +306,29 @@ class _DecodedLinesSource(Source):
         self._arrival = 0
         self._lateness = int(allowed_lateness_ms)
         self._fields, self._decoder = make_column_decoder(schema)
+        # checkpoint-position health: True once a tell()/seek() failed,
+        # i.e. the checkpointed position is NOT exact (resume is
+        # at-least-once from wherever the stream actually is). Sources
+        # with no tell/seek at all (sockets) are not degraded — an
+        # arrival-order position was never promised for them.
+        self._state_degraded = False
+        self._telemetry = None
+
+    def bind_telemetry(self, registry) -> None:
+        """Job.__init__ wiring: state-capture faults land in the job's
+        registry as ``faults.source_state``."""
+        self._telemetry = registry
+
+    def _note_state_fault(self, what: str, exc: Exception) -> None:
+        self._state_degraded = True
+        if self._telemetry is not None:
+            self._telemetry.inc("faults.source_state")
+        _LOG.warning(
+            "%s: source position %s failed (%s); the checkpoint is "
+            "marked degraded — restore replays from the stream's "
+            "current position (at-least-once)",
+            self.stream_id, what, exc,
+        )
 
     def _decode(self, data: bytes, max_rows: int):
         raise NotImplementedError
@@ -330,24 +396,35 @@ class _DecodedLinesSource(Source):
         if tell is not None:
             try:
                 pos = int(tell()) - len(self._carry)
-            except (OSError, ValueError):
-                pos = None
-        return {
+            except (OSError, ValueError) as e:
+                # NOT silent: a position we could not capture means the
+                # checkpoint cannot promise exactly-once resume for
+                # this source — count it, mark the state degraded, and
+                # let the snapshot carry the marker instead of a
+                # silently-wrong position
+                self._note_state_fault("capture (tell)", e)
+        d = {
             "pos": pos,
             "arrival": self._arrival,
             "done": self._done,
         }
+        if self._state_degraded:
+            d["degraded"] = True
+        return d
 
     def load_state_dict(self, d: dict) -> None:
         self._arrival = int(d.get("arrival", 0))
         self._done = bool(d.get("done", False))
+        self._state_degraded = bool(d.get("degraded", False))
         pos = d.get("pos")
         if pos is not None and hasattr(self._f, "seek"):
             try:
                 self._f.seek(pos)
                 self._carry = b""
-            except (OSError, ValueError):
-                pass  # non-seekable: at-least-once replay from current pos
+            except (OSError, ValueError) as e:
+                # at-least-once replay from the stream's current
+                # position — counted and marked, never silent
+                self._note_state_fault("restore (seek)", e)
 
 
 class JsonLinesSource(_DecodedLinesSource):

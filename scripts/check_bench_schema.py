@@ -41,6 +41,12 @@ contract:
   the deadline drain scheduler's staleness leg must actually have
   recorded (a scheduler that never ran produces no line).
 
+Optional ``recovery`` block (``bench.py --fault``, any version): when
+present it must carry a finite positive measured ``recovery_time_ms``,
+at least one injected crash, ``stale_tmp_swept: true``, and EXACT
+exactly-once numbers — ``duplicate_rows`` and ``lost_rows`` (counted
+against an unfaulted oracle, not assumed) must both be 0.
+
 Usage:
     python scripts/check_bench_schema.py [FILES...]
     python scripts/check_bench_schema.py --require-stages FILES...
@@ -288,6 +294,61 @@ def validate_v4(doc, errors: List[str], where: str) -> None:
                 )
 
 
+def validate_recovery(rec, errors: List[str], where: str) -> None:
+    """The ``--fault`` recovery block (optional in every version; when
+    present it must carry real measurements and the exactly-once
+    numbers must actually be exact — a recovery claim with duplicates
+    or losses is a failed claim, not a benchmark)."""
+    where = f"{where}:recovery"
+    if not isinstance(rec, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    for key in (
+        "crashes",
+        "restarts",
+        "checkpoints",
+        "events_replayed",
+        "rows_emitted",
+        "duplicate_rows",
+        "lost_rows",
+    ):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"{where}: {key} missing/non-int/negative ({v!r})"
+            )
+    rt = rec.get("recovery_time_ms")
+    if not _finite(rt) or rt <= 0:
+        errors.append(
+            f"{where}: recovery_time_ms missing/non-positive ({rt!r}) "
+            "— recovery must be a measured number"
+        )
+    if rec.get("crashes") == 0:
+        errors.append(
+            f"{where}: crashes == 0 — a recovery block with no "
+            "injected crash measures nothing"
+        )
+    if rec.get("duplicate_rows") != 0:
+        errors.append(
+            f"{where}: duplicate_rows="
+            f"{rec.get('duplicate_rows')!r} — exactly-once violated "
+            "(committed output repeated rows the oracle emitted once)"
+        )
+    if rec.get("lost_rows") != 0:
+        errors.append(
+            f"{where}: lost_rows={rec.get('lost_rows')!r} — "
+            "exactly-once violated (committed output is missing "
+            "oracle rows)"
+        )
+    if rec.get("exactly_once") is not True:
+        errors.append(f"{where}: exactly_once must be true")
+    if rec.get("stale_tmp_swept") is not True:
+        errors.append(
+            f"{where}: stale_tmp_swept must be true — the "
+            "kill-mid-checkpoint debris was not cleaned up"
+        )
+
+
 def validate_doc(
     doc, errors: List[str], where: str, require_stages: bool = False
 ) -> None:
@@ -324,6 +385,8 @@ def validate_doc(
         validate_v3(doc, errors, where)
     if version >= 4:
         validate_v4(doc, errors, where)
+    if "recovery" in doc:
+        validate_recovery(doc["recovery"], errors, where)
 
 
 def extract_docs(text: str, errors: List[str], path: str):

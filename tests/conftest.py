@@ -12,6 +12,19 @@ initializes (``jax.backends()`` would otherwise try to init them all).
 
 import os
 
+# Persistent XLA compilation cache, shared with bench.py: the sharded
+# (shard_map) and resident-replay tests cost minutes of XLA CPU
+# compile per cold run on the 2-core tier-1 lane; with the cache warm,
+# repeat suite runs skip every unchanged compile. Same knobs bench.py
+# sets — one cache, both consumers.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO, ".jax_cache")
+)
+os.environ.setdefault(
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2"
+)
+
 # TPU smoke lane (`FST_TPU_SMOKE=1 python -m pytest -m tpu tests/`):
 # keep the real accelerator backend alive instead of pinning CPU —
 # the only configuration under which the real chip runs result-asserting

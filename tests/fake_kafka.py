@@ -20,6 +20,27 @@ produce/append call so whole-batch fetch semantics are honest.
 ``mangle_batch`` (a bytes->bytes hook applied to every served v2
 batch) lets tests inject corruption or foreign codec flags on the
 wire without touching the log.
+
+Fault injection (``fault_hook``): a callable ``(api, seq) -> action``
+consulted once per request, in arrival order (``seq`` is a
+broker-lifetime request counter — deterministic schedules replay
+exactly). Actions:
+
+* ``None``             — serve normally
+* ``"drop"``           — close the connection without answering (an
+                         outage / crashed broker)
+* ``"drop_mid_frame"`` — send the size header + half the response,
+                         then close (the exact failure the client's
+                         ``_read_frame`` sees as mid-frame close)
+* ``"error"``          — answer Fetch/Produce/ListOffsets with the
+                         transient NOT_LEADER_FOR_PARTITION code (6)
+                         instead of data (other apis: like ``drop``)
+* ``"corrupt"``        — serve THIS fetch's v2 batches mangled
+                         (bit-flip => CRC32C mismatch); the log is
+                         untouched, the next fetch is clean (other
+                         apis: like ``drop``)
+* ``"delay"``          — serve normally after ``fault_delay_s``
+                         (default 2 ms; bounded, never a test clock)
 """
 
 from __future__ import annotations
@@ -27,6 +48,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from bisect import bisect_right
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -49,6 +71,7 @@ from flink_siddhi_tpu.connectors.kafka.records import (
 
 ERR_CORRUPT_MESSAGE = 2
 ERR_UNKNOWN_TOPIC = 3
+ERR_NOT_LEADER = 6  # transient: the client's retry taxonomy retries it
 
 # what the modern dialect advertises (intentionally wider than the
 # client implements: negotiation must intersect, not parrot)
@@ -81,6 +104,12 @@ class FakeBroker:
             MODERN_API_VERSIONS if api_versions is None else api_versions
         )
         self.mangle_batch: Optional[Callable[[bytes], bytes]] = None
+        # fault injection: (api, request_seq) -> action (see module
+        # docstring); None = no faults. Request seq is broker-lifetime
+        # and monotonic, so a seeded schedule replays deterministically.
+        self.fault_hook: Optional[Callable[[int, int], Optional[str]]] = None
+        self.fault_delay_s = 0.002
+        self._req_seq = 0
         self._lock = threading.Lock()
         self._server = socket.create_server((host, port))
         self._server.settimeout(0.2)
@@ -146,17 +175,48 @@ class FakeBroker:
                         return
                     data += chunk
                 resp = self._handle(bytes(data))
-                if resp is None:  # legacy broker: unknown api, hang up
+                if resp is None:  # legacy broker / drop fault: hang up
                     return
+                if isinstance(resp, tuple):  # ("partial", payload)
+                    _, payload = resp
+                    conn.sendall(
+                        struct.pack(">i", len(payload))
+                        + payload[: max(len(payload) // 2, 1)]
+                    )
+                    return  # close mid-frame
                 conn.sendall(struct.pack(">i", len(resp)) + resp)
         finally:
             conn.close()
 
     # -- request dispatch -------------------------------------------------
-    def _handle(self, data: bytes) -> Optional[bytes]:
+    def _handle(self, data: bytes):
         r = Reader(data)
         api, version, corr = r.i16(), r.i16(), r.i32()
         r.string()  # client_id
+        # fault injection happens here, per request, in arrival order
+        fault = None
+        if self.fault_hook is not None:
+            with self._lock:
+                seq = self._req_seq
+                self._req_seq += 1
+            fault = self.fault_hook(api, seq)
+        if fault == "drop":
+            return None
+        if fault == "delay":
+            time.sleep(self.fault_delay_s)
+            fault = None
+        forced_err = 0
+        corrupt = False
+        if fault == "error":
+            if api in (API_FETCH, API_PRODUCE, API_LIST_OFFSETS):
+                forced_err = ERR_NOT_LEADER
+            else:
+                return None  # no error slot in these responses: drop
+        elif fault == "corrupt":
+            if api == API_FETCH:
+                corrupt = True
+            else:
+                return None
         w = Writer().i32(corr)
         if api == API_VERSIONS:
             if self.legacy:
@@ -165,19 +225,21 @@ class FakeBroker:
         elif api == API_METADATA:
             self._metadata(r, w)
         elif api == API_LIST_OFFSETS:
-            self._list_offsets(r, w)
+            self._list_offsets(r, w, forced_err)
         elif api == API_FETCH:
             if version not in (0, 4):
                 raise AssertionError(f"fake broker: Fetch v{version}")
-            self._fetch(r, w, version)
+            self._fetch(r, w, version, forced_err, corrupt)
         elif api == API_PRODUCE:
             if version not in (0, 3):
                 raise AssertionError(f"fake broker: Produce v{version}")
-            self._produce(r, w, version)
+            self._produce(r, w, version, forced_err)
         else:
             if self.legacy:
                 return None
             raise AssertionError(f"fake broker: unsupported api {api}")
+        if fault == "drop_mid_frame":
+            return ("partial", w.done())
         return w.done()
 
     def _metadata(self, r: Reader, w: Writer) -> None:
@@ -197,7 +259,9 @@ class FakeBroker:
                     w.i32(1).i32(0)  # replicas [0]
                     w.i32(1).i32(0)  # isr [0]
 
-    def _list_offsets(self, r: Reader, w: Writer) -> None:
+    def _list_offsets(
+        self, r: Reader, w: Writer, forced_err: int = 0
+    ) -> None:
         r.i32()  # replica
         w.i32(r_topics := r.i32())
         for _ in range(r_topics):
@@ -206,6 +270,9 @@ class FakeBroker:
             w.string(t).i32(np_)
             for _ in range(np_):
                 pid, time_, _maxn = r.i32(), r.i64(), r.i32()
+                if forced_err:
+                    w.i32(pid).i16(forced_err).i32(0)
+                    continue
                 with self._lock:
                     log = self.logs.get((t, pid))
                 if log is None:
@@ -215,7 +282,10 @@ class FakeBroker:
                 w.i32(pid).i16(0).i32(1).i64(off)
 
     # -- fetch ------------------------------------------------------------
-    def _fetch(self, r: Reader, w: Writer, version: int) -> None:
+    def _fetch(
+        self, r: Reader, w: Writer, version: int,
+        forced_err: int = 0, corrupt: bool = False,
+    ) -> None:
         r.i32(), r.i32(), r.i32()  # replica, max_wait, min_bytes
         if version >= 4:
             r.i32(), r.i8()  # total max_bytes, isolation_level
@@ -232,8 +302,16 @@ class FakeBroker:
                     log = list(self.logs.get((t, pid), ()))
                     bounds = list(self.bounds.get((t, pid), ()))
                 hw = len(log)
+                if forced_err:
+                    w.i32(pid).i16(forced_err).i64(hw)
+                    if version >= 4:
+                        w.i64(hw).i32(0)
+                    w.bytes_(b"")
+                    continue
                 if version >= 4:
-                    rset = self._serve_batches(log, bounds, off, maxb)
+                    rset = self._serve_batches(
+                        log, bounds, off, maxb, corrupt=corrupt
+                    )
                     w.i32(pid).i16(0).i64(hw)
                     w.i64(hw)  # last_stable_offset
                     w.i32(0)  # aborted_transactions
@@ -256,9 +334,14 @@ class FakeBroker:
             o += 1
         return mset
 
-    def _serve_batches(self, log, bounds, off: int, maxb: int) -> bytes:
+    def _serve_batches(
+        self, log, bounds, off: int, maxb: int, corrupt: bool = False
+    ) -> bytes:
         """v4 dialect: whole v2 batches, starting with the batch that
-        CONTAINS the fetch offset; always at least one batch."""
+        CONTAINS the fetch offset; always at least one batch.
+        ``corrupt=True`` (one fetch's fault action) flips a payload
+        bit in every served batch — CRC32C fails client-side, the log
+        itself stays clean."""
         if off >= len(log) or not bounds:
             return b""
         from flink_siddhi_tpu.connectors.kafka.codecs import codec_id
@@ -276,12 +359,18 @@ class FakeBroker:
             )
             if self.mangle_batch is not None:
                 batch = self.mangle_batch(batch)
+            if corrupt:
+                b = bytearray(batch)
+                b[-1] ^= 0x04  # payload bit: breaks the batch CRC32C
+                batch = bytes(b)
             out += batch
             i += 1
         return out
 
     # -- produce ----------------------------------------------------------
-    def _produce(self, r: Reader, w: Writer, version: int) -> None:
+    def _produce(
+        self, r: Reader, w: Writer, version: int, forced_err: int = 0
+    ) -> None:
         if version >= 3:
             r.string()  # transactional_id
         r.i16(), r.i32()  # acks, timeout
@@ -294,6 +383,13 @@ class FakeBroker:
             for _ in range(np_):
                 pid = r.i32()
                 rset = r.bytes_() or b""
+                if forced_err:
+                    # transient refusal: NOTHING is appended — the
+                    # client's retry re-sends the whole batch
+                    w.i32(pid).i16(forced_err).i64(-1)
+                    if version >= 2:
+                        w.i64(-1)
+                    continue
                 try:
                     msgs = decode_record_set(rset)
                     err = 0

@@ -240,6 +240,7 @@ def test_rerun_is_deterministic_counts_only():
         rep2.rerun()
 
 
+@pytest.mark.slow  # full-mesh-8 shard_map: minutes of XLA CPU compile on the 2-core tier-1 lane (mesh-4 sharded coverage stays tier-1)
 def test_sharded_resident_matches_sharded_streaming():
     """Bounded replay over a ShardedJob mesh: the [cycles, shards, ...]
     scan whose body is the shard_map'd step must reproduce the sharded
