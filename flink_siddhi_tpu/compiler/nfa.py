@@ -941,31 +941,57 @@ def _chain_core(
 
     # advance every partial through all remaining positive elements
     # (K-1 gathers); absence guards between steps kill a partial when a
-    # guard event arrives at or before the step's own match
-    for k in range(1, K):
-        elem = positive[k]
-        at_k = v_active & (v_step == k)
-        j = nxt[elem][jnp.clip(v_pos, 0, E)]
-        found = at_k & (j < E)
-        for g in guards[k]:
-            jg = nxt[g][jnp.clip(v_pos, 0, E)]
-            violated = at_k & (jg <= j) & (jg < E)
-            v_active = v_active & ~violated
-            found = found & ~violated
-        ts_j = ts_pad[j]
-        if cfg.has_within:
-            ok = (ts_j - v_start) <= within_val
-            dead = found & ~ok
-            found = found & ok
-            v_active = v_active & ~dead
-        for pair in pairs:
-            if pair[0] == elem:
-                v = env_pad[pair][j]
-                caps[pair] = jnp.where(found, v, caps[pair])
-        v_step = jnp.where(found, k + 1, v_step)
-        v_pos = jnp.where(found, j + 1, v_pos)
-        if k == K - 1:
-            v_emit_ts = jnp.where(found, ts_j, v_emit_ts)
+    # guard event arrives at or before the step's own match. On TPU the
+    # whole advance fuses into ONE Pallas pass (pallas_ops.chain_advance
+    # holds the next-match table in VMEM and returns the per-step match
+    # positions); capture/emit-ts gathers replay off jmat in XLA. The
+    # unfused loop below is both the fallback and the kernel's oracle.
+    adv = None
+    if use_pallas and K > 1:
+        from .pallas_ops import chain_advance
+
+        adv = chain_advance(
+            positive, guards, cfg.has_within, nxt, ts_pad,
+            v_active, v_step, v_pos, v_start, within_val,
+        )
+    if adv is not None:
+        v_active, v_step, v_pos, jmat = adv
+        for k in range(1, K):
+            elem = positive[k]
+            jk = jmat[k - 1]
+            found = jk < E
+            for pair in pairs:
+                if pair[0] == elem:
+                    caps[pair] = jnp.where(
+                        found, env_pad[pair][jk], caps[pair]
+                    )
+            if k == K - 1:
+                v_emit_ts = jnp.where(found, ts_pad[jk], v_emit_ts)
+    else:
+        for k in range(1, K):
+            elem = positive[k]
+            at_k = v_active & (v_step == k)
+            j = nxt[elem][jnp.clip(v_pos, 0, E)]
+            found = at_k & (j < E)
+            for g in guards[k]:
+                jg = nxt[g][jnp.clip(v_pos, 0, E)]
+                violated = at_k & (jg <= j) & (jg < E)
+                v_active = v_active & ~violated
+                found = found & ~violated
+            ts_j = ts_pad[j]
+            if cfg.has_within:
+                ok = (ts_j - v_start) <= within_val
+                dead = found & ~ok
+                found = found & ok
+                v_active = v_active & ~dead
+            for pair in pairs:
+                if pair[0] == elem:
+                    v = env_pad[pair][j]
+                    caps[pair] = jnp.where(found, v, caps[pair])
+            v_step = jnp.where(found, k + 1, v_step)
+            v_pos = jnp.where(found, j + 1, v_pos)
+            if k == K - 1:
+                v_emit_ts = jnp.where(found, ts_j, v_emit_ts)
 
     if batch_max is None:
         batch_max = jnp.max(jnp.where(valid, ts, -_BIG))

@@ -38,12 +38,51 @@ _LAZY_ORD_WRAP = 1 << 30  # reset lazy ordinal space before int32 wrap
 _LOG = logging.getLogger(__name__)
 
 
+def _wire_sig(wire):
+    """Structural signature of a wire tape: pytree aux + leaf layouts.
+    Two tapes with equal signatures can stack into one scanned axis
+    (shared by the fused streaming dispatch below and the bounded
+    replay's pre-stager, runtime/replay.py)."""
+    leaves, treedef = jax.tree.flatten(wire)
+    return (
+        str(treedef),
+        tuple((np.shape(x), np.dtype(getattr(x, "dtype", type(x))))
+              for x in leaves),
+    )
+
+
+def _stack_wires(wires):
+    """Stack structurally-identical host wire tapes along a new leading
+    (scan) axis — ONE definition for the fused streaming dispatch and
+    the bounded replay's pre-stager."""
+    return jax.tree.map(lambda *ls: np.stack(ls), *wires)
+
+
+def _empty_wire_like(wire):
+    """A padding tape for a partial trailing segment: structurally
+    identical, zero valid events, time parked at the source tape's
+    base (never advances the clock). Only ``n_valid`` is replaced —
+    every other leaf aliases the source tape (read-only)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        wire, n_valid=np.zeros(1, dtype=np.int32)
+    )
+
+
 @dataclass
 class _PlanRuntime:
     plan: CompiledPlan
     states: Dict
     jitted: Callable  # plan.step (kept for direct/step callers)
     jitted_acc: Callable = None  # plan.step_acc — the hot loop entry
+    # fused streaming dispatch: a lax.scan of K stacked micro-batch
+    # tapes per device call (the replay's segment shape, fed live).
+    # seg_pending holds staged-but-undispatched device tapes; the scan
+    # keeps jitted_acc's donation semantics (states + acc donated, the
+    # scan carry updates them in place)
+    jitted_seg: Callable = None
+    seg_pending: List = field(default_factory=list)
     jitted_init_acc: Callable = None  # cached: zeroing program compiles once
     jitted_flush: Callable = None  # plan.flush under jit (device states)
     acc: Dict = None  # device-side output accumulator (None: fetch-per-cycle)
@@ -475,6 +514,19 @@ class Job:
         # bounded by ~max_inflight_cycles * device_cycle_time + drain
         # interval, and the device stays fed as long as it is >= 2.
         self.max_inflight_cycles = 6
+        # fused streaming dispatch: collapse the per-micro-batch
+        # dispatch chain into one lax.scan-of-K-tapes device call (the
+        # bounded replay's segment shape, fed live). None/1 = the
+        # historical one-dispatch-per-batch loop. Tapes stage host-side
+        # while a segment fills; at dispatch the stacked segment
+        # crosses H2D in ONE async jax.device_put, issued while the
+        # PREVIOUS segment's compute is still in flight (the ticket
+        # window keeps >= 2 segments outstanding) — double-buffered
+        # ingest; the fusion.* counters and the stage.h2d_overlap span
+        # prove the overlap. Drains fire between segments; checkpoints
+        # force-dispatch the pending partial segment first, so state
+        # capture always lands on a segment boundary.
+        self.fused_segment_len: Optional[int] = None
         # adaptive depth: when set, max_inflight_cycles tracks the
         # measured cycle pace so queued device work stays within about
         # half the latency target (the other half is drain staleness +
@@ -574,6 +626,18 @@ class Job:
             traces["n"] += 1  # python body runs only while TRACING
             return plan.step_acc(states, acc, wire.expand())
 
+        def seg_scan(states, acc, seg):
+            # the fused streaming dispatch: ONE device call advances K
+            # stacked micro-batches — the exact scan body the bounded
+            # replay proves row-identical (runtime/replay.py), fed from
+            # live tapes instead of a pre-staged stream
+            def body(carry, wire):
+                s, a = plan.step_acc(carry[0], carry[1], wire.expand())
+                return (s, a), None
+
+            (states, acc), _ = jax.lax.scan(body, (states, acc), seg)
+            return states, acc
+
         rt = _PlanRuntime(
             plan=plan,
             states=plan.init_state(),
@@ -582,6 +646,10 @@ class Job:
             # 100s-of-MB) output buffer in place instead of copying it
             # every micro-batch
             jitted_acc=jax.jit(step_wire, donate_argnums=(0, 1)),
+            # donation survives the scan carry: states + acc thread
+            # through as the carry and come back as the only outputs,
+            # so XLA updates both in place across the whole segment
+            jitted_seg=jax.jit(seg_scan, donate_argnums=(0, 1)),
             jitted_init_acc=init_acc,
             jitted_flush=jax.jit(plan.flush),
             acc=init_acc(),
@@ -636,6 +704,9 @@ class Job:
         self, host_id: str, plan: CompiledPlan, slot: int, t
     ) -> None:
         rt = self._plans[host_id]
+        # fused mode: tapes staged before this add must step WITHOUT
+        # the new member (same boundary contract as set_plan_enabled)
+        self._dispatch_segment(rt)
         group = rt.plan.artifacts[0]
         tpl, params, within = t
         states = dict(rt.states)
@@ -825,6 +896,11 @@ class Job:
             host_id, slot = folded
             rt = self._plans.get(host_id)
             if rt is not None:
+                # fused mode: events staged before this control event
+                # must step under the OLD member state (control takes
+                # effect at the next boundary, as in the per-batch
+                # loop) — dispatch the pending segment before mutating
+                self._dispatch_segment(rt)
                 group = rt.plan.artifacts[0]
                 states = dict(rt.states)
                 states[group.name] = group.set_enabled(
@@ -834,6 +910,11 @@ class Job:
             return
         rt = self._plans.get(plan_id)
         if rt is not None:
+            if not enabled:
+                # events staged while the plan was enabled still step
+                # (control takes effect at the NEXT boundary, as in the
+                # per-batch loop)
+                self._dispatch_segment(rt)
             rt.enabled = enabled
 
     @property
@@ -882,6 +963,45 @@ class Job:
         for rt in self._plans.values():
             self._drain_poll(rt, block=True)
         self._sinks.setdefault(output_stream, []).append(fn)
+
+    def reset_engine_state(self) -> None:
+        """Benchmark/rerun aid: reset device state, staged fused
+        segments, in-flight tickets, lazy rings, and host emission
+        phase so the SAME job can replay an identical stream again
+        with every compiled executable still warm — the second-run
+        measurement contract shared by ``ResidentReplay.rerun``,
+        bench's streaming mode, and ``scripts/profile_dispatch.py``
+        (ONE reset recipe, so a new runtime field cannot be forgotten
+        in one of the copies). States re-grow to the interned encoder
+        sizes: compiled programs were lowered against the GROWN
+        shapes."""
+        for rt in self._plans.values():
+            rt.states = jax.device_put(
+                rt.plan.grow_state(rt.plan.init_state())
+            )
+            rt.acc = rt.jitted_init_acc()
+            rt.acc_dirty = False
+            rt.dirty_since = None
+            rt.seg_pending = []
+            rt.tickets.clear()
+            if getattr(rt, "lazy", None) is not None:
+                rt.lazy = _LazyRing(rt.lazy.budget)
+                rt.lazy_base = None
+        # host-side emission state too: a carried rate-limiter phase
+        # (chunk position / buffered rows / deadlines) would make the
+        # second run's flush emit at different boundaries
+        for lim in self._rate_limiters.values():
+            lim.count = 0
+            lim.buf = []
+            lim.cur = {}
+            lim.deadline = None
+        # drain-cadence phase: a carried _cycles_since_drain would put
+        # the second run's first capacity swap at a different boundary
+        # than the first run's (same contract as the limiter reset)
+        self._cycles_since_drain = 0
+        self._last_full_drain = time.monotonic()
+        self._last_cycle_t = None
+        self._cycle_ema = None
 
     # -- run loop ------------------------------------------------------------
     def run(self, max_cycles: Optional[int] = None) -> None:
@@ -997,6 +1117,12 @@ class Job:
         the fetches — the accumulator is swapped for a fresh one and its
         meta/data transfers overlap with subsequent device cycles, to be
         decoded by a later poll (run_cycle) or a waiting drain."""
+        for rt in self._plans.values():
+            # fused mode: staged-but-undispatched tapes must reach the
+            # device before a drain whose caller will read state or
+            # rows (results/snapshot/checkpoint) — this is what makes
+            # every checkpoint land on a segment boundary
+            self._dispatch_segment(rt)
         with self.telemetry.span("drain"):
             for rt in self._plans.values():
                 self._drain_request(rt)
@@ -1004,6 +1130,7 @@ class Job:
 
     def _drain_plan(self, rt: _PlanRuntime) -> None:
         """Synchronous per-plan drain (checkpoint / removal paths)."""
+        self._dispatch_segment(rt)
         with self.telemetry.span("drain"):
             self._drain_request(rt)
             self._drain_poll(rt, block=True)
@@ -1538,12 +1665,21 @@ class Job:
                     budget_s = self.target_p99_ms / 2000.0
                     # depth 1 is legitimate under a latency target when
                     # a single cycle already eats the budget (a paced
-                    # load doesn't need pipelining to stay fed)
+                    # load doesn't need pipelining to stay fed). Under
+                    # fused dispatch each ticket holds a whole
+                    # K-batch segment while the EMA tracks per-CYCLE
+                    # (per-batch) pace, so the queued-work estimate
+                    # scales by K — without it the window admits ~K x
+                    # the intended device backlog
+                    k_seg = max(1, self.fused_segment_len or 1)
                     self.max_inflight_cycles = max(
                         1,
                         min(
                             8,
-                            int(budget_s / max(self._cycle_ema, 1e-3)),
+                            int(
+                                budget_s
+                                / max(self._cycle_ema * k_seg, 1e-3)
+                            ),
                         ),
                     )
             self._last_cycle_t = t_now
@@ -1551,6 +1687,24 @@ class Job:
         with tel.span("drain"):
             for rt in self._plans.values():
                 self._drain_poll(rt)
+        if self.fused_segment_len and self.fused_segment_len > 1:
+            # a partial segment must not wait forever for a slow source
+            # to fill it: once its oldest staged tape reaches the drain
+            # staleness budget, dispatch short — visibility latency
+            # stays bounded by ~interval + drain time, fused or not.
+            # (`is None` check, not `or`: drain_interval_ms=0 means
+            # "tightest visibility", which must not round up to 500ms)
+            age_s = (
+                500.0
+                if self.drain_interval_ms is None
+                else self.drain_interval_ms
+            ) / 1e3
+            now0 = time.monotonic()
+            for rt in self._plans.values():
+                if rt.seg_pending and (
+                    now0 - rt.seg_pending[0]["t"] >= age_s
+                ):
+                    self._dispatch_segment(rt)
         now = time.monotonic()
         if self.drain_interval_ms is not None:
             interval_s = self.drain_interval_ms / 1e3
@@ -1817,11 +1971,25 @@ class Job:
         plan = rt.plan
         total = sum(len(b) for b in involved)
         rt.tape_capacity = max(rt.tape_capacity, bucket_size(total))
+        # lazy-ring retention is decode-side state: a plan NOBODY
+        # observes (no sinks, retention off) never decodes ordinals,
+        # so retaining projection columns for it is pure memcpy waste.
+        # A sink attached later starts a fresh ordinal base (the
+        # lazy_base=None adopt-from-device path) — rows produced
+        # before the attach are counted-not-delivered by the add_sink
+        # contract, so nothing they would have decoded is ever read.
+        retain_lazy = (
+            getattr(rt, "lazy", None) is not None
+            and self._has_consumers(rt)
+        )
         tape, _prov = build_wire_tape(
             plan.spec, involved, self._epoch_ms, rt.wire_kinds,
             capacity=rt.tape_capacity,
+            # the merged-order provenance map is only consulted by the
+            # multi-batch lazy retention below
+            want_prov=retain_lazy and len(involved) > 1,
         )
-        if getattr(rt, "lazy", None) is not None:
+        if retain_lazy:
             if rt.lazy_base is None:
                 # first step (or first after restore): adopt the device
                 # counter so host ring and device ordinals share a base
@@ -1893,9 +2061,148 @@ class Job:
             rt.lazy_base += total
         return tape
 
+    # -- fused streaming dispatch (scan-of-microbatches segments) ----------
+    def _fused_k(self, rt: _PlanRuntime) -> int:
+        """Effective segment length for this plan: the configured K,
+        clamped so the accumulator can hold a whole segment's
+        emissions (there is no mid-segment drain — the same bound the
+        bounded replay applies via the drain hint)."""
+        k = self.fused_segment_len
+        if not k or k <= 1 or rt.acc is None or not rt.plan.artifacts:
+            return 1
+        hint = self._drain_hints.get(rt.plan.plan_id)
+        if hint:
+            k = min(k, hint)
+        return max(1, k)
+
+    def _stage_fused(
+        self, rt: _PlanRuntime, involved: List[EventBatch]
+    ) -> None:
+        """Stage one micro-batch tape toward the current segment (host
+        side only — the segment uploads in one async device_put at
+        dispatch, which the in-flight ticket window overlaps with the
+        PREVIOUS segment's compute). A structural break (wire kinds
+        widened, capacity grew) flushes the shorter segment first so
+        one compiled scan shape serves each structure."""
+        tape = self._stage_tape(rt, involved)
+        # the staging bookkeeping accrues to tape_build (it IS part of
+        # building this batch's staged form); the dispatch calls below
+        # open their own top-level spans, so they stay outside
+        with self.telemetry.span("tape_build"):
+            self._update_drain_hint(
+                rt.plan, tape.capacity,
+                lambda name: rt.states.get(name),
+            )
+            sig = _wire_sig(tape)
+        if rt.seg_pending and rt.seg_pending[0]["sig"] != sig:
+            self._dispatch_segment(rt)
+        with self.telemetry.span("tape_build"):
+            # the sampling mask is computed once per batch; the tiny
+            # sampled subset serves both the "staged" mark here and
+            # the "dispatch" mark later
+            sampled = [
+                self.tracer.sampled_subset(b.timestamps)
+                for b in involved
+            ]
+            rt.seg_pending.append(
+                {
+                    "tape": tape,
+                    "sig": sig,
+                    "ts": sampled,
+                    "t": time.monotonic(),
+                }
+            )
+            self.telemetry.inc("fusion.batches")
+            for s in sampled:
+                self.tracer.mark(s, "staged", presampled=True)
+        if len(rt.seg_pending) >= self._fused_k(rt):
+            self._dispatch_segment(rt)
+
+    def _dispatch_segment(self, rt: _PlanRuntime) -> None:
+        """Upload + dispatch the pending tapes as ONE scanned device
+        call. The stacked segment crosses host->device in a single
+        async ``jax.device_put`` issued while the previous segment's
+        compute is still in flight (the backpressure window keeps >= 2
+        segments outstanding), so ingest H2D and device compute
+        double-buffer — counted per upload in fusion.h2d_overlapped.
+        A partial segment (end of stream, checkpoint boundary,
+        structural break) pads with empty tapes to the full segment
+        length so the compiled scan stays one shape — padding tapes
+        carry zero valid events and are row-inert (the replay's
+        proof)."""
+        pending = rt.seg_pending
+        if not pending:
+            return
+        rt.seg_pending = []
+        wires = [e["tape"] for e in pending]
+        k_full = max(self._fused_k(rt), len(wires))
+        while len(wires) < k_full:
+            wires.append(_empty_wire_like(wires[-1]))
+        tel = self.telemetry
+        with tel.span("stage.h2d_overlap"):
+            # overlap proof: the upload is issued while the device is
+            # still busy with the previous segment — counted, not
+            # asserted. The NEWEST ticket is the previous segment's
+            # dispatch (tickets retire oldest-first, so checking [0]
+            # would undercount overlap whenever an older ticket
+            # happened to retire but not yet pop)
+            busy = bool(rt.tickets) and not rt.tickets[-1].is_ready()
+            seg = jax.device_put(_stack_wires(wires))
+        tel.inc("fusion.h2d_uploads")
+        if busy:
+            tel.inc("fusion.h2d_overlapped")
+        plan = rt.plan
+        with tel.span("dispatch"):
+            t0 = time.monotonic()
+            # host interning during staging may have discovered new
+            # group keys: grow once per segment, before the scanned call
+            rt.states = plan.grow_state(rt.states)
+            rt.states, rt.acc = rt.jitted_seg(rt.states, rt.acc, seg)
+            rt.acc_dirty = True
+            if rt.dirty_since is None:
+                # backdate to the OLDEST staged tape's staging time:
+                # its events have been in hand since then, so the
+                # drain deadline (and the schema-gated drain.staleness
+                # histogram) must count the staging wait too — else a
+                # paced load's visibility is ~2x interval while the
+                # histogram reports ~1x
+                rt.dirty_since = pending[0]["t"]
+            rt.tickets.append(self._make_ticket(rt.states))
+            if tel.enabled:
+                # per-segment enqueue time (host side of the dispatch;
+                # the device wall hides behind the ticket). Recorded
+                # under both names: dispatch.segment is the fused-mode
+                # stage model's leg (docs/observability.md),
+                # dispatch.enqueue the mode-agnostic one the
+                # profiler reads (scripts/profile_dispatch.py)
+                dt = time.monotonic() - t0
+                tel.record_seconds("dispatch.segment", dt)
+                tel.record_seconds("dispatch.enqueue", dt)
+                tel.inc("fusion.dispatches")
+        for e in pending:
+            for t in e["ts"]:
+                self.tracer.mark(t, "dispatch", presampled=True)
+        while rt.tickets and rt.tickets[0].is_ready():
+            rt.tickets.popleft()
+        if len(rt.tickets) > self.max_inflight_cycles:
+            with tel.span("backpressure_wait"):
+                jax.block_until_ready(rt.tickets.popleft())
+            while rt.tickets and rt.tickets[0].is_ready():
+                rt.tickets.popleft()
+        if plan.has_flush and (
+            rt.flush_warm is None
+            or rt.flush_warm[0] != self._state_sig(rt.states)
+        ):
+            self._warm_flush(rt)
+
     def _step_plan_window(
         self, rt: _PlanRuntime, involved: List[EventBatch]
     ) -> None:
+        if self.fused_segment_len and self.fused_segment_len > 1 and (
+            rt.acc is not None and rt.plan.artifacts
+        ):
+            self._stage_fused(rt, involved)
+            return
         plan = rt.plan
         tape = self._stage_tape(rt, involved)
         tel = self.telemetry
@@ -1903,6 +2210,7 @@ class Job:
         # tables before the jit call (shape change -> one-off retrace)
         rt.states = plan.grow_state(rt.states)
         with tel.span("dispatch"):
+            t0 = time.monotonic()
             # NO device->host fetch here: emissions append to the
             # on-device accumulator and are drained in bulk
             # (flush/results/periodic check)
@@ -1910,6 +2218,13 @@ class Job:
             rt.acc_dirty = True
             if rt.dirty_since is None:
                 rt.dirty_since = time.monotonic()
+            if tel.enabled:
+                # host-side enqueue time of one dispatch (the device
+                # wall hides behind the ticket; scripts/
+                # profile_dispatch.py reports both legs)
+                tel.record_seconds(
+                    "dispatch.enqueue", time.monotonic() - t0
+                )
             # sliding-window backpressure: a tiny non-donated "ticket"
             # is derived from the new state each cycle; completed
             # tickets retire via is_ready polling (free), and only when

@@ -41,35 +41,16 @@ import jax
 import numpy as np
 
 from ..schema.batch import EventBatch
-from .executor import Job, _PlanRuntime
+from .executor import (
+    Job,
+    _PlanRuntime,
+    _empty_wire_like as _empty_like,
+    _stack_wires,
+    _wire_sig,
+)
 from .tape import build_wire_tape
 
 _LOG = logging.getLogger(__name__)
-
-
-def _wire_sig(wire):
-    """Structural signature of a wire tape: pytree aux + leaf layouts.
-    Two tapes with equal signatures can stack into one scanned axis."""
-    leaves, treedef = jax.tree.flatten(wire)
-    return (
-        str(treedef),
-        tuple((np.shape(x), np.dtype(getattr(x, "dtype", type(x))))
-              for x in leaves),
-    )
-
-
-def _stack_wires(wires):
-    return jax.tree.map(lambda *ls: np.stack(ls), *wires)
-
-
-def _empty_like(wire):
-    """A padding tape: structurally identical, zero valid events, time
-    parked at the source tape's base (never advances the clock)."""
-    import dataclasses
-
-    return dataclasses.replace(
-        wire, n_valid=np.zeros(1, dtype=np.int32)
-    )
 
 
 class ResidentReplay:
@@ -186,21 +167,15 @@ class ResidentReplay:
                 for i in range(0, len(wires), k)
             ]
         plan = rt.plan
-
-        def seg_scan(states, acc, seg):
-            def body(carry, wire):
-                s, a = plan.step_acc(carry[0], carry[1], wire.expand())
-                return (s, a), None
-
-            (states, acc), _ = jax.lax.scan(body, (states, acc), seg)
-            return states, acc
-
-        # AOT-compile off the replay clock and keep the COMPILED
-        # executable: lower().compile() does not seed jit.__call__'s
-        # cache, so calling the jit wrapper in run() would pay the
-        # compile (or its multi-second cache deserialize) on the clock
+        # the scan body IS the fused streaming dispatch's (ONE
+        # definition: _PlanRuntime.jitted_seg, built in
+        # Job._create_runtime) — AOT-compiled off the replay clock,
+        # keeping the COMPILED executable: lower().compile() does not
+        # seed jit.__call__'s cache, so calling the jit wrapper in
+        # run() would pay the compile (or its multi-second cache
+        # deserialize) on the clock
         with tel.span("stage.compile"):
-            scan = jax.jit(seg_scan, donate_argnums=(0, 1)).lower(
+            scan = rt.jitted_seg.lower(
                 rt.states, rt.acc, segments[0]
             ).compile()
         # ...and warm it: the FIRST invocation of a freshly-loaded
@@ -292,25 +267,10 @@ class ResidentReplay:
                     "sinks/collectors would double-observe rows"
                 )
         with job.telemetry.span("replay.reset"):
-            for pid in self._staged:
-                rt = job._plans[pid]
-                # grow to the staged encoder sizes: the compiled scan
-                # was lowered against the GROWN state shapes
-                rt.states = jax.device_put(
-                    rt.plan.grow_state(rt.plan.init_state())
-                )
-                rt.acc = rt.jitted_init_acc()
-                rt.acc_dirty = False
-                rt.dirty_since = None
-            # host-side emission state resets too: a carried rate-
-            # limiter phase (chunk position / buffered rows / deadlines)
-            # would make the second run's flush emit at different
-            # boundaries
-            for lim in job._rate_limiters.values():
-                lim.count = 0
-                lim.buf = []
-                lim.cur = {}
-                lim.deadline = None
+            # the one shared reset recipe (device state re-grown to the
+            # staged encoder sizes, accumulators, fused segments, rate-
+            # limiter phase) — see Job.reset_engine_state
+            job.reset_engine_state()
         t0 = time.perf_counter()
         self.run()
         self.job.flush()
@@ -363,7 +323,8 @@ class ShardedResidentReplay(ResidentReplay):
             for shards in routed:
                 tapes = [
                     build_tape(
-                        plan.spec, sh, job._epoch_ms, rt.tape_capacity
+                        plan.spec, sh, job._epoch_ms, rt.tape_capacity,
+                        want_prov=False,
                     )[0]
                     for sh in shards
                 ]

@@ -250,11 +250,17 @@ def build_wire_tape(
     epoch_ms: int,
     sticky_kinds: Dict[str, str],
     capacity: int | None = None,
+    want_prov: bool = True,
 ) -> Tuple[WireTape, np.ndarray]:
     """build_tape + narrowing. ``sticky_kinds`` (mutated) remembers each
-    column's widest kind seen so widths only ever widen (bounded retraces).
+    column's widest kind seen so widths only ever widen (bounded
+    retraces). ``want_prov=False`` skips building the merged-order
+    provenance map (callers that never consult it — e.g. single-batch
+    staging — save two full-width array fills per batch).
     """
-    tape, prov = build_tape(spec, batches, epoch_ms, capacity)
+    tape, prov = build_tape(
+        spec, batches, epoch_ms, capacity, want_prov=want_prov
+    )
     total = sum(len(b) for b in batches)
     epoch_i32 = int(np.int64(epoch_ms) & 0xFFFFFFFF)
     if epoch_i32 >= 1 << 31:
@@ -310,6 +316,26 @@ def build_wire_tape(
     ts_kind = sticky_kinds.get("__ts__")
     ts_arr = tape.ts
     ts_base = None
+    if ts_kind == "d0" and total >= 2:
+        # sticky fast path: the cadence was already proven regular on
+        # a >=4096-event batch; re-verifying "still constant" is one
+        # int32 subtract + compare — no int64 diff allocation. Any
+        # size keeps d0 here (widening a small-but-constant batch
+        # would only force a needless retrace); an irregular batch
+        # falls through to the generic widening below
+        step = int(tape.ts[1]) - int(tape.ts[0])
+        if 0 <= step <= (1 << 30) and bool(
+            np.all(
+                tape.ts[1:total] - tape.ts[: total - 1] == step
+            )
+        ):
+            ts_base = np.asarray([tape.ts[0], step], dtype=np.int32)
+            ts_arr = np.zeros(0, dtype=np.int8)
+            sticky_kinds["__ts__"] = "d0"
+            return _finish_wire(
+                spec, tape, total, cols, kinds, epoch_i32,
+                "d0", ts_base, ts_arr,
+            ), prov
     if ts_kind != "i32" and total:
         deltas = np.diff(tape.ts.astype(np.int64), prepend=tape.ts[0])
         vd = deltas[1:total]  # valid-region deltas (padding repeats)
@@ -343,11 +369,19 @@ def build_wire_tape(
     else:
         ts_kind = "i32"
     sticky_kinds["__ts__"] = ts_kind
+    return _finish_wire(
+        spec, tape, total, cols, kinds, epoch_i32, ts_kind, ts_base,
+        ts_arr,
+    ), prov
 
+
+def _finish_wire(
+    spec, tape, total, cols, kinds, epoch_i32, ts_kind, ts_base, ts_arr
+) -> WireTape:
     single = len(spec.stream_codes) == 1
     stream_const = next(iter(spec.stream_codes.values())) if single else -1
     narrow_stream_ok = max(spec.stream_codes.values(), default=0) <= 127
-    wire = WireTape(
+    return WireTape(
         ts=ts_arr,
         n_valid=np.asarray([total], dtype=np.int32),
         stream=(
@@ -365,7 +399,6 @@ def build_wire_tape(
         ts_base=ts_base,
         cap=tape.capacity,
     )
-    return wire, prov
 
 
 def _merged_stream_values(
@@ -409,11 +442,14 @@ def build_tape(
     batches: Sequence[EventBatch],
     epoch_ms: int,
     capacity: int | None = None,
+    want_prov: bool = True,
 ) -> Tuple[Tape, np.ndarray]:
     """Merge per-stream batches into one padded, ts-sorted host tape.
 
     Returns (tape, order) where order[i] = (batch_idx, row_idx) provenance of
     merged position i (sinks use it to reach host-only payloads).
+    ``want_prov=False`` returns None in its place (two full-width array
+    fills skipped — for callers that never consult it).
     Arrays are numpy; the jitted step's donate/commit moves them to device.
     """
     total = sum(len(b) for b in batches)
@@ -423,7 +459,9 @@ def build_tape(
 
     ts_all = np.empty(total, dtype=np.int64)
     stream_all = np.empty(total, dtype=np.int32)
-    prov = np.empty((total, 2), dtype=np.int64)
+    prov = (
+        np.empty((total, 2), dtype=np.int64) if want_prov else None
+    )
     offset = 0
     for bi, b in enumerate(batches):
         n = len(b)
@@ -431,8 +469,9 @@ def build_tape(
             raise KeyError(f"stream {b.stream_id!r} not in tape spec")
         ts_all[offset : offset + n] = b.timestamps
         stream_all[offset : offset + n] = spec.stream_codes[b.stream_id]
-        prov[offset : offset + n, 0] = bi
-        prov[offset : offset + n, 1] = np.arange(n)
+        if prov is not None:
+            prov[offset : offset + n, 0] = bi
+            prov[offset : offset + n, 1] = np.arange(n)
         offset += n
 
     # per-stream batches arrive time-sorted (the reorder buffer sorts on
@@ -449,7 +488,8 @@ def build_tape(
         order = np.argsort(ts_all, kind="stable")
         ts_sorted = ts_all[order]
         stream_sorted = stream_all[order]
-        prov = prov[order]
+        if prov is not None:
+            prov = prov[order]
 
     ts = np.zeros(cap, dtype=np.int32)
     ts[:total] = (ts_sorted - epoch_ms).astype(np.int32)

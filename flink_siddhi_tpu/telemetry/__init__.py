@@ -39,6 +39,11 @@ TOP_LEVEL_STAGES = (
     "reorder",
     "route",
     "tape_build",
+    # fused streaming dispatch: the stacked segment's single async
+    # H2D device_put, issued while the previous segment computes
+    # (host-side enqueue time only — the transfer itself overlaps
+    # the device)
+    "stage.h2d_overlap",
     "dispatch",
     "backpressure_wait",
     "drain",

@@ -45,6 +45,8 @@ import numpy as np
 from .histogram import LatencyHistogram
 from .registry import MetricsRegistry
 
+_EMPTY_TS = np.zeros(0, dtype=np.int64)
+
 
 class TraceSampler:
     """Deterministic 1-in-N per-event trace sampler for one Job.
@@ -120,16 +122,31 @@ class TraceSampler:
                 )
 
     # -- intermediate legs -------------------------------------------------
-    def mark(self, timestamps, leg: str) -> None:
+    def sampled_subset(self, timestamps) -> np.ndarray:
+        """The sampled events of a batch, as a (usually tiny) array —
+        compute the vectorized sampling mask ONCE per batch and feed
+        the result to several :meth:`mark` calls (the fused streaming
+        path marks each batch at staging AND at dispatch; recomputing
+        a full-batch mod per mark was measurable on the hot loop)."""
+        if not self.enabled:
+            return _EMPTY_TS
+        ts = np.asarray(timestamps)
+        if ts.size == 0:
+            return _EMPTY_TS
+        return ts[self._mask(ts)]
+
+    def mark(self, timestamps, leg: str, presampled: bool = False) -> None:
         """Record (now - ingest) for sampled pending events into the
         ``trace.ingest_to_<leg>`` histogram. The stamp stays pending —
-        only a row emission completes a trace."""
+        only a row emission completes a trace. ``presampled=True``:
+        ``timestamps`` is already a :meth:`sampled_subset` result (the
+        sampling mask is skipped)."""
         if not self.enabled:
             return
         ts = np.asarray(timestamps)
         if ts.size == 0:
             return
-        hits = ts[self._mask(ts)]
+        hits = ts if presampled else ts[self._mask(ts)]
         if hits.size == 0:
             return
         now = time.monotonic()

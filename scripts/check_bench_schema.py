@@ -41,6 +41,22 @@ contract:
   the deadline drain scheduler's staleness leg must actually have
   recorded (a scheduler that never ran produces no line).
 
+Schema v5 (fused-dispatch round, bench.py ``schema_version: 5``) adds
+the dispatch-bound contract:
+
+* every mode section carries a ``fusion`` block: ``segment_len``
+  (int >= 1), ``dispatches_per_1k_batches`` (finite positive — fused
+  segments must actually collapse dispatches), and
+  ``h2d_overlap_frac`` (finite, in [0, 1] — what fraction of
+  streaming H2D tape uploads overlapped in-flight compute);
+* the top level carries ``streaming_vs_resident_ratio`` (finite,
+  recomputed from the two modes' events_per_sec so a declared ratio
+  cannot lie) and a ``fusion_target`` block whose ``verdict`` must be
+  ``met``: streaming-mode ev/s >= 80% of resident-mode ev/s on the
+  same lane. ``missed`` is rejected loudly — the fused dispatch
+  exists to close exactly this gap. Pre-v5 files (BENCH_r01..r05)
+  are exempt.
+
 Optional ``recovery`` block (``bench.py --fault``, any version): when
 present it must carry a finite positive measured ``recovery_time_ms``,
 at least one injected crash, ``stale_tmp_swept: true``, and EXACT
@@ -294,6 +310,136 @@ def validate_v4(doc, errors: List[str], where: str) -> None:
                 )
 
 
+def validate_fusion(fu, errors: List[str], where: str) -> None:
+    """One mode's ``fusion`` block (schema v5)."""
+    where = f"{where}:fusion"
+    if not isinstance(fu, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    if fu.get("telemetry") == "off":
+        return  # BENCH_TELEMETRY=0 A/B run: no counters to report
+    sl = fu.get("segment_len")
+    if not isinstance(sl, int) or isinstance(sl, bool) or sl < 1:
+        errors.append(f"{where}: segment_len missing/non-int/<1 ({sl!r})")
+    dp = fu.get("dispatches_per_1k_batches")
+    if not _finite(dp) or dp <= 0:
+        errors.append(
+            f"{where}: dispatches_per_1k_batches missing/non-positive "
+            f"({dp!r})"
+        )
+    elif isinstance(sl, int) and sl > 1 and dp >= 1000.0:
+        # >= not >: the likeliest regression (the fused gate silently
+        # never engaging) reports EXACTLY 1000 via the per-batch
+        # fallback counters
+        errors.append(
+            f"{where}: dispatches_per_1k_batches {dp} >= 1000 with "
+            f"segment_len {sl} — fused dispatch did not collapse "
+            "anything"
+        )
+    # a declared collapse ratio cannot lie: re-derive it from the
+    # dispatch/batch counts shipped in the same block (the same rule
+    # validate_v5 applies to streaming_vs_resident_ratio)
+    d, b = fu.get("dispatches"), fu.get("batches")
+    if (
+        _finite(dp)
+        and isinstance(d, int)
+        and isinstance(b, int)
+        and b > 0
+    ):
+        recomputed = 1000.0 * d / b
+        if abs(recomputed - dp) > 0.02 * max(recomputed, 1.0):
+            errors.append(
+                f"{where}: declared dispatches_per_1k_batches {dp} != "
+                f"recomputed {recomputed:.1f} from dispatches={d} / "
+                f"batches={b}"
+            )
+    of = fu.get("h2d_overlap_frac")
+    if not _finite(of) or of < 0.0 or of > 1.0:
+        errors.append(
+            f"{where}: h2d_overlap_frac missing/outside [0, 1] ({of!r})"
+        )
+
+
+def validate_v5(doc, errors: List[str], where: str) -> None:
+    """The fused-dispatch contract (on top of v3/v4)."""
+    modes = doc.get("modes")
+    if isinstance(modes, dict):
+        for name in V3_MODES:
+            sec = modes.get(name)
+            if not isinstance(sec, dict):
+                continue  # v3 already reported the missing mode
+            fu = sec.get("fusion")
+            if fu is None:
+                errors.append(
+                    f"{where}:modes.{name}: fusion block missing "
+                    "(schema v5 requires per-mode dispatch accounting)"
+                )
+            else:
+                validate_fusion(fu, errors, f"{where}:modes.{name}")
+    ratio = doc.get("streaming_vs_resident_ratio")
+    if not _finite(ratio):
+        errors.append(
+            f"{where}: streaming_vs_resident_ratio missing/non-finite"
+        )
+    else:
+        # the ratio's basis is the PAIRED ABBA measurement in
+        # fusion_target: per round, resident/streaming/streaming/
+        # resident, scored (res1+res2)/(str1+str2) so linear host
+        # drift cancels; the published ratio is the BEST round (the
+        # repo's min-of-runs convention). Re-derive it from the
+        # published run times so a declared ratio cannot lie.
+        tgt0 = doc.get("fusion_target") or {}
+        res_r = tgt0.get("resident_runs_s")
+        str_r = tgt0.get("streaming_runs_s")
+        recomputed = None
+        if (
+            isinstance(res_r, list)
+            and isinstance(str_r, list)
+            and res_r
+            and len(res_r) == len(str_r)
+            and len(res_r) % 2 == 0
+            and all(_finite(v) and v > 0 for v in res_r + str_r)
+        ):
+            recomputed = max(
+                (res_r[2 * i] + res_r[2 * i + 1])
+                / (str_r[2 * i] + str_r[2 * i + 1])
+                for i in range(len(res_r) // 2)
+            )
+        else:
+            res = tgt0.get("resident_ev_s")
+            st = tgt0.get("streaming_ev_s")
+            if _finite(res) and _finite(st) and res > 0:
+                recomputed = st / res
+        if recomputed is not None and (
+            abs(recomputed - ratio) > 0.02 * max(recomputed, 1e-9)
+        ):
+            errors.append(
+                f"{where}: declared streaming_vs_resident_ratio "
+                f"{ratio} != recomputed {recomputed:.3f} from "
+                "fusion_target's paired round times"
+            )
+    tgt = doc.get("fusion_target")
+    if not isinstance(tgt, dict):
+        errors.append(
+            f"{where}: fusion_target block missing (schema v5 requires "
+            "the streaming-vs-resident verdict)"
+        )
+    else:
+        if tgt.get("verdict") != "met":
+            errors.append(
+                f"{where}: fusion_target.verdict "
+                f"{tgt.get('verdict')!r} — streaming ev/s "
+                f"{tgt.get('streaming_ev_s')} is below 80% of resident "
+                f"{tgt.get('resident_ev_s')}: still dispatch-bound"
+            )
+        else:
+            INFO.append(
+                f"{where}: fusion target met — streaming/resident "
+                f"ratio {tgt.get('ratio')} at segment_len "
+                f"{tgt.get('segment_len')}"
+            )
+
+
 def validate_recovery(rec, errors: List[str], where: str) -> None:
     """The ``--fault`` recovery block (optional in every version; when
     present it must carry real measurements and the exactly-once
@@ -385,6 +531,8 @@ def validate_doc(
         validate_v3(doc, errors, where)
     if version >= 4:
         validate_v4(doc, errors, where)
+    if version >= 5:
+        validate_v5(doc, errors, where)
     if "recovery" in doc:
         validate_recovery(doc["recovery"], errors, where)
 

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Dispatch-leg microprofiler: enqueue vs device-wall, per dispatch.
+
+The round-6 measurement named ``dispatch`` the limiting leg (the
+jitted device step: 2.289 s of the 2.56 s data-path window). This
+profiler decomposes that leg WITHOUT a full bench run, for both the
+historical per-batch loop and the fused scan-of-microbatches segment
+dispatch (``Job.fused_segment_len``):
+
+* ``enqueue``     — host time to hand one dispatch to the device
+                    (segment stack + H2D device_put + jit-call
+                    return), from the runtime's own
+                    ``dispatch.enqueue`` histogram;
+* ``device_wall`` — residual device execution measured by the driver
+                    blocking on the dispatch ticket right after the
+                    cycle that enqueued it (the serialization is the
+                    point: the leg is isolated, pipelining is off).
+
+A warm pass runs the whole stream first (every XLA executable —
+fused scan shapes, padded trailing partial, drain packs — compiles
+there), then engine state resets rerun-style and the measured pass
+reports per-leg p50/p99 plus dispatches-per-1k-batches, so a
+per-batch vs fused A/B is two invocations of this script.
+
+Env knobs:
+  PROF_CONFIG    bench config (default: headline; bench._config_cql)
+  PROF_EVENTS    total events staged (default 2_000_000)
+  PROF_BATCH     micro-batch size (default 65_536)
+  PROF_SEGMENT   fused segment length (default 8; 0/1 = per-batch)
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/profile_dispatch.py
+    JAX_PLATFORMS=cpu PROF_SEGMENT=0 python scripts/profile_dispatch.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache")
+)
+
+
+def main() -> int:
+    config = os.environ.get("PROF_CONFIG", "headline")
+    n_events = int(os.environ.get("PROF_EVENTS", 2_000_000))
+    batch = int(os.environ.get("PROF_BATCH", 65_536))
+    seg = int(os.environ.get("PROF_SEGMENT", 8))
+
+    import jax
+
+    import bench
+    from flink_siddhi_tpu.telemetry import (
+        LatencyHistogram,
+        MetricsRegistry,
+    )
+    from flink_siddhi_tpu.telemetry.tracing import TraceSampler
+
+    job = bench.build_job(config, n_events, batch)
+    job.fused_segment_len = seg if seg > 1 else None
+    job.drain_interval_ms = None  # isolate dispatch: no interval drains
+    batches = bench.drain_source_batches(job)
+
+    # warm pass: compiles land here, off the profile
+    bench.re_source(job, batches)
+    while not job.finished:
+        job.run_cycle()
+    job.flush()
+    job.reset_engine_state()  # the shared rerun recipe
+    job.telemetry = MetricsRegistry()
+    job.tracer = TraceSampler(job.telemetry, sample_every=0)
+
+    # measured pass: block on every dispatch ticket as it appears —
+    # device_wall is what the pipeline normally hides
+    wall = LatencyHistogram()
+    rts = list(job._plans.values())
+    bench.re_source(job, batches)
+    t0 = time.perf_counter()
+    while not job.finished:
+        job.run_cycle()
+        for rt in rts:
+            while rt.tickets:
+                t1 = time.perf_counter()
+                jax.block_until_ready(rt.tickets.popleft())
+                wall.record_seconds(time.perf_counter() - t1)
+    job.flush()
+    elapsed = time.perf_counter() - t0
+
+    snap = job.telemetry.snapshot()
+    counters = snap["counters"]
+    enq = job.telemetry.histogram("dispatch.enqueue")
+    dispatches = enq.count
+    n_batches = counters.get("fusion.batches", 0) or dispatches
+    out = {
+        "config": config,
+        "events": n_events,
+        "batch": batch,
+        "segment_len": seg,
+        "mode": "fused" if seg > 1 else "per-batch",
+        "dispatches": dispatches,
+        "batches": n_batches,
+        "dispatches_per_1k_batches": round(
+            1000.0 * dispatches / max(n_batches, 1), 1
+        ),
+        "h2d_uploads": counters.get("fusion.h2d_uploads", 0),
+        "h2d_overlapped": counters.get("fusion.h2d_overlapped", 0),
+        "elapsed_s": round(elapsed, 3),
+        "legs": {},
+    }
+    for name, h in (("enqueue", enq), ("device_wall", wall)):
+        if not h.count:
+            continue
+        out["legs"][name] = {
+            "count": h.count,
+            "p50_ms": h.percentile_ms(50),
+            "p99_ms": h.percentile_ms(99),
+        }
+    print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -53,6 +53,51 @@ if not _TPU_SMOKE:
             del _xb._backend_factories[_name]
 
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _pallas_fallback_gate():
+    """Tier-1 gate: on this CPU lane Pallas is unavailable and every
+    kernel entry point must fall back CLEANLY — warmup() returns False
+    without raising, no kernel reports active, and the fallback paths
+    compute. If this gate fires, the XLA fallbacks the whole suite
+    runs on are broken, so no test may silently skip past it (the
+    kernel-vs-fallback equivalence itself runs under the Pallas
+    interpreter in tests/test_pallas_ops.py subprocesses — those
+    tests FAIL, never skip, when the kernels regress)."""
+    if _TPU_SMOKE:
+        yield
+        return
+    import numpy as _np
+
+    import jax.numpy as _jnp
+    from flink_siddhi_tpu.compiler import pallas_ops
+
+    assert not pallas_ops.available(), (
+        "CPU lane unexpectedly reports Pallas available"
+    )
+    assert pallas_ops.warmup() is False, (
+        "warmup() must fall back cleanly when Pallas is unavailable"
+    )
+    assert pallas_ops.chain_kernel_active() is False
+    assert pallas_ops.fold_kernel_active() is False
+    assert pallas_ops.chain_advance(
+        (0, 1), ((), ()), False, {}, _jnp.zeros(5, _jnp.int32),
+        _jnp.zeros(4, bool), _jnp.zeros(4, _jnp.int32),
+        _jnp.zeros(4, _jnp.int32), _jnp.zeros(4, _jnp.int32), 0,
+    ) is None
+    assert pallas_ops.unique_window_fold(
+        _jnp.zeros(128, bool), _jnp.zeros(128, _jnp.int32), [],
+        _jnp.zeros(128, bool), [], (("count", -1),),
+    ) is None
+    out = pallas_ops.multi_reverse_cummin(
+        [_jnp.asarray(_np.array([4, 2, 9, 1], _np.int32))]
+    )
+    assert _np.asarray(out[0]).tolist() == [1, 1, 1, 1]
+    yield
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 

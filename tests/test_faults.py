@@ -159,6 +159,49 @@ def test_supervised_kafka_exactly_once_under_fault_schedule(
         broker.close()
 
 
+def test_supervised_fused_exactly_once_kill_mid_segment(tmp_path):
+    """Fused-dispatch fault path (the scan-of-microbatches streaming
+    step): segments of 4 micro-batches, process deaths scheduled at
+    pulls that land MID-segment (between a tape being staged and its
+    segment dispatching), plus one kill mid-checkpoint. Checkpoints
+    land only at segment boundaries — save_checkpoint force-dispatches
+    the staged partial segment before capturing state — so restore
+    comes from the last segment-boundary checkpoint and the committed
+    rows match the unfaulted oracle with 0 duplicate / 0 lost rows."""
+    import collections
+
+    n = 96
+    schema = _schema()
+    # checkpoint cadence (3) deliberately misaligned with the segment
+    # length (4): every checkpoint interrupts a filling segment, and
+    # pulls 3/7 kill with tapes staged but undispatched
+    crash = CrashPlan(at_pulls=(3, 7), at_checkpoints=(2,))
+
+    def factory():
+        src = ListSource(
+            "S", schema, _record_tuples(n), ts_field="timestamp",
+        )
+        plan = compile_plan(CQL, {"S": schema})
+        job = Job([plan], [src], batch_size=16, retain_results=False)
+        job.fused_segment_len = 4
+        return wrap_job(job, crash)
+
+    ckpt = str(tmp_path / "ckpt")
+    sup = Supervisor(
+        factory, ckpt,
+        checkpoint_every_cycles=3, keep_checkpoints=3,
+        max_restarts=10, restart_window_s=3600.0,
+    )
+    sup.run()
+
+    assert crash.crashes == 3  # both pull kills + the checkpoint kill
+    oracle = collections.Counter(_oracle_rows(n))
+    committed = collections.Counter(sup.results_with_ts("out"))
+    assert sum((committed - oracle).values()) == 0, "duplicate rows"
+    assert sum((oracle - committed).values()) == 0, "lost rows"
+    assert glob.glob(f"{ckpt}.tmp.*") == []
+
+
 @pytest.mark.parametrize("seed", [1, 17])
 def test_kafka_source_survives_wire_faults_unsupervised(seed):
     """Retry/backoff alone (no supervisor): a plain job over a flaky

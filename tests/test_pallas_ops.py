@@ -67,3 +67,104 @@ def test_fallback_matches():
         assert np.asarray(out[0]).tolist() == [1, 1, 1, 1]
     finally:
         os.environ.pop("FST_NO_PALLAS", None)
+
+
+# -- chain-advance + unique-fold kernels (fused-dispatch round) ------------
+# warmup() probes BOTH against numpy oracles (a probe mismatch disables
+# the kernel and the asserts below fail loudly — never skip); the e2e
+# snippet then runs real queries twice in ONE process, kernels on
+# (interpreter) vs forced fallback (FST_NO_PALLAS reread dynamically),
+# and pins row-identical output.
+
+_KERNELS_SNIPPET = """
+import os
+import numpy as np
+from flink_siddhi_tpu.compiler import pallas_ops
+assert pallas_ops.available()
+pallas_ops.warmup()
+assert pallas_ops.chain_kernel_active(), "chain-advance probe failed"
+assert pallas_ops.fold_kernel_active(), "unique-fold probe failed"
+
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.sources import BatchSource
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+schema = StreamSchema([
+    ("id", AttributeType.INT), ("price", AttributeType.DOUBLE),
+    ("timestamp", AttributeType.LONG),
+])
+rng = np.random.default_rng(11)
+n, batch = 6000, 512
+ids = rng.integers(0, 5, n).astype(np.int32)
+prices = np.round(rng.random(n) * 50, 2)
+ts = (1000 + 3 * np.arange(n)).astype(np.int64)
+
+def batches():
+    return iter([
+        EventBatch("S", schema,
+                   {"id": ids[s:s + batch], "price": prices[s:s + batch],
+                    "timestamp": ts[s:s + batch]}, ts[s:s + batch])
+        for s in range(0, n, batch)
+    ])
+
+CQLS = {
+    "chain": "from every s1 = S[id == 1] -> s2 = S[id == 2] -> "
+             "s3 = S[id == 3] within 5 sec select s1.timestamp as t1, "
+             "s3.timestamp as t3, s3.price as p insert into o",
+    "guard": "from every s1 = S[id == 1] -> not S[id == 4] -> "
+             "s2 = S[id == 2] select s1.timestamp as t1, "
+             "s2.timestamp as t2 insert into o",
+    "unique": "from S#window.unique(id) select id, sum(price) as t, "
+              "count() as c, min(price) as mn, max(price) as mx "
+              "insert into o",
+}
+
+def run_all():
+    out = {}
+    for name, cql in CQLS.items():
+        plan = compile_plan(cql, {"S": schema})
+        job = Job([plan], [BatchSource("S", schema, batches())],
+                  batch_size=batch, time_mode="processing")
+        job.run()
+        out[name] = job.results_with_ts("o")
+    return out
+
+with_kernels = run_all()
+os.environ["FST_NO_PALLAS"] = "1"  # read dynamically: forces fallback
+without = run_all()
+for name in CQLS:
+    a, b = with_kernels[name], without[name]
+    assert len(a) == len(b) and a, (name, len(a), len(b))
+    assert a == b, f"{name}: kernel rows != fallback rows"
+print("OK", {k: len(v) for k, v in with_kernels.items()})
+"""
+
+
+def test_chain_and_fold_kernels_interpret_equivalence():
+    """The kernel-vs-fallback contract for the fused-dispatch round's
+    two new kernels, end to end: warmup oracle probes must PASS (not
+    fall back) under the interpreter, and full queries produce
+    row-identical output with kernels on vs forced off. Runs in a
+    clean subprocess (the pallas import path registers TPU lowering
+    rules this suite's conftest strips)."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        FST_PALLAS_INTERPRET="1",
+        PYTHONPATH=_REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    )
+    env.pop("XLA_FLAGS", None)
+    env.pop("FST_NO_PALLAS", None)
+    r = subprocess.run(
+        [sys.executable, "-c", _KERNELS_SNIPPET],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0 and "OK" in r.stdout, (
+        r.stdout + "\n" + r.stderr
+    )
