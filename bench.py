@@ -613,18 +613,21 @@ def _fusion_block(job, segment_len):
     batches = counters.get("fusion.batches", 0)
     if not batches:
         # per-batch loop (segment_len 1): every staged batch was its
-        # own dispatch — read the dispatch span count
+        # own dispatch — read the dispatch span count. Honest zeros
+        # (fstlint FST103 class, same fix as _resident_fusion_block):
+        # a loop that dispatched NOTHING must fail the gate's dp>0
+        # check, not masquerade as one per-batch dispatch
         dispatches = batches = int(
             snap["stages"].get("dispatch", {}).get("count", 0)
-        ) or 1
+        )
     uploads = counters.get("fusion.h2d_uploads", 0)
     overlapped = counters.get("fusion.h2d_overlapped", 0)
     return {
         "segment_len": segment_len,
         "dispatches": dispatches,
         "batches": batches,
-        "dispatches_per_1k_batches": round(
-            1000.0 * dispatches / max(batches, 1), 1
+        "dispatches_per_1k_batches": (
+            round(1000.0 * dispatches / batches, 1) if batches else 0.0
         ),
         "h2d_overlap_frac": (
             round(overlapped / uploads, 4) if uploads else 0.0
@@ -659,10 +662,14 @@ def _resident_fusion_block(job, rep):
             dispatches = n
     return {
         "segment_len": seg_len,
-        "dispatches": dispatches or 1,
-        "batches": batches or 1,
-        "dispatches_per_1k_batches": round(
-            1000.0 * (dispatches or 1) / max(batches, 1), 1
+        # honest zeros (fstlint FST103): a replay that staged nothing
+        # must FAIL the gate's dp>0 check, not masquerade as one
+        # per-batch dispatch — `or 1` turned "nothing ran" into a
+        # passing fusion block
+        "dispatches": dispatches,
+        "batches": batches,
+        "dispatches_per_1k_batches": (
+            round(1000.0 * dispatches / batches, 1) if batches else 0.0
         ),
         "h2d_overlap_frac": 0.0,
         "prestaged": True,

@@ -1,0 +1,36 @@
+"""Repo-specific static correctness tooling.
+
+Two halves, both wired into the tier-1 lane
+(scripts/run_static_analysis.py; docs/static_analysis.md):
+
+* ``fstlint`` — an AST linter whose rule set is drawn from JAX hazard
+  classes this repo has actually shipped: donation-after-use (the PR 7
+  checkpoint-restore aliasing bug), host-sync-in-hot-path, falsy-zero
+  ``or``-defaults (the PR 8 ``drain_interval_ms=0`` bug), tracer leaks,
+  and unbounded retraces (the sticky wire-kind widening class).
+* ``plancheck`` — a compiled-plan verifier validating invariants of the
+  artifact stack the compiler emits (shape/dtype agreement, slot-NFA
+  table well-formedness, padded-stack inertness, donation safety)
+  before it reaches the device; run at ``compile()`` time behind
+  ``EngineConfig.verify_plans`` / ``FST_VERIFY_PLANS=1`` and standalone
+  over the query zoo in CI.
+
+The analog of the reference's parse-time plan validation
+(SiddhiManager.validateExecutionPlan — every SiddhiQL plan is checked
+before it ever runs): our compiler emits artifact stacks into a donated,
+jitted, scanned hot loop, so the machine-checkable invariants live here.
+"""
+
+from .findings import Finding, RULES
+from .fstlint import lint_paths, main
+from .plancheck import PlanCheckError, PlanIssue, verify_plan
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "lint_paths",
+    "main",
+    "PlanCheckError",
+    "PlanIssue",
+    "verify_plan",
+]

@@ -1,0 +1,174 @@
+"""Baseline suppressions: incremental adoption without silent rot.
+
+``baseline.toml`` holds ``[[suppress]]`` entries; every entry MUST carry
+a non-empty ``reason`` string (a suppression nobody can justify is a
+finding), and entries that no longer match any finding are STALE and
+fail the run — the baseline only ever shrinks or explains itself.
+
+Python 3.10 has no ``tomllib``, and the container must not grow deps, so
+this parses the narrow TOML subset the file uses: ``[[suppress]]``
+array-of-tables headers and ``key = "string" | int`` pairs. Unknown
+syntax is a loud error, never a silently-dropped suppression.
+Deliberately NOT a try-import of ``tomllib`` on 3.11+: the gate must
+parse the same baseline identically on every interpreter — a file
+accepted on 3.11 (single-quoted strings, inline tables) but rejected
+on the 3.10 CI lane would make suppression behavior
+environment-dependent.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .findings import Finding
+
+_KV = re.compile(r"^([A-Za-z_][\w-]*)\s*=\s*(.+?)\s*$")
+
+
+class BaselineError(Exception):
+    pass
+
+
+@dataclass
+class Suppression:
+    rule: str
+    path: str
+    reason: str
+    line: Optional[int] = None  # None = whole file for this rule
+    src_line: int = 0  # where in baseline.toml the entry lives
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            f.rule == self.rule
+            and f.path == self.path
+            and (self.line is None or f.line == self.line)
+        )
+
+
+def _parse_value(raw: str, where: str):
+    if raw.startswith('"') and raw.endswith('"') and len(raw) >= 2:
+        body = raw[1:-1]
+        if '"' in body.replace('\\"', ""):
+            raise BaselineError(f"{where}: unsupported string escape")
+        return body.replace('\\"', '"')
+    if re.fullmatch(r"-?\d+", raw):
+        return int(raw)
+    raise BaselineError(
+        f"{where}: unsupported TOML value {raw!r} (string or int only)"
+    )
+
+
+def _strip_comment(line: str) -> str:
+    """Cut at the first '#' OUTSIDE a double-quoted string — issue/PR
+    references ('tracked in #42') are the most natural suppression
+    reasons and must survive."""
+    in_str = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        elif c == "#" and not in_str:
+            return line[:i]
+        i += 1
+    return line
+
+
+def parse_baseline(text: str, src: str = "baseline.toml") -> List[Suppression]:
+    entries: List[Dict] = []
+    cur: Optional[Dict] = None
+    for i, line in enumerate(text.splitlines(), start=1):
+        stripped = _strip_comment(line).strip()
+        if not stripped:
+            continue
+        if stripped == "[[suppress]]":
+            cur = {"src_line": i}
+            entries.append(cur)
+            continue
+        m = _KV.match(stripped)
+        if m is None or cur is None:
+            raise BaselineError(
+                f"{src}:{i}: unsupported baseline syntax {stripped!r}"
+            )
+        cur[m.group(1)] = _parse_value(m.group(2), f"{src}:{i}")
+    out: List[Suppression] = []
+    for e in entries:
+        where = f"{src}:{e['src_line']}"
+        for key in ("rule", "path", "reason"):
+            if not isinstance(e.get(key), str) or not e.get(key, "").strip():
+                raise BaselineError(
+                    f"{where}: suppression needs a non-empty {key!r} "
+                    "string (an unexplained suppression is a finding)"
+                )
+        line = e.get("line")
+        if line is not None and not isinstance(line, int):
+            raise BaselineError(f"{where}: 'line' must be an integer")
+        unknown = set(e) - {"rule", "path", "reason", "line", "src_line"}
+        if unknown:
+            raise BaselineError(
+                f"{where}: unknown keys {sorted(unknown)}"
+            )
+        out.append(
+            Suppression(
+                rule=e["rule"],
+                path=e["path"],
+                reason=e["reason"],
+                line=line,
+                src_line=e["src_line"],
+            )
+        )
+    return out
+
+
+def apply_baseline(
+    findings: Sequence[Finding], suppressions: Sequence[Suppression]
+) -> Tuple[List[Finding], List[Suppression]]:
+    """-> (unsuppressed findings, stale suppressions)."""
+    used = [False] * len(suppressions)
+    open_findings: List[Finding] = []
+    for f in findings:
+        hit = False
+        for i, s in enumerate(suppressions):
+            if s.matches(f):
+                used[i] = True
+                hit = True
+        if not hit:
+            open_findings.append(f)
+    stale = [s for s, u in zip(suppressions, used) if not u]
+    return open_findings, stale
+
+
+def render_baseline(
+    findings: Sequence[Finding],
+    prior: Sequence[Suppression] = (),
+) -> str:
+    """Emit a baseline file for the given findings. Entries matching a
+    ``prior`` suppression KEEP its human-written reason (regenerating
+    a live baseline must never discard reviewed justifications); new
+    findings get REVIEWME, which the linter rejects until a human
+    writes the why."""
+
+    def _reason(f: Finding) -> str:
+        for s in prior:
+            if s.matches(f):
+                return s.reason
+        return f"REVIEWME: {f.message[:60]}"
+
+    def _quote(s: str) -> str:
+        return '"' + s.replace('"', '\\"') + '"'
+
+    parts = [
+        "# fstlint baseline — every entry must carry a reason; stale\n"
+        "# entries (matching no current finding) fail the run.\n"
+    ]
+    for f in sorted(findings):
+        parts.append(
+            "[[suppress]]\n"
+            f'rule = "{f.rule}"\n'
+            f'path = "{f.path}"\n'
+            f"line = {f.line}\n"
+            f"reason = {_quote(_reason(f))}\n"
+        )
+    return "\n".join(parts)

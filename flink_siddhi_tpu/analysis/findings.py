@@ -1,0 +1,57 @@
+"""Lint finding type + the rule registry.
+
+Every rule carries the historical bug that motivated it — a rule that
+cannot name the shipped bug it would have caught does not get added
+(docs/static_analysis.md holds the long-form reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str  # repo-root-relative, forward slashes
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+# rule id -> one-line hazard description (the linter's --list output;
+# docs/static_analysis.md is the full reference with the motivating bugs)
+RULES = {
+    "FST101": (
+        "donation-after-use: a binding (or an alias captured before the "
+        "call) is read after being passed through a donate_argnums / "
+        "device_put(donate=...) call site — the donated buffer may "
+        "already be freed or reused (the PR 7 checkpoint-restore "
+        "aliasing bug class)"
+    ),
+    "FST102": (
+        "host-sync-in-hot-path: .item() / float() / int() / bool() / "
+        "np.asarray() or branching on a device-derived value inside an "
+        "annotated hot-path function — each one is a blocking device "
+        "sync (or a TracerBoolConversionError) in the per-batch loop"
+    ),
+    "FST103": (
+        "falsy-zero-default: `x or default` where x is a numeric config "
+        "that legitimately accepts 0 — zero silently becomes the "
+        "default (the PR 8 drain_interval_ms=0 bug class)"
+    ),
+    "FST104": (
+        "tracer-leak: a value derived inside a jit/scan body is stored "
+        "onto self or a module global — the tracer escapes the trace "
+        "and poisons later calls"
+    ),
+    "FST105": (
+        "unbounded-retrace: a jitted call site whose argument shapes "
+        "derive from a dynamic size not routed through a named "
+        "shape-bucketing helper (bucket_size) — every distinct size "
+        "compiles a fresh executable (the sticky wire-kind widening "
+        "retrace-explosion class)"
+    ),
+}

@@ -1,0 +1,205 @@
+"""fstlint: the JAX-hazard linter CLI.
+
+Usage::
+
+    fstlint [paths...] [--baseline FILE | --no-baseline]
+            [--write-baseline FILE] [--list-rules] [--json]
+
+With no paths, lints the default surface: the ``flink_siddhi_tpu``
+package, ``bench.py``, and ``scripts/``. Exit codes: 0 clean; 1
+unsuppressed findings; 2 baseline problems (stale entries, missing or
+REVIEWME reasons, parse errors). ``scripts/run_static_analysis.py``
+runs this (plus plancheck over the query zoo) in the tier-1 lane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Iterable, List, Optional, Sequence
+
+from .baseline import (
+    BaselineError,
+    apply_baseline,
+    parse_baseline,
+    render_baseline,
+)
+from .findings import RULES, Finding
+from .rules import lint_module
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(_PKG_DIR)
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.toml"
+)
+
+# generated / vendored files the default sweep skips
+_SKIP_PARTS = {".jax_cache", "__pycache__", ".git", "analysis_fixtures"}
+
+
+def _default_targets() -> List[str]:
+    out = [_PKG_DIR]
+    for extra in ("bench.py", "scripts"):
+        p = os.path.join(REPO_ROOT, extra)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def _iter_py_files(paths: Iterable[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d not in _SKIP_PARTS]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    yield os.path.join(root, f)
+
+
+def _rel(path: str, root: str) -> str:
+    try:
+        rel = os.path.relpath(os.path.abspath(path), root)
+    except ValueError:
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def lint_paths(
+    paths: Optional[Sequence[str]] = None, root: Optional[str] = None
+) -> List[Finding]:
+    """Lint files/directories; findings carry root-relative paths."""
+    root = root or REPO_ROOT
+    targets = list(paths) if paths else _default_targets()
+    findings: List[Finding] = []
+    for fp in _iter_py_files(targets):
+        with open(fp, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            findings.extend(lint_module(source, _rel(fp, root)))
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    _rel(fp, root),
+                    e.lineno or 0,
+                    "FST000",
+                    f"file does not parse: {e.msg}",
+                )
+            )
+    return sorted(findings)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fstlint", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs (default: repo)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help="emit a baseline covering current findings (reasons left "
+        "REVIEWME; the linter rejects them until a human explains)",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in sorted(RULES.items()):
+            print(f"{rid}  {desc}")
+        return 0
+
+    findings = lint_paths(args.paths or None)
+
+    if args.write_baseline:
+        # regenerating a live baseline must PRESERVE human-written
+        # reasons for findings that still exist; only new findings get
+        # REVIEWME placeholders
+        prior = []
+        if os.path.exists(args.write_baseline):
+            try:
+                with open(
+                    args.write_baseline, "r", encoding="utf-8"
+                ) as fh:
+                    prior = parse_baseline(
+                        fh.read(), _rel(args.write_baseline, REPO_ROOT)
+                    )
+            except BaselineError as e:
+                print(f"warning: existing baseline unparseable ({e}); "
+                      "reasons cannot be carried over")
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            fh.write(render_baseline(findings, prior))
+        print(
+            f"wrote {len(findings)} suppression(s) to "
+            f"{args.write_baseline}; fill in any REVIEWME reasons"
+        )
+        return 0
+
+    stale = []
+    baseline_errors: List[str] = []
+    if not args.no_baseline and os.path.exists(args.baseline):
+        try:
+            with open(args.baseline, "r", encoding="utf-8") as fh:
+                sups = parse_baseline(
+                    fh.read(), _rel(args.baseline, REPO_ROOT)
+                )
+        except BaselineError as e:
+            baseline_errors.append(str(e))
+            sups = []
+        for s in sups:
+            if s.reason.strip().upper().startswith("REVIEWME"):
+                baseline_errors.append(
+                    f"{_rel(args.baseline, REPO_ROOT)}:{s.src_line}: "
+                    f"suppression for {s.rule} at {s.path} still has a "
+                    "REVIEWME reason — explain it or fix the finding"
+                )
+        findings, stale = apply_baseline(findings, sups)
+        if args.paths:
+            # a targeted run lints a SUBSET of the surface, so a
+            # suppression for an out-of-scope file matching nothing is
+            # expected, not stale — staleness is only meaningful (and
+            # only enforced) against the full default sweep
+            stale = []
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.__dict__ for f in findings],
+                    "stale_suppressions": [
+                        {"rule": s.rule, "path": s.path, "line": s.line}
+                        for s in stale
+                    ],
+                    "baseline_errors": baseline_errors,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        for s in stale:
+            print(
+                f"{_rel(args.baseline, REPO_ROOT)}:{s.src_line}: STALE "
+                f"suppression ({s.rule} at {s.path}"
+                + (f":{s.line}" if s.line is not None else "")
+                + ") matches no current finding — delete it"
+            )
+        for msg in baseline_errors:
+            print(msg)
+        if findings:
+            print(f"{len(findings)} finding(s)")
+
+    if stale or baseline_errors:
+        return 2
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

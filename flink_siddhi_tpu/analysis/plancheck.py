@@ -1,0 +1,560 @@
+"""Compiled-plan verifier: invariants of the artifact stack.
+
+The compiler emits artifact stacks into a donated, jitted, scanned hot
+loop; this pass validates the stack BEFORE it reaches the device — the
+analog of the reference validating every SiddhiQL plan at parse time
+(SiddhiManager.validateExecutionPlan) instead of letting a miscompile
+surface as garbage rows three subsystems later.
+
+Rule families (each issue carries its rule id):
+
+* PLC1xx — shape/dtype agreement: every artifact's traced emissions
+  (``jax.eval_shape`` of the whole plan step, zero device allocation)
+  must agree with its declared OutputSchema; chained consumers must see
+  exactly the fields their producer declares.
+* PLC2xx — slot-NFA well-formedness: positive/guard element tables
+  partition the declared elements (no unreachable slots), absence
+  guards sit only on declared ``not`` elements, quantifier and
+  next-match table bounds hold.
+* PLC3xx — padded multi-query stacks: all members share one chain
+  signature, slot bookkeeping is consistent, and padding/free rows are
+  actually row-inert (``deep=True`` drives an all-invalid tape through
+  the concrete step and requires zero emissions).
+* PLC4xx — donation safety: the step signature returns states/acc with
+  the same treedef+shape+dtype it consumes, so ``donate_argnums``
+  reuses buffers instead of silently copying (or aliasing stale ones —
+  the PR 7 restore bug class).
+
+Wired into ``compile_plan`` behind ``EngineConfig.verify_plans`` /
+``FST_VERIFY_PLANS=1`` (on in tests, off on bench hot paths) and run
+standalone over the query zoo by scripts/run_static_analysis.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class PlanIssue:
+    rule: str
+    where: str  # "plan_id/artifact" locator
+    message: str
+
+    def render(self) -> str:
+        return f"{self.rule} [{self.where}] {self.message}"
+
+
+class PlanCheckError(Exception):
+    def __init__(self, issues: Sequence[PlanIssue]):
+        self.issues = list(issues)
+        super().__init__(
+            "compiled-plan verification failed:\n"
+            + "\n".join(f"  {i.render()}" for i in self.issues)
+        )
+
+
+def _zero_tape(plan, capacity: int = 64):
+    """A concrete, all-invalid tape matching the plan's TapeSpec — the
+    inert input: a correct plan emits NOTHING for it."""
+    from ..runtime.tape import build_tape
+
+    tape, _prov = build_tape(plan.spec, [], 0, capacity=capacity)
+    return tape
+
+
+def _shape_env(plan, capacity: int = 64):
+    """(state_shapes, acc_shapes, tape) via eval_shape — no allocation."""
+    import jax
+
+    states = jax.eval_shape(plan.init_state)
+    acc = jax.eval_shape(plan.init_acc)
+    return states, acc, _zero_tape(plan, capacity)
+
+
+# --------------------------------------------------------------------------
+# PLC1xx: schema agreement
+# --------------------------------------------------------------------------
+
+
+def _check_outputs(plan, issues: List[PlanIssue], capacity: int) -> None:
+    import jax
+
+    states, _acc, tape = _shape_env(plan, capacity)
+    try:
+        _new_states, outputs = jax.eval_shape(
+            lambda s, t: plan.step(s, t), states, tape
+        )
+    except Exception as e:  # noqa: BLE001 — any trace failure is a reject
+        issues.append(
+            PlanIssue(
+                "PLC100",
+                plan.plan_id,
+                f"plan step does not trace: {type(e).__name__}: {e}",
+            )
+        )
+        return
+    for a in plan.artifacts:
+        out = outputs.get(a.name)
+        where = f"{plan.plan_id}/{a.name}"
+        mode = getattr(a, "output_mode", "buffered")
+        if out is None:
+            issues.append(
+                PlanIssue("PLC101", where, "artifact produced no output")
+            )
+            continue
+        if mode == "packed":
+            n, block = out[0], out[1]
+            rows = int(block.shape[0])
+            want = getattr(a, "acc_rows", None)
+            if want is None:
+                sch = getattr(a, "output_schema", None)
+                want = 1 + len(sch.fields) if sch is not None else rows
+            if rows != want:
+                issues.append(
+                    PlanIssue(
+                        "PLC102",
+                        where,
+                        f"packed block has {rows} rows, artifact "
+                        f"declares {want} (ts/qid/column row layout "
+                        "drifted — the accumulator would misroute "
+                        "columns)",
+                    )
+                )
+            if np.dtype(block.dtype) != np.dtype(np.int32):
+                issues.append(
+                    PlanIssue(
+                        "PLC103",
+                        where,
+                        f"packed block dtype {block.dtype} != int32 "
+                        "(the accumulator stores bitcast int32 rows)",
+                    )
+                )
+            if np.dtype(n.dtype).kind not in "iu":
+                issues.append(
+                    PlanIssue(
+                        "PLC103", where, f"packed count dtype {n.dtype}"
+                    )
+                )
+            continue
+        # buffered: (n, ts, cols); aligned: (mask, ts, cols)
+        head, ts, cols = out[0], out[1], list(out[2])
+        sch = getattr(a, "output_schema", None)
+        if sch is None:
+            continue
+        if len(cols) != len(sch.fields):
+            issues.append(
+                PlanIssue(
+                    "PLC104",
+                    where,
+                    f"emits {len(cols)} columns, schema declares "
+                    f"{len(sch.fields)}",
+                )
+            )
+            continue
+        for f, col in zip(sch.fields, cols):
+            want_dt = np.dtype(f.atype.device_dtype)
+            got_dt = np.dtype(col.dtype)
+            if got_dt != want_dt:
+                issues.append(
+                    PlanIssue(
+                        "PLC105",
+                        where,
+                        f"field {f.name!r} declared {want_dt} but the "
+                        f"step emits {got_dt} — decode would bitcast "
+                        "garbage",
+                    )
+                )
+        if mode == "aligned" and np.dtype(head.dtype) != np.dtype(bool):
+            issues.append(
+                PlanIssue(
+                    "PLC103",
+                    where,
+                    f"aligned mask dtype {head.dtype} != bool",
+                )
+            )
+
+    # chained consumers: the synthetic tape is built from ci.fields —
+    # they must BE the producer's current declared fields
+    for consumer, ci in plan.chained.items():
+        where = f"{plan.plan_id}/{consumer}"
+        try:
+            producer = plan.artifact(ci.producer)
+        except KeyError:
+            issues.append(
+                PlanIssue(
+                    "PLC106",
+                    where,
+                    f"chained producer {ci.producer!r} missing",
+                )
+            )
+            continue
+        declared = tuple(producer.output_schema.fields)
+        if tuple(ci.fields) != declared:
+            issues.append(
+                PlanIssue(
+                    "PLC106",
+                    where,
+                    "chained input field list drifted from producer "
+                    f"schema ({[f.name for f in ci.fields]} vs "
+                    f"{[f.name for f in declared]})",
+                )
+            )
+        if ci.mode != producer.output_mode:
+            issues.append(
+                PlanIssue(
+                    "PLC106",
+                    where,
+                    f"chained mode {ci.mode!r} != producer mode "
+                    f"{producer.output_mode!r}",
+                )
+            )
+
+
+# --------------------------------------------------------------------------
+# PLC2xx: slot-NFA well-formedness
+# --------------------------------------------------------------------------
+
+
+def _check_nfa_tables(plan, issues: List[PlanIssue]) -> None:
+    for a in plan.artifacts:
+        hook = getattr(a, "nfa_check_info", None)
+        if hook is None:
+            continue
+        for info in hook():
+            _check_one_nfa(plan.plan_id, info, issues)
+
+
+def _check_one_nfa(plan_id: str, info: Dict, issues: List[PlanIssue]) -> None:
+    where = f"{plan_id}/{info['name']}"
+    n = info["n_elements"]
+    positive: Tuple[int, ...] = tuple(info["positive"])
+    guards: Tuple[Tuple[int, ...], ...] = tuple(
+        tuple(g) for g in info["guards"]
+    )
+    negated: Tuple[bool, ...] = tuple(info["negated"])
+    t_guard: Optional[int] = info.get("t_guard")
+
+    def bad(rule: str, msg: str) -> None:
+        issues.append(PlanIssue(rule, where, msg))
+
+    if n <= 0:
+        bad("PLC201", "pattern has no elements")
+        return
+    if len(negated) != n:
+        bad("PLC201", f"negated flags length {len(negated)} != {n}")
+        return
+    if not positive:
+        bad("PLC201", "no positive elements (nothing can ever match)")
+    if list(positive) != sorted(set(positive)) or any(
+        not (0 <= p < n) for p in positive
+    ):
+        bad(
+            "PLC202",
+            f"positive element table {positive} is not a strictly "
+            f"increasing subset of range({n})",
+        )
+        return
+    if any(negated[p] for p in positive):
+        bad("PLC202", "a negated element appears in the positive table")
+    if len(guards) != len(positive):
+        bad(
+            "PLC203",
+            f"guard table has {len(guards)} rows for "
+            f"{len(positive)} positive steps",
+        )
+        return
+    for k, gs in enumerate(guards):
+        lo = positive[k - 1] if k else -1
+        hi = positive[k]
+        for g in gs:
+            if not (0 <= g < n):
+                bad("PLC203", f"guard index {g} out of range({n})")
+            elif not negated[g]:
+                bad(
+                    "PLC203",
+                    f"absence guard on element {g}, which is not a "
+                    "declared 'not' element",
+                )
+            elif not (lo < g < hi):
+                bad(
+                    "PLC203",
+                    f"guard {g} of step {k} lies outside its inter-"
+                    f"positive window ({lo}, {hi}) — the next-match "
+                    "scan would consult the wrong table row",
+                )
+    if t_guard is not None:
+        if not (0 <= t_guard < n) or not negated[t_guard]:
+            bad(
+                "PLC204",
+                f"terminal timed-absence guard {t_guard} is not a "
+                "declared 'not' element",
+            )
+        elif t_guard != n - 1:
+            bad(
+                "PLC204",
+                f"terminal timed-absence guard {t_guard} is not the "
+                "last element",
+            )
+    covered = set(positive) | {g for gs in guards for g in gs}
+    if t_guard is not None:
+        covered.add(t_guard)
+    unreachable = sorted(set(range(n)) - covered)
+    if unreachable:
+        bad(
+            "PLC205",
+            f"elements {unreachable} are unreachable (neither positive "
+            "steps nor absence guards — dead slots in the transition "
+            "table)",
+        )
+    quant = info.get("quantifiers")
+    if quant is not None:
+        for i, (mn, mx) in enumerate(quant):
+            if mn < 0 or (mx >= 0 and mx < mn):
+                bad(
+                    "PLC206",
+                    f"element {i} quantifier <{mn}:{mx}> is malformed",
+                )
+    prefix = info.get("min_prefix")
+    if prefix is not None:
+        arr = np.asarray(prefix)
+        if arr.ndim != 1 or np.any(np.diff(arr) < 0) or arr[0] != 0:
+            bad(
+                "PLC207",
+                "min-count prefix table is not a monotone cumulative "
+                "sum starting at 0 (optional-skip bounds would read "
+                "out of range)",
+            )
+    groups = info.get("groups")
+    if groups is not None:
+        seen: List[int] = []
+        for mem in groups:
+            seen.extend(mem)
+        if sorted(seen) != list(range(n)):
+            bad(
+                "PLC208",
+                f"group table {groups} does not partition "
+                f"range({n}) — transition steps would skip or "
+                "double-count elements",
+            )
+    bits = info.get("mask_bits")
+    if bits is not None and bits > 31:
+        bad(
+            "PLC209",
+            f"match bitmask needs {bits} bits > 31 (wire word bound)",
+        )
+
+
+# --------------------------------------------------------------------------
+# PLC3xx: padded multi-query stacks
+# --------------------------------------------------------------------------
+
+
+def _check_stacks(plan, issues: List[PlanIssue]) -> None:
+    from ..compiler.nfa import (
+        DynamicChainGroup,
+        StackedChainArtifact,
+        _ChainCfg,
+    )
+
+    for a in plan.artifacts:
+        where = f"{plan.plan_id}/{a.name}"
+        if isinstance(a, StackedChainArtifact):
+            if not a.members:
+                issues.append(
+                    PlanIssue("PLC301", where, "stacked group is empty")
+                )
+                continue
+            cfg0 = _ChainCfg.of(a.members[0].spec)
+            for m in a.members[1:]:
+                if _ChainCfg.of(m.spec) != cfg0:
+                    issues.append(
+                        PlanIssue(
+                            "PLC301",
+                            where,
+                            f"member {m.name!r} does not share the "
+                            "stack's chain signature — the vmapped "
+                            "advance would run the wrong transition "
+                            "table for it",
+                        )
+                    )
+            pools = {m.pool for m in a.members}
+            if len(pools) != 1:
+                issues.append(
+                    PlanIssue(
+                        "PLC302",
+                        where,
+                        f"members disagree on partial pool size {pools}",
+                    )
+                )
+            if a.out_cap_factor < 1:
+                issues.append(
+                    PlanIssue(
+                        "PLC302",
+                        where,
+                        f"out_cap_factor {a.out_cap_factor} < 1",
+                    )
+                )
+        if isinstance(a, DynamicChainGroup):
+            if len(a.members) != a.capacity:
+                issues.append(
+                    PlanIssue(
+                        "PLC303",
+                        where,
+                        f"dynamic group member table has "
+                        f"{len(a.members)} slots, capacity declares "
+                        f"{a.capacity}",
+                    )
+                )
+
+
+def _check_inert(plan, issues: List[PlanIssue], capacity: int) -> None:
+    """deep check: drive an all-invalid tape through the CONCRETE step;
+    a correct plan (including every padded / free slot row) emits
+    nothing. This is what 'inert padding rows actually row-inert'
+    means operationally — a stale or garbage pad row shows up as a
+    phantom emission here, not as garbage in a tenant's sink."""
+    states = plan.init_state()
+    tape = _zero_tape(plan, capacity)
+    try:
+        _new_states, outputs = plan.step(states, tape)
+    except Exception as e:  # noqa: BLE001
+        issues.append(
+            PlanIssue(
+                "PLC310",
+                plan.plan_id,
+                f"concrete step failed on the inert tape: "
+                f"{type(e).__name__}: {e}",
+            )
+        )
+        return
+    for a in plan.artifacts:
+        out = outputs.get(a.name)
+        if out is None:
+            continue
+        where = f"{plan.plan_id}/{a.name}"
+        mode = getattr(a, "output_mode", "buffered")
+        if mode == "aligned":
+            n = int(np.asarray(out[0]).sum())
+        else:
+            n = int(np.asarray(out[0]))
+        if n != 0:
+            issues.append(
+                PlanIssue(
+                    "PLC311",
+                    where,
+                    f"{n} emission(s) from an all-invalid tape — "
+                    "padding/free rows are not row-inert",
+                )
+            )
+
+
+# --------------------------------------------------------------------------
+# PLC4xx: donation safety
+# --------------------------------------------------------------------------
+
+
+def _leaf_paths(tree) -> Dict[str, Tuple]:
+    import jax
+
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        out[key] = (tuple(leaf.shape), np.dtype(leaf.dtype))
+    return out
+
+
+def _check_donation(plan, issues: List[PlanIssue], capacity: int) -> None:
+    import jax
+
+    states, acc, tape = _shape_env(plan, capacity)
+    try:
+        new_states, new_acc = jax.eval_shape(
+            lambda s, a, t: plan.step_acc(s, a, t), states, acc, tape
+        )
+    except Exception as e:  # noqa: BLE001
+        issues.append(
+            PlanIssue(
+                "PLC400",
+                plan.plan_id,
+                f"step_acc does not trace: {type(e).__name__}: {e}",
+            )
+        )
+        return
+    for label, before, after in (
+        ("states", states, new_states),
+        ("acc", acc, new_acc),
+    ):
+        b, a_ = _leaf_paths(before), _leaf_paths(after)
+        for key in sorted(set(b) | set(a_)):
+            if key not in a_:
+                issues.append(
+                    PlanIssue(
+                        "PLC401",
+                        f"{plan.plan_id}/{label}{key}",
+                        "leaf consumed but not produced — donation "
+                        "frees a buffer the next step still needs",
+                    )
+                )
+            elif key not in b:
+                issues.append(
+                    PlanIssue(
+                        "PLC401",
+                        f"{plan.plan_id}/{label}{key}",
+                        "leaf produced but never consumed — the step "
+                        "signature is not a fixed point, so the jitted "
+                        "scan carry cannot type",
+                    )
+                )
+            elif b[key] != a_[key]:
+                issues.append(
+                    PlanIssue(
+                        "PLC402",
+                        f"{plan.plan_id}/{label}{key}",
+                        f"shape/dtype changes across the step "
+                        f"({b[key]} -> {a_[key]}) — donate_argnums "
+                        "cannot reuse the buffer and every batch pays "
+                        "a hidden copy (or the scan carry fails)",
+                    )
+                )
+
+
+# --------------------------------------------------------------------------
+# entry
+# --------------------------------------------------------------------------
+
+
+def verify_plan(
+    plan,
+    deep: bool = False,
+    trace: bool = True,
+    capacity: int = 64,
+    raise_on_error: bool = True,
+) -> List[PlanIssue]:
+    """Validate one CompiledPlan, in up to three tiers.
+
+    * static (always): NFA transition tables + padded-stack
+      bookkeeping — pure python, microseconds. This is the tier the
+      test lane's ``FST_VERIFY_PLANS=1`` applies to EVERY compile.
+    * ``trace=True``: ``jax.eval_shape`` of the whole step — schema
+      agreement + donation safety. One extra trace, no compile, no
+      device allocation (~0.1s/plan; ``config.verify_plans`` /
+      ``FST_VERIFY_PLANS=full``).
+    * ``deep=True``: concrete inert-tape execution (eager, the
+      expensive one) proving padding/free rows are row-inert — the
+      zoo/CI pass.
+    """
+    issues: List[PlanIssue] = []
+    _check_nfa_tables(plan, issues)
+    _check_stacks(plan, issues)
+    if trace:
+        _check_outputs(plan, issues, capacity)
+        _check_donation(plan, issues, capacity)
+    if deep:
+        _check_inert(plan, issues, capacity)
+    if issues and raise_on_error:
+        raise PlanCheckError(issues)
+    return issues

@@ -1,0 +1,839 @@
+"""The fstlint rule set: AST analyses over one module at a time.
+
+Every rule is deliberately function-scoped and conservative — a linter
+for a donated, jitted hot loop earns its keep by having near-zero false
+positives on clean code, with ``baseline.toml`` absorbing the justified
+remainder. The dataflow here is a simple line-ordered forward pass
+(aliases and taint propagate through assignments in statement order);
+loop-carried flows are intentionally out of scope.
+
+Hot-path annotation: a ``# fst:hotpath`` comment on (or directly above)
+a ``def`` line marks the function for FST102. An optional
+``device=a,b,c`` names the parameters that carry device values; without
+it every parameter is treated as device-derived. Nested functions
+inherit the annotation (scan bodies run under the same trace).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+
+# names whose terminal token marks a numeric config where 0 is a
+# legitimate value (the FST103 trigger set)
+_NUMERIC_SUFFIXES = {
+    "ms", "msec", "sec", "secs", "len", "size", "count", "cap",
+    "capacity", "budget", "interval", "slots", "bytes", "factor",
+    "width", "depth", "cycles", "timeout", "dispatches", "batches",
+    "events", "rows", "offset", "p99",
+}
+
+# attribute reads that yield static host metadata, not device values
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "capacity", "size", "at"}
+
+# the named shape-bucketing helpers FST105 accepts
+BUCKET_HELPERS = {"bucket_size", "_compact_width", "emit_block_width"}
+
+_HOTPATH_MARK = re.compile(r"#\s*fst:hotpath(?:\s+device=([\w,]+))?")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a simple Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _tail(node: ast.AST) -> Optional[str]:
+    """Terminal identifier of a Name/Attribute chain."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _func_key(call: ast.Call) -> Optional[str]:
+    return _tail(call.func)
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """jax.jit(...) / jit(...) — also matches through functools.partial
+    only when jit is the partial's own first argument."""
+    key = _func_key(call)
+    if key == "jit":
+        return True
+    if key == "partial" and call.args:
+        return _tail(call.args[0]) == "jit"
+    return False
+
+
+def _donated_positions(call: ast.Call) -> Tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for el in v.elts:
+                    if isinstance(el, ast.Constant) and isinstance(
+                        el.value, int
+                    ):
+                        out.append(el.value)
+                return tuple(out)
+    return ()
+
+
+@dataclass
+class ModuleInfo:
+    """Module-level prepass: which names are jit-compiled callables and
+    which of their positional arguments are donated."""
+
+    # terminal binding name -> donated positional indices (may be empty:
+    # jitted but donation-free — FST105 still cares about those sites)
+    jitted: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    # local function names passed to jax.jit / lax.scan (traced bodies)
+    traced_funcs: Set[str] = field(default_factory=set)
+
+
+def scan_module(tree: ast.Module) -> ModuleInfo:
+    info = ModuleInfo()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_call(node):
+            # donation positions are recorded where the jitted callable
+            # gets a NAME (Assign / kwarg / decorator branches below);
+            # an unbound jit(...) has no call sites to check
+            if node.args:
+                fn_name = _tail(node.args[0])
+                if fn_name:
+                    info.traced_funcs.add(fn_name)
+        if isinstance(node, ast.Call):
+            fk = _func_key(node)
+            if fk == "scan" and node.args:
+                body = _tail(node.args[0])
+                if body:
+                    info.traced_funcs.add(body)
+        # name = jax.jit(...)  |  SomeCall(kwarg=jax.jit(...))
+        if isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Call) and _is_jit_call(
+                node.value
+            ):
+                for t in node.targets:
+                    tn = _tail(t)
+                    if tn:
+                        info.jitted[tn] = _donated_positions(node.value)
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if (
+                    kw.arg
+                    and isinstance(kw.value, ast.Call)
+                    and _is_jit_call(kw.value)
+                ):
+                    info.jitted[kw.arg] = _donated_positions(kw.value)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                if _tail(d) == "jit" or (
+                    isinstance(dec, ast.Call) and _is_jit_call(dec)
+                ):
+                    info.traced_funcs.add(node.name)
+                    info.jitted.setdefault(
+                        node.name,
+                        _donated_positions(dec)
+                        if isinstance(dec, ast.Call)
+                        else (),
+                    )
+    return info
+
+
+# --------------------------------------------------------------------------
+# hotpath annotations
+# --------------------------------------------------------------------------
+
+
+def hotpath_functions(
+    source_lines: Sequence[str], tree: ast.Module
+) -> Dict[int, Optional[Set[str]]]:
+    """def-lineno -> device param-name set (None = all params)."""
+    out: Dict[int, Optional[Set[str]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for ln in (node.lineno, node.lineno - 1):
+            if 1 <= ln <= len(source_lines):
+                m = _HOTPATH_MARK.search(source_lines[ln - 1])
+                if m:
+                    names = m.group(1)
+                    out[node.lineno] = (
+                        set(names.split(",")) if names else None
+                    )
+                    break
+    return out
+
+
+# --------------------------------------------------------------------------
+# linear statement walk (shared by the dataflow rules)
+# --------------------------------------------------------------------------
+
+
+def _flat_statements(body: Iterable[ast.stmt]) -> Iterable[ast.stmt]:
+    """Statements in source order, descending into control flow but NOT
+    into nested function/class definitions (those get their own scope)."""
+    for st in body:
+        yield st
+        for attr in ("body", "orelse", "finalbody", "handlers"):
+            sub = getattr(st, attr, None)
+            if not sub:
+                continue
+            if attr == "handlers":
+                for h in sub:
+                    yield from _flat_statements(h.body)
+            elif not isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                yield from _flat_statements(sub)
+
+
+def _stmt_exprs(st: ast.stmt) -> List[ast.AST]:
+    """Every expression node attached to THIS statement (header exprs
+    of compound statements included, nested block bodies excluded —
+    those are visited as their own statements, preserving order)."""
+    out: List[ast.AST] = []
+    for f_name, value in ast.iter_fields(st):
+        if f_name in ("body", "orelse", "finalbody", "handlers"):
+            continue
+        if isinstance(value, ast.AST):
+            out.extend(ast.walk(value))
+        elif isinstance(value, list):
+            for v in value:
+                if isinstance(v, ast.AST):
+                    out.extend(ast.walk(v))
+    return out
+
+
+def _assign_targets(st: ast.stmt) -> List[ast.AST]:
+    if isinstance(st, ast.Assign):
+        out: List[ast.AST] = []
+        for t in st.targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                out.extend(t.elts)
+            else:
+                out.append(t)
+        return out
+    if isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+        return [st.target]
+    if isinstance(st, ast.For):
+        t = st.target
+        return list(t.elts) if isinstance(t, (ast.Tuple, ast.List)) else [t]
+    if isinstance(st, ast.With):
+        return [
+            it.optional_vars
+            for it in st.items
+            if it.optional_vars is not None
+        ]
+    return []
+
+
+def _value_exprs(st: ast.stmt) -> List[ast.AST]:
+    if isinstance(st, (ast.Assign, ast.AugAssign, ast.Return)):
+        return [st.value] if st.value is not None else []
+    if isinstance(st, ast.AnnAssign):
+        return [st.value] if st.value is not None else []
+    if isinstance(st, ast.Expr):
+        return [st.value]
+    return []
+
+
+# --------------------------------------------------------------------------
+# FST101: donation-after-use
+# --------------------------------------------------------------------------
+
+
+class _DonationScope:
+    """Line-ordered per-scope analysis. Tracks alias groups (x = y) and
+    donation events (calls through donate_argnums-jitted bindings or
+    device_put(donate=...)); flags later reads of donated bindings."""
+
+    def __init__(self, info: ModuleInfo, path: str):
+        self.info = info
+        self.path = path
+        self.aliases: Dict[str, Set[str]] = {}
+        # dotted key -> (line, col) of the donating call: reads are
+        # flagged when they sit AFTER that position in source order,
+        # which tracks left-to-right evaluation within one statement
+        # (`out = step(x) + x.sum()` flags; `x.sum() + step(x)` not)
+        self.donated: Dict[str, Tuple[int, int]] = {}
+        self.findings: List[Finding] = []
+
+    def _group(self, key: str) -> Set[str]:
+        return self.aliases.setdefault(key, {key})
+
+    def _alias(self, a: str, b: str) -> None:
+        group = self._group(a) | self._group(b)
+        for k in group:
+            self.aliases[k] = group
+
+    def _donate(self, key: str, pos: Tuple[int, int]) -> None:
+        for k in self._group(key):
+            self.donated.setdefault(k, pos)
+
+    def _rebind(self, key: str) -> None:
+        self.donated.pop(key, None)
+        group = self.aliases.pop(key, None)
+        if group is not None:
+            group.discard(key)
+
+    def _check_reads(self, st: ast.stmt, skip: Set[int]) -> None:
+        for node in _stmt_exprs(st):
+            if id(node) in skip:
+                continue
+            if not isinstance(node, (ast.Name, ast.Attribute)):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            key = _dotted(node)
+            if key is None:
+                continue
+            dpos = self.donated.get(key)
+            if dpos is not None and (
+                (node.lineno, node.col_offset) > dpos
+            ):
+                self.findings.append(
+                    Finding(
+                        self.path,
+                        node.lineno,
+                        "FST101",
+                        f"read of {key!r} after its buffer was donated "
+                        f"at line {dpos[0]} (donated device memory may "
+                        "already be freed or reused)",
+                    )
+                )
+                # one report per binding per scope keeps output usable
+                for k in self._group(key):
+                    self.donated.pop(k, None)
+
+    def _donating_calls(self, st: ast.stmt) -> Set[int]:
+        """Process donation call sites; returns node ids of donated arg
+        expressions (their own read is the donation, not a use-after)."""
+        skip: Set[int] = set()
+        for node in _stmt_exprs(st):
+            if not isinstance(node, ast.Call):
+                continue
+            fk = _func_key(node)
+            positions: Tuple[int, ...] = ()
+            if fk == "device_put":
+                if any(
+                    kw.arg == "donate"
+                    and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False
+                    )
+                    for kw in node.keywords
+                ):
+                    positions = (0,)
+            elif fk in self.info.jitted:
+                positions = self.info.jitted[fk]
+            donated_any = False
+            for pos in positions:
+                if pos < len(node.args):
+                    arg = node.args[pos]
+                    key = _dotted(arg)
+                    if key is not None:
+                        donated_any = True
+                        self._donate(
+                            key, (node.lineno, node.col_offset)
+                        )
+            if donated_any:
+                # the WHOLE call expression is exempt: every argument
+                # is evaluated (and captured) before the donation
+                # happens at call time, so reads inside the call are
+                # never use-after-free — while a read later in the
+                # SAME statement (`step(x) + x.sum()`) is
+                for sub in ast.walk(node):
+                    skip.add(id(sub))
+        return skip
+
+    def run(self, body: Iterable[ast.stmt]) -> List[Finding]:
+        self._run_block(body)
+        return self.findings
+
+    def _run_block(self, body: Iterable[ast.stmt]) -> None:
+        for st in body:
+            if isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            # reads first (against donations from PRIOR statements) —
+            # the donating call's own subtree is exempted below
+            skip = self._donating_calls(st)
+            self._check_reads(st, skip)
+            # then rebinds: targets of this statement are fresh values
+            for t in _assign_targets(st):
+                key = _dotted(t)
+                if key is not None:
+                    self._rebind(key)
+            # alias capture LAST: `x = y` links x to y's group
+            if isinstance(st, ast.Assign) and len(st.targets) == 1:
+                src = _dotted(st.value)
+                dst = _dotted(st.targets[0])
+                if src is not None and dst is not None:
+                    self._alias(dst, src)
+            if isinstance(st, ast.If):
+                # mutually exclusive branches: a donation in one must
+                # not flag a read in the other; donations from either
+                # branch persist afterwards (conservative union)
+                before = dict(self.donated)
+                self._run_block(st.body)
+                after_body = dict(self.donated)
+                self.donated = dict(before)
+                self._run_block(st.orelse)
+                for k, v in after_body.items():
+                    self.donated.setdefault(k, v)
+            elif isinstance(st, (ast.For, ast.While)):
+                self._run_block(st.body)
+                self._run_block(st.orelse)
+            elif isinstance(st, ast.With):
+                self._run_block(st.body)
+            elif isinstance(st, ast.Try):
+                self._run_block(st.body)
+                for h in st.handlers:
+                    self._run_block(h.body)
+                self._run_block(st.orelse)
+                self._run_block(st.finalbody)
+
+
+def rule_donation_after_use(
+    tree: ast.Module, info: ModuleInfo, path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    findings.extend(_DonationScope(info, path).run(tree.body))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_DonationScope(info, path).run(node.body))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# taint propagation (shared by FST102 / FST104)
+# --------------------------------------------------------------------------
+
+
+def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    """Does the expression read a tainted binding? `.shape`-style static
+    metadata reads break the chain; host-materializing calls
+    (np.asarray / device_get / .item / float / int / bool) yield host
+    values so their results do not re-taint."""
+    if isinstance(expr, ast.Attribute) and expr.attr in _STATIC_ATTRS:
+        return False
+    if isinstance(expr, ast.Call):
+        fk = _func_key(expr)
+        if fk in {
+            "asarray", "array", "item", "device_get", "float", "int",
+            "bool", "len",
+        }:
+            return False
+    if isinstance(expr, ast.Compare) and all(
+        isinstance(op, (ast.In, ast.NotIn, ast.Is, ast.IsNot))
+        for op in expr.ops
+    ):
+        # membership on a pytree dict / identity vs None are host
+        # operations even when an operand holds device values
+        return False
+    key = _dotted(expr)
+    if key is not None:
+        root = key.split(".", 1)[0]
+        return key in tainted or root in tainted
+    for child in ast.iter_child_nodes(expr):
+        if _expr_tainted(child, tainted):
+            return True
+    return False
+
+
+def _propagate(st: ast.stmt, tainted: Set[str]) -> None:
+    vals = _value_exprs(st)
+    # container literals/comprehensions holding device values: their
+    # truthiness is a host len() check, so the binding itself does not
+    # taint (conservative: element reads through it are not tracked)
+    vals = [
+        v
+        for v in vals
+        if not isinstance(
+            v,
+            (ast.List, ast.Tuple, ast.Dict, ast.Set, ast.ListComp,
+             ast.DictComp, ast.SetComp),
+        )
+    ]
+    is_tainted = any(_expr_tainted(v, tainted) for v in vals)
+    if isinstance(st, ast.For) and _expr_tainted(st.iter, tainted):
+        is_tainted = True
+    for t in _assign_targets(st):
+        key = _dotted(t)
+        if key is None:
+            continue
+        if is_tainted:
+            tainted.add(key)
+        else:
+            tainted.discard(key)
+
+
+# --------------------------------------------------------------------------
+# FST102: host sync in hot path
+# --------------------------------------------------------------------------
+
+
+def _hotpath_scope(
+    fn: ast.AST,
+    device: Optional[Set[str]],
+    path: str,
+    findings: List[Finding],
+) -> None:
+    params = {
+        a.arg
+        for a in (
+            list(fn.args.posonlyargs)
+            + list(fn.args.args)
+            + list(fn.args.kwonlyargs)
+        )
+        if a.arg != "self"
+    }
+    # device roots may also name non-param bindings (self.X paths)
+    tainted: Set[str] = set(params) if device is None else set(device)
+
+    def flag(node: ast.AST, what: str) -> None:
+        findings.append(Finding(path, node.lineno, "FST102", what))
+
+    def visit_block(body: Iterable[ast.stmt]) -> None:
+        for st in body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested defs (scan bodies) run under the same trace:
+                # their params are device values
+                _hotpath_scope(st, None, path, findings)
+                continue
+            if isinstance(st, ast.ClassDef):
+                continue
+            for node in _stmt_exprs(st):
+                _check_expr(node)
+            if isinstance(st, (ast.If, ast.While)) and _expr_tainted(
+                st.test, tainted
+            ):
+                flag(
+                    st,
+                    "branching on a device-derived value (implicit "
+                    "bool() forces a blocking device sync, or a "
+                    "TracerBoolConversionError under trace)",
+                )
+            _propagate(st, tainted)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(st, attr, None)
+                if sub:
+                    visit_block(sub)
+            for h in getattr(st, "handlers", ()):
+                visit_block(h.body)
+
+    def _check_expr(node: ast.AST) -> None:
+        if not isinstance(node, ast.Call):
+            return
+        fk = _func_key(node)
+        if fk == "item" and isinstance(node.func, ast.Attribute):
+            flag(
+                node,
+                ".item() in a hot-path function (one blocking "
+                "device->host round trip per call)",
+            )
+        elif fk in {"float", "int", "bool"} and node.args:
+            if _expr_tainted(node.args[0], tainted):
+                flag(
+                    node,
+                    f"{fk}() on a device-derived value in a hot-path "
+                    "function (blocking device sync / tracer error)",
+                )
+        elif fk in {"asarray", "array"}:
+            root = _dotted(node.func)
+            if (
+                root
+                and root.split(".", 1)[0] in {"np", "numpy", "onp"}
+                and node.args
+                and _expr_tainted(node.args[0], tainted)
+            ):
+                flag(
+                    node,
+                    "np.asarray() of a device value in a hot-path "
+                    "function (synchronous device->host transfer)",
+                )
+
+    visit_block(fn.body)
+
+
+def rule_host_sync(
+    tree: ast.Module,
+    source_lines: Sequence[str],
+    path: str,
+) -> List[Finding]:
+    findings: List[Finding] = []
+    marks = hotpath_functions(source_lines, tree)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.lineno in marks
+        ):
+            _hotpath_scope(node, marks[node.lineno], path, findings)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# FST103: falsy-zero or-default
+# --------------------------------------------------------------------------
+
+
+def _numeric_config_name(node: ast.AST) -> Optional[str]:
+    name = _tail(node)
+    if name is None and isinstance(node, ast.Call):
+        # cfg.get("drain_interval_ms") / d.get("x", ...) spellings
+        f = node.func
+        if (
+            isinstance(f, ast.Attribute)
+            and f.attr == "get"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            name = node.args[0].value.rsplit(".", 1)[-1]
+    if name is None:
+        return None
+    if name.rsplit("_", 1)[-1].lower() in _NUMERIC_SUFFIXES:
+        return name
+    return None
+
+
+def rule_falsy_zero_default(tree: ast.Module, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or)):
+            continue
+        default = node.values[-1]
+        if not (
+            isinstance(default, ast.Constant)
+            and isinstance(default.value, (int, float))
+            and not isinstance(default.value, bool)
+            and default.value != 0
+        ):
+            continue
+        for left in node.values[:-1]:
+            name = _numeric_config_name(left)
+            if name is not None:
+                findings.append(
+                    Finding(
+                        path,
+                        node.lineno,
+                        "FST103",
+                        f"`{name} or {default.value!r}`: {name}=0 "
+                        "silently becomes the default — use an explicit "
+                        "`is None` check (0 is a legitimate value for "
+                        "numeric configs; the drain_interval_ms=0 bug "
+                        "class)",
+                    )
+                )
+    return findings
+
+
+# --------------------------------------------------------------------------
+# FST104: tracer leak
+# --------------------------------------------------------------------------
+
+
+def _traced_function_nodes(
+    tree: ast.Module, info: ModuleInfo
+) -> List[ast.AST]:
+    out: List[ast.AST] = []
+
+    def visit(node: ast.AST, inside_traced: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                traced = inside_traced or child.name in info.traced_funcs
+                if traced:
+                    out.append(child)
+                visit(child, traced)
+            else:
+                visit(child, inside_traced)
+
+    visit(tree, False)
+    return out
+
+
+def rule_tracer_leak(
+    tree: ast.Module, info: ModuleInfo, path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in _traced_function_nodes(tree, info):
+        params = {
+            a.arg
+            for a in (
+                list(fn.args.posonlyargs)
+                + list(fn.args.args)
+                + list(fn.args.kwonlyargs)
+            )
+            if a.arg != "self"
+        }
+        tainted: Set[str] = set(params)
+        globals_declared: Set[str] = set()
+        for st in _flat_statements(fn.body):
+            if isinstance(st, ast.Global):
+                globals_declared.update(st.names)
+                continue
+            if isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            vals = _value_exprs(st)
+            val_tainted = any(_expr_tainted(v, tainted) for v in vals)
+            for t in _assign_targets(st):
+                leak = None
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    leak = f"self.{t.attr}"
+                elif isinstance(t, ast.Name) and t.id in globals_declared:
+                    leak = f"global {t.id}"
+                if leak and val_tainted:
+                    findings.append(
+                        Finding(
+                            path,
+                            st.lineno,
+                            "FST104",
+                            f"stores a traced value onto {leak} inside "
+                            f"a jit/scan body ({fn.name!r}) — the "
+                            "tracer escapes the trace and poisons "
+                            "later calls",
+                        )
+                    )
+            _propagate(st, tainted)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# FST105: unbounded retrace
+# --------------------------------------------------------------------------
+
+
+def _dynamic_shape_expr(
+    arg: ast.AST, bucketed: Set[str]
+) -> Optional[str]:
+    """Name of the unbucketed dynamic size feeding this argument's
+    shape, or None when the shape is static/bucketed."""
+    for node in ast.walk(arg):
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            bounds = (
+                [sl.lower, sl.upper] if isinstance(sl, ast.Slice) else []
+            )
+            for b in bounds:
+                bn = _tail(b) if b is not None else None
+                if (
+                    b is not None
+                    and not isinstance(b, ast.Constant)
+                    and bn is not None
+                    and bn not in bucketed
+                ):
+                    return bn
+        if isinstance(node, ast.Call):
+            fk = _func_key(node)
+            if fk in {"zeros", "empty", "full", "ones"} and node.args:
+                shape = node.args[0]
+                dims = (
+                    shape.elts
+                    if isinstance(shape, (ast.Tuple, ast.List))
+                    else [shape]
+                )
+                for d in dims:
+                    dn = _tail(d)
+                    if (
+                        dn is not None
+                        and not isinstance(d, ast.Constant)
+                        and dn not in bucketed
+                    ):
+                        return dn
+    return None
+
+
+def rule_unbounded_retrace(
+    tree: ast.Module, info: ModuleInfo, path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    scopes: List[ast.AST] = [tree] + [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for scope in scopes:
+        bucketed: Set[str] = set()
+        body = scope.body if hasattr(scope, "body") else []
+        for st in _flat_statements(body):
+            if isinstance(
+                st, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ) and scope is not st:
+                continue
+            for node in _stmt_exprs(st):
+                if isinstance(node, ast.Call) and _func_key(
+                    node
+                ) in BUCKET_HELPERS:
+                    for t in _assign_targets(st):
+                        tn = _tail(t)
+                        if tn:
+                            bucketed.add(tn)
+            for node in _stmt_exprs(st):
+                if not isinstance(node, ast.Call):
+                    continue
+                fk = _func_key(node)
+                if fk not in info.jitted:
+                    continue
+                for arg in list(node.args) + [
+                    kw.value for kw in node.keywords
+                ]:
+                    dyn = _dynamic_shape_expr(arg, bucketed)
+                    if dyn is not None:
+                        findings.append(
+                            Finding(
+                                path,
+                                node.lineno,
+                                "FST105",
+                                f"jitted call {fk!r} takes an argument "
+                                f"sized by {dyn!r} without routing "
+                                "through a shape-bucketing helper "
+                                "(bucket_size) — every distinct size "
+                                "compiles a fresh executable",
+                            )
+                        )
+    return findings
+
+
+# --------------------------------------------------------------------------
+# entry
+# --------------------------------------------------------------------------
+
+
+def lint_module(source: str, path: str) -> List[Finding]:
+    tree = ast.parse(source, filename=path)
+    lines = source.splitlines()
+    info = scan_module(tree)
+    findings: List[Finding] = []
+    findings.extend(rule_donation_after_use(tree, info, path))
+    findings.extend(rule_host_sync(tree, lines, path))
+    findings.extend(rule_falsy_zero_default(tree, path))
+    findings.extend(rule_tracer_leak(tree, info, path))
+    findings.extend(rule_unbounded_retrace(tree, info, path))
+    return sorted(set(findings))

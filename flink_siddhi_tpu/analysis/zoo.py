@@ -1,0 +1,140 @@
+"""The plancheck query zoo: one representative plan per artifact class.
+
+scripts/run_static_analysis.py (tier-1) and tests/test_plancheck.py
+both compile and deep-verify every entry — window zoo, patterns
+(chain, slot-NFA quantifiers, absence), sequences, joins, group-by,
+chained multi-query composition, and a stacked multi-query group. A new
+artifact class earns a zoo row in the same PR that adds it, or
+plancheck silently stops covering the compiler's output surface.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+# name -> CQL (all over the S / Trades streams of zoo_schemas())
+PLAN_ZOO: Dict[str, str] = {
+    "filter_select": (
+        "from S[id == 2] select id, name, price insert into out"
+    ),
+    "length_window_agg": (
+        "from S#window.length(16) select sum(price) as total, "
+        "count() as c insert into out"
+    ),
+    "time_window_groupby": (
+        "from S#window.time(3 sec) select id, avg(price) as a "
+        "group by id insert into out"
+    ),
+    "timebatch_window": (
+        "from S#window.timeBatch(2 sec) select sum(price) as s "
+        "insert into out"
+    ),
+    "unique_window": (
+        "from S#window.unique(id) select id, price insert into out"
+    ),
+    "sort_window": (
+        "from S#window.sort(8, price) select id, price insert into out"
+    ),
+    "expired_events": (
+        "from S#window.length(4) select id, price "
+        "insert expired events into out"
+    ),
+    "chain_pattern": (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] -> "
+        "s3 = S[id == 3] "
+        "select s1.price as p1, s3.price as p3 insert into out"
+    ),
+    "chain_pattern_within": (
+        "from every s1 = S[id == 1] -> s2 = S[price > 50.0] "
+        "within 5 sec "
+        "select s1.id as a, s2.price as p insert into out"
+    ),
+    "pattern_absence": (
+        "from every s1 = S[id == 1] -> not S[id == 9] -> "
+        "s2 = S[id == 2] "
+        "select s1.price as p1, s2.price as p2 insert into out"
+    ),
+    "slot_nfa_quantified": (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2]<2:4> -> "
+        "s3 = S[id == 3] "
+        "select s1.price as a, s3.price as b insert into out"
+    ),
+    "sequence": (
+        "from every s1 = S[id == 1], s2 = S[id == 2] "
+        "select s1.price as p1, s2.price as p2 insert into out"
+    ),
+    "window_join": (
+        "from S#window.length(8) as a join Trades#window.length(8) "
+        "as b on a.id == b.vol "
+        "select a.id, b.price insert into out"
+    ),
+    "join_groupby_rewrite": (
+        "from S#window.length(8) as a join Trades#window.length(8) "
+        "as b on a.id == b.vol "
+        "select a.id, sum(b.price) as total group by a.id "
+        "insert into out"
+    ),
+    "chained_composition": (
+        "from S[price > 10.0] select id, price insert into mid; "
+        "from mid#window.length(8) select sum(price) as s "
+        "insert into out"
+    ),
+}
+
+# a stacked multi-query group: structurally-identical chains fold onto
+# one query axis (StackedChainArtifact) — the padded-stack PLC3xx rows
+MULTIQUERY_STACK = "; ".join(
+    f"from every s1 = S[id == {i}] -> s2 = S[id == {i + 1}] "
+    f"select s1.price as p1, s2.price as p2 insert into out{i}"
+    for i in range(6)
+)
+PLAN_ZOO["multiquery_stack6"] = MULTIQUERY_STACK
+
+
+def zoo_schemas():
+    """Fresh schema objects per call (schemas carry shared string
+    tables; zoo entries must not cross-contaminate interning)."""
+    from ..schema.stream_schema import StreamSchema
+    from ..schema.types import AttributeType
+
+    return {
+        "S": StreamSchema(
+            [
+                ("id", AttributeType.INT),
+                ("name", AttributeType.STRING),
+                ("price", AttributeType.DOUBLE),
+                ("timestamp", AttributeType.LONG),
+            ]
+        ),
+        "Trades": StreamSchema(
+            [
+                ("sym", AttributeType.STRING),
+                ("price", AttributeType.DOUBLE),
+                ("vol", AttributeType.INT),
+                ("timestamp", AttributeType.LONG),
+            ]
+        ),
+    }
+
+
+def compile_zoo(
+    verify: bool = False,
+) -> List[Tuple[str, object]]:
+    """Compile every zoo plan; returns [(name, CompiledPlan)].
+    ``verify=False`` so callers decide when plancheck runs (the tier-1
+    conftest exports FST_VERIFY_PLANS=1, which applies regardless)."""
+    from ..compiler.config import EngineConfig
+    from ..compiler.plan import compile_plan
+
+    out = []
+    cfg = EngineConfig(verify_plans=verify)
+    for name, cql in PLAN_ZOO.items():
+        out.append(
+            (
+                name,
+                compile_plan(
+                    cql, zoo_schemas(), plan_id=f"zoo:{name}", config=cfg
+                ),
+            )
+        )
+    return out

@@ -62,6 +62,15 @@ class EngineConfig:
     # fold into a recompile-free dynamic chain group, whose tape carries
     # the raw columns).
     pred_pushdown: bool = False
+    # compiled-plan verification (analysis/plancheck.py): validate the
+    # emitted artifact stack's invariants — schema agreement, slot-NFA
+    # table well-formedness, padded-stack consistency, donation safety
+    # — at compile() time. One extra trace per compile, no device
+    # allocation. Off by default so bench hot paths never pay it; the
+    # test lane turns it on globally via FST_VERIFY_PLANS=1
+    # (tests/conftest.py), and FST_VERIFY_PLANS=0 force-disables even
+    # an explicit True (bench escape hatch).
+    verify_plans: bool = False
 
 
 DEFAULT_CONFIG = EngineConfig()

@@ -199,6 +199,7 @@ class JoinArtifact:
                 st[f"{tag}_c{j}"] = jnp.zeros(C, t.device_dtype)
         return st
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         E = tape.capacity

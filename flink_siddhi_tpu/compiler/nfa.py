@@ -783,6 +783,26 @@ def _from_i32(row, dtype):
     return row.astype(dtype)
 
 
+def _spec_check_info(name: str, spec: "_PatternSpec", **extra) -> Dict:
+    """One pattern's transition tables in the neutral dict form
+    analysis.plancheck consumes — the compiler's side of the plancheck
+    contract (the verifier never reaches into private spec fields)."""
+    cfg = _ChainCfg.of(spec)
+    info = dict(
+        name=name,
+        n_elements=spec.n_elements,
+        positive=cfg.positive,
+        guards=cfg.guards,
+        t_guard=cfg.t_guard,
+        negated=tuple(el.negated for el in spec.elements),
+        quantifiers=tuple(
+            (el.min_count, el.max_count) for el in spec.elements
+        ),
+    )
+    info.update(extra)
+    return info
+
+
 @dataclass(frozen=True)
 class _ChainCfg:
     """Static (hashable) chain-matcher configuration — everything the
@@ -842,6 +862,7 @@ class _ChainCfg:
         )
 
 
+# fst:hotpath device=state,preds,cap_srcs,within_val,ts,valid,tfor_val,batch_max
 def _chain_core(
     cfg: _ChainCfg,
     P: int,
@@ -1133,6 +1154,11 @@ class ChainPatternArtifact:
         """Widest per-cycle emission block (drain-cadence contract)."""
         return tape_capacity + self.pool
 
+    def nfa_check_info(self) -> List[Dict]:
+        """Transition-table descriptors for analysis.plancheck (PLC2xx:
+        positive/guard partition, quantifier bounds, bitmask width)."""
+        return [_spec_check_info(self.name, self.spec)]
+
     def _row_plan(self):
         """Emission block layout. Legacy: [ts, one row per projection].
         Lazy plans compact it: projections that emit the SAME element's
@@ -1276,6 +1302,7 @@ class ChainPatternArtifact:
             )
         return state
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         spec = self.spec
         E = tape.capacity
@@ -1746,6 +1773,12 @@ class StackedChainArtifact:
         ), "stacked members must share a chain signature"
         self._vec_info = self._build_vec_preds()
 
+    def nfa_check_info(self) -> List[Dict]:
+        return [
+            _spec_check_info(f"{self.name}[{m.name}]", m.spec)
+            for m in self.members
+        ]
+
     def _build_vec_preds(self):
         """Per-element conjunct vectors for the broadcast predicate path:
         when every member's element-k filter flattens to the same
@@ -1884,6 +1917,7 @@ class StackedChainArtifact:
     # so chunking caps peak HBM at ~chunk/Q of the naive all-Q vmap
     CHUNK_Q = 8
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         cfg = self._cfg
         E = tape.capacity
@@ -2400,6 +2434,7 @@ class DynamicChainGroup:
         return st
 
     # -- device step ----------------------------------------------------
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         t = self.template
         Qc, P, K = self.capacity, self.pool, t.K
@@ -2798,6 +2833,20 @@ class SlotNFAArtifact:
             [[0], np.cumsum(self._mins)]
         ).astype(np.int32)
 
+    def nfa_check_info(self) -> List[Dict]:
+        """Slot-engine tables for analysis.plancheck: the generic chain
+        descriptors plus the group/min-prefix machinery the scan body
+        indexes by (PLC207/208/209)."""
+        return [
+            _spec_check_info(
+                self.name,
+                self.spec,
+                groups=self._groups,
+                min_prefix=self._min_prefix,
+                mask_bits=self.spec.n_elements + len(self._idx),
+            )
+        ]
+
     def init_state(self) -> Dict:
         S = self.slots
         state = {
@@ -2830,6 +2879,7 @@ class SlotNFAArtifact:
         pre = jnp.asarray(self._min_prefix)
         return (pre[b] - pre[jnp.clip(a + 1, 0, len(self._mins))]) == 0
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         spec = self.spec
         K = spec.n_elements

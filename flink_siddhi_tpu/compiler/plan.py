@@ -113,6 +113,7 @@ class CompiledPlan:
             }
         return states
 
+    # fst:hotpath device=states,tape
     def step(
         self, states: Dict, tape, axis_name: Optional[str] = None
     ) -> Tuple[Dict, Dict]:
@@ -178,6 +179,7 @@ class CompiledPlan:
                 return True
         return False
 
+    # fst:hotpath device=states
     def flush(self, states: Dict) -> Tuple[Dict, Dict]:
         """End-of-stream flush (timeBatch final windows etc.). Artifacts
         writing to tables flush THROUGH the table state (windowed table
@@ -251,6 +253,7 @@ class CompiledPlan:
             return jax.lax.bitcast_convert_type(arr, jnp.int32)
         return arr.astype(jnp.int32)
 
+    # fst:hotpath device=states,acc,tape
     def step_acc(self, states: Dict, acc: Dict, tape,
                  axis_name: Optional[str] = None) -> Tuple[Dict, Dict]:
         """step() + on-device append of every emission into ``acc``."""
@@ -414,6 +417,7 @@ class CompiledPlan:
         return by_stream
 
 
+# fst:hotpath device=out
 def _synthetic_tape(out, ci: ChainedInput):
     """Producer emissions -> the consumer's input Tape, inside the same
     jitted step. All three artifact output modes convert losslessly:
@@ -827,7 +831,7 @@ def compile_plan(
             )
         output_rates[q.output_stream] = r
 
-    return CompiledPlan(
+    plan = CompiledPlan(
         plan_id=plan_id,
         spec=spec,
         artifacts=artifacts,
@@ -844,6 +848,24 @@ def compile_plan(
         output_rates=output_rates,
         snapshot_keys=snapshot_keys,
     )
+    # compiled-plan verification (Siddhi validates every plan at parse
+    # time; we validate the artifact stack before it reaches the
+    # device). Tiered cost: FST_VERIFY_PLANS=1 (the test lane,
+    # tests/conftest.py) runs the static NFA/stack checks on EVERY
+    # compile for ~free; config.verify_plans=True or
+    # FST_VERIFY_PLANS=full adds the eval_shape schema+donation tier
+    # (~0.1s/plan, still no compile); =0 force-disables everything
+    # (bench hot-path escape hatch). docs/static_analysis.md.
+    import os as _os
+
+    _env = _os.environ.get("FST_VERIFY_PLANS")
+    if (config.verify_plans or _env in ("1", "full")) and _env != "0":
+        from ..analysis.plancheck import verify_plan
+
+        verify_plan(
+            plan, trace=bool(config.verify_plans) or _env == "full"
+        )
+    return plan
 
 
 def _rewrite_partitioned(q: ast.Query, schemas) -> ast.Query:

@@ -192,6 +192,7 @@ class ScanWindowArtifact:
         }
         return new_buf, slot_rows
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)
@@ -653,6 +654,7 @@ class SessionWindowArtifact:
             out[agg.slot] = v
         return out
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)
@@ -919,6 +921,7 @@ class FrequencyWindowArtifact:
             )
         return out
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)

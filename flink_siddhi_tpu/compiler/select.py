@@ -73,6 +73,7 @@ class SelectArtifact:
             state["seen"] = jnp.zeros((), jnp.int32)
         return state
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)

@@ -371,6 +371,7 @@ class SlidingWindowArtifact:
                 return False
         return True
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         if self._blocked():
             return self._step_blocked(state, tape)
@@ -1096,6 +1097,7 @@ class CumulativeAggArtifact:
             out["@gv"], out["@gc"] = self._chained_tables(need)
         return out
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)
@@ -1348,6 +1350,7 @@ class BatchWindowArtifact:
             return E // self.length + 2
         return self.batch_slots + 1
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)
@@ -2172,6 +2175,7 @@ class ExpiredWindowArtifact:
         ai = jnp.clip(idx - P0, 0, arr_col.shape[0] - 1)
         return jnp.where(idx < P0, from_ring, arr_col[ai])
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)
@@ -2439,6 +2443,7 @@ class PerKeyWindowArtifact:
             )
         return out
 
+    # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)
         mask = tape.valid & (tape.stream == self.stream_code)

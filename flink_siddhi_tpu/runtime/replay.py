@@ -383,6 +383,7 @@ class ShardedResidentReplay(ResidentReplay):
             ]
         smapped = make_sharded_step_acc(rt.plan, job.mesh, jitted=False)
 
+        # fst:hotpath
         def seg_scan(states, acc, seg):
             def body(carry, tape):
                 s, a = smapped(carry[0], carry[1], tape)

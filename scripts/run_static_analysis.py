@@ -1,0 +1,83 @@
+#!/usr/bin/env python3
+"""Tier-1 static analysis gate: fstlint + plancheck over the query zoo.
+
+Runs alongside scripts/check_bench_schema.py in the tier-1 lane
+(tests/test_static_analysis.py imports and invokes this; CI can also
+call it directly). Exits nonzero on:
+
+* any unsuppressed fstlint finding over the repo surface
+  (flink_siddhi_tpu/, bench.py, scripts/),
+* any stale / reason-less / REVIEWME baseline.toml suppression,
+* any plancheck issue over the window/pattern/join/multiquery zoo
+  (full tier: static NFA/stack checks + eval_shape schema/donation
+  checks + the deep inert-tape execution; ``--fast`` skips deep).
+
+docs/static_analysis.md is the rule and invariant reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--skip-lint", action="store_true")
+    ap.add_argument("--skip-plancheck", action="store_true")
+    ap.add_argument(
+        "--fast",
+        action="store_true",
+        help="skip the deep inert-tape zoo execution (trace checks "
+        "still run; the tier-1 lane uses this to protect its wall-"
+        "clock budget — CI outside the lane runs full deep)",
+    )
+    args = ap.parse_args(argv)
+    failed = False
+
+    if not args.skip_lint:
+        from flink_siddhi_tpu.analysis import fstlint
+
+        print("== fstlint ==", flush=True)
+        rc = fstlint.main([])
+        if rc != 0:
+            failed = True
+            print(f"fstlint: FAILED (exit {rc})")
+        else:
+            print("fstlint: clean")
+
+    if not args.skip_plancheck:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from flink_siddhi_tpu.analysis.plancheck import (
+            PlanCheckError,
+            verify_plan,
+        )
+        from flink_siddhi_tpu.analysis.zoo import compile_zoo
+
+        print("== plancheck (query zoo) ==", flush=True)
+        try:
+            plans = compile_zoo()
+        except Exception as e:  # noqa: BLE001 — a zoo compile failure IS the finding
+            print(f"zoo compile FAILED: {type(e).__name__}: {e}")
+            return 1
+        for name, plan in plans:
+            try:
+                verify_plan(plan, trace=True, deep=not args.fast)
+                print(f"  {name}: ok")
+            except PlanCheckError as e:
+                failed = True
+                print(f"  {name}: FAILED")
+                for issue in e.issues:
+                    print(f"    {issue.render()}")
+
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""KNOWN-BAD fixture: the PR 7 checkpoint-restore donation-aliasing
+bug, reconstructed. On CPU, ``jax.device_put`` zero-copies aligned
+numpy, so device state silently aliases the unpickled snapshot's
+buffers; the donated step then frees them in place and the retained
+alias reads garbage. fstlint must flag the post-donation read (FST101).
+
+Lint fixture only — never imported by tests, only parsed.
+"""
+
+import jax
+
+
+def step(states, batch):
+    return {"w": states["w"] + batch}
+
+
+jitted_step = jax.jit(step, donate_argnums=(0,))
+
+
+def restore_and_run(snapshot_arrays, batches):
+    states = jax.device_put(snapshot_arrays)
+    snap = states  # alias captured BEFORE the donating call
+    for b in batches:
+        states = jitted_step(states, b)
+    # BAD: snap still points at the donated (freed/reused) buffers
+    return snap["w"]
+
+
+def donate_put(x, batches):
+    y = jax.device_put(x, donate=True)
+    # BAD: x's buffer was donated to the transfer above
+    return x + y

@@ -1,0 +1,29 @@
+"""Corrected twin of fst101_donation_bad.py: the restore path makes an
+OWNED copy before the donating step ever runs (the actual PR 7 fix in
+runtime/checkpoint.py), so no binding outlives its buffer. fstlint must
+stay quiet."""
+
+import jax
+import jax.numpy as jnp
+
+
+def step(states, batch):
+    return {"w": states["w"] + batch}
+
+
+jitted_step = jax.jit(step, donate_argnums=(0,))
+
+
+def restore_and_run(snapshot_arrays, batches):
+    states = jax.device_put(snapshot_arrays)
+    # owned on-device copy: nothing aliases the snapshot's numpy
+    states = jax.tree.map(lambda a: a + 0, states)
+    snap = jax.device_get(states)  # host copy, not an alias
+    for b in batches:
+        states = jitted_step(states, b)
+    return states["w"], snap
+
+
+def donate_put(x, batches):
+    y = jax.device_put(jnp.asarray(x), donate=True)
+    return y + 1

@@ -1,0 +1,17 @@
+"""KNOWN-BAD fixture: host syncs inside an annotated hot-path function
+— every one is a blocking device round trip per micro-batch (or a
+TracerBoolConversionError once the function is jitted). fstlint must
+flag all four (FST102). Lint fixture only."""
+
+import numpy as np
+
+
+# fst:hotpath device=state,tape
+def step(state, tape):
+    total = state["acc"] + tape["vals"]
+    if total > 0:  # BAD: branching on a device value
+        total = total + 1
+    rate = float(total)  # BAD: float() forces a fetch
+    dump = np.asarray(total)  # BAD: implicit device->host transfer
+    one = total.item()  # BAD: per-call round trip
+    return {"acc": total}, (rate, dump, one)

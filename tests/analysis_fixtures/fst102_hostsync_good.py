@@ -1,0 +1,20 @@
+"""Corrected twin of fst102_hostsync_bad.py: the branch becomes a
+device-side ``jnp.where``, host materialization happens OUTSIDE the
+hot path (the drain boundary), and static shape metadata reads stay
+legal. fstlint must stay quiet."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# fst:hotpath device=state,tape
+def step(state, tape):
+    total = state["acc"] + tape["vals"]
+    total = jnp.where(total > 0, total + 1, total)
+    width = int(total.shape[0])  # static metadata: no sync
+    return {"acc": total}, width
+
+
+def drain(acc):
+    # the ONE intended sync point, outside any hot-path annotation
+    return np.asarray(acc), float(acc.sum())

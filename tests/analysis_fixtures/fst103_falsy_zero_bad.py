@@ -1,0 +1,22 @@
+"""KNOWN-BAD fixture: the PR 8 ``drain_interval_ms=0`` bug,
+reconstructed — ``x or default`` on a numeric config where 0 is a
+legitimate value ("tightest visibility") silently rounds 0 up to the
+default. fstlint must flag both sites (FST103). Lint fixture only."""
+
+
+class Job:
+    def __init__(self):
+        self.drain_interval_ms = None
+        self.fused_segment_len = None
+
+
+def partial_age_budget_s(job):
+    # BAD: drain_interval_ms=0 means "dispatch immediately" but `or`
+    # rounds it up to 500ms
+    age_ms = job.drain_interval_ms or 500.0
+    return age_ms / 1e3
+
+
+def segment_depth(job):
+    # BAD: a 0 segment length silently becomes 8
+    return job.fused_segment_len or 8

@@ -1,0 +1,24 @@
+"""Corrected twin of fst103_falsy_zero_bad.py: explicit ``is None``
+defaulting (the actual PR 8 review fix) — 0 stays 0. fstlint must stay
+quiet."""
+
+
+class Job:
+    def __init__(self):
+        self.drain_interval_ms = None
+        self.fused_segment_len = None
+
+
+def partial_age_budget_s(job):
+    age_ms = (
+        500.0
+        if job.drain_interval_ms is None
+        else job.drain_interval_ms
+    )
+    return age_ms / 1e3
+
+
+def segment_depth(job):
+    if job.fused_segment_len is None:
+        return 8
+    return job.fused_segment_len

@@ -1,0 +1,27 @@
+"""KNOWN-BAD fixture: values derived inside jit/scan bodies stored
+onto ``self`` and a module global — the tracer (or a stale concrete
+value from trace time) escapes the trace. fstlint must flag both
+(FST104). Lint fixture only."""
+
+import jax
+
+_LAST_BATCH = None
+
+
+class Engine:
+    def make_step(self):
+        def body(carry, x):
+            y = carry + x
+            self.debug_last = y  # BAD: tracer stored on self
+            return y, y
+
+        return jax.jit(body)
+
+
+def traced(x):
+    global _LAST_BATCH
+    _LAST_BATCH = x * 2  # BAD: tracer stored in a module global
+    return x + 1
+
+
+jitted = jax.jit(traced)

@@ -1,0 +1,27 @@
+"""Corrected twin of fst104_tracer_leak_bad.py: trace-time python
+counters (host ints, the executor's ``traces["n"] += 1`` idiom) and
+pure returns are legal; debug state is captured OUTSIDE the jitted
+function from its outputs. fstlint must stay quiet."""
+
+import jax
+
+
+class Engine:
+    def make_step(self):
+        traces = {"n": 0}
+
+        def body(carry, x):
+            traces["n"] += 1  # host int bump at TRACE time: fine
+            y = carry + x
+            return y, y
+
+        self.step = jax.jit(body)
+        return self.step
+
+
+def run(engine, carry, xs):
+    step = engine.make_step()
+    for x in xs:
+        carry, out = step(carry, x)
+    engine.debug_last = out  # captured from the OUTPUT, outside jit
+    return carry

@@ -1,0 +1,23 @@
+"""KNOWN-BAD fixture: a jitted call site fed argument shapes that
+derive from the raw batch length — every distinct length compiles a
+fresh executable (the sticky wire-kind widening retrace-explosion
+class). fstlint must flag both call sites (FST105). Lint fixture
+only."""
+
+import jax
+import numpy as np
+
+step = jax.jit(lambda t: t * 2)
+
+
+def dispatch_sliced(events):
+    n = len(events)
+    tape = np.asarray(events, dtype=np.int32)
+    # BAD: n takes any value -> one executable per batch size
+    return step(tape[:n])
+
+
+def dispatch_fresh(events):
+    n = len(events)
+    # BAD: freshly built array sized by the raw length
+    return step(np.zeros(n, dtype=np.int32))

@@ -1,0 +1,24 @@
+"""Corrected twin of fst105_retrace_bad.py: sizes route through the
+named shape-bucketing helper (``bucket_size``, runtime/tape.py), so
+the jitted callee sees a handful of power-of-two shapes. fstlint must
+stay quiet."""
+
+import jax
+import numpy as np
+
+
+def bucket_size(n, minimum=128):
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+step = jax.jit(lambda t: t * 2)
+
+
+def dispatch(events):
+    cap = bucket_size(len(events))
+    tape = np.zeros(cap, dtype=np.int32)
+    tape[: len(events)] = events
+    return step(tape)

@@ -25,6 +25,14 @@ os.environ.setdefault(
     "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2"
 )
 
+# Compiled-plan verification on EVERY compile in the test lane
+# (analysis/plancheck.py): static NFA/stack invariants at ~zero cost.
+# The eval_shape tier runs over the full query zoo in
+# tests/test_plancheck.py + scripts/run_static_analysis.py; =1 keeps
+# per-compile overhead out of the suite's 870s budget while still
+# rejecting malformed transition tables anywhere a test compiles one.
+os.environ.setdefault("FST_VERIFY_PLANS", "1")
+
 # TPU smoke lane (`FST_TPU_SMOKE=1 python -m pytest -m tpu tests/`):
 # keep the real accelerator backend alive instead of pinning CPU —
 # the only configuration under which the real chip runs result-asserting
@@ -95,6 +103,31 @@ def _pallas_fallback_gate():
         [_jnp.asarray(_np.array([4, 2, 9, 1], _np.int32))]
     )
     assert _np.asarray(out[0]).tolist() == [1, 1, 1, 1]
+    yield
+
+
+# The jitted-step suites run the engine hot loop under jax's transfer
+# guard (runtime/executor.py HOTLOOP_TRANSFER_GUARD): an IMPLICIT
+# host<->device transfer inside run_cycle — a numpy array silently
+# riding a jit call where the design says "one explicit async
+# device_put per segment" — fails loudly. The per-batch path's
+# intended staging upload is re-allowed at its one call site
+# (_staging_allow); everything else the guard catches is a regression
+# of the staging contract (docs/static_analysis.md). Scoped to the
+# hot loop, not the whole test: plan compilation legitimately builds
+# eager device constants.
+_TRANSFER_GUARD_FILES = {"test_fused_stream.py", "test_checkpoint.py"}
+
+
+@pytest.fixture(autouse=True)
+def _hotloop_transfer_guard(request, monkeypatch):
+    fname = os.path.basename(str(request.node.fspath))
+    if _TPU_SMOKE or fname not in _TRANSFER_GUARD_FILES:
+        yield
+        return
+    from flink_siddhi_tpu.runtime import executor as _executor
+
+    monkeypatch.setattr(_executor, "HOTLOOP_TRANSFER_GUARD", True)
     yield
 
 

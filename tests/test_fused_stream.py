@@ -248,6 +248,54 @@ def test_drain_staleness_bounded_under_fused_dispatch():
     assert counters.get("fusion.dispatches", 0) >= 1
 
 
+# Named per-shape-bucket compile budget for the tier-1 gate shape
+# (constant-cadence stream, one tape bucket, segment 8): the complete
+# executable set is init_acc + full-segment scan + padded partial-
+# trailing scan + backpressure ticket noop + drain count/pack shapes +
+# flush + retrace headroom for jax-version drift. Measured 12 on this
+# lane; the sticky-d0 widening regression class (every small-but-
+# constant batch widening the wire kind and retracing the segment
+# executable) lowers O(n_batches) extra modules and blows straight
+# through this.
+RETRACE_BUDGET_GATE_SHAPE = 16
+
+
+def test_retrace_budget_gate_shape():
+    """Satellite: count XLA executable builds over an end-to-end run
+    of the gate shape via jax.monitoring and pin them to the named
+    budget. The counter is '/jax/core/compile/jaxpr_to_mlir_module
+    _duration': it fires once per executable LOWERING, before the
+    persistent compilation cache is consulted — so a warm .jax_cache
+    cannot mask a retrace regression (cache hits skip backend_compile,
+    not lowering)."""
+    import jax
+
+    lowered = []
+
+    def listener(name, _secs):
+        if name == "/jax/core/compile/jaxpr_to_mlir_module_duration":
+            lowered.append(name)
+
+    jax.monitoring.register_event_duration_secs_listener(listener)
+    try:
+        cql, n_ids = CASES["window_groupby"]
+        out, job = _run(cql, n_ids, seg=8)
+        assert any(rows for rows in out.values())
+        counters = job.telemetry.snapshot()["counters"]
+        assert counters.get("fusion.dispatches", 0) >= 1
+        n = len(lowered)
+        assert 0 < n <= RETRACE_BUDGET_GATE_SHAPE, (
+            f"{n} executables lowered for ONE shape bucket (budget "
+            f"{RETRACE_BUDGET_GATE_SHAPE}) — a retrace leak (sticky "
+            "wire-kind widening, unstable jit signatures) is "
+            "recompiling the hot loop"
+        )
+    finally:
+        # jax.monitoring has no per-listener remove; the suite
+        # registers none elsewhere, so a full clear is safe
+        jax.monitoring.clear_event_listeners()
+
+
 def test_checkpoint_forces_segment_boundary(tmp_path):
     """Checkpoints land only at segment boundaries: save_checkpoint
     force-dispatches the staged partial segment, so the snapshot's

@@ -1,0 +1,188 @@
+"""plancheck: the full query zoo verifies clean (trace tier for every
+entry, deep inert-tape tier for the padded/stacked shapes), and
+deliberately miscompiled plans — dtype drift, malformed NFA tables,
+donation-signature breaks, non-inert padding — are rejected with
+rule-ID'd errors."""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.analysis.plancheck import (
+    PlanCheckError,
+    _check_one_nfa,
+    verify_plan,
+)
+from flink_siddhi_tpu.analysis.zoo import PLAN_ZOO, compile_zoo, zoo_schemas
+from flink_siddhi_tpu.compiler.config import EngineConfig
+from flink_siddhi_tpu.compiler.plan import compile_plan
+
+_ZOO = dict(compile_zoo())
+
+# the entries whose padding/free rows the deep tier exists for; the
+# full-zoo deep pass lives in scripts/run_static_analysis.py (CI) —
+# tier-1 keeps the expensive eager executions to the shapes that carry
+# padded stacks or slot pools
+DEEP = (
+    "multiquery_stack6",
+    "slot_nfa_quantified",
+    "pattern_absence",
+    "chained_composition",
+)
+
+
+@pytest.mark.parametrize("name", sorted(PLAN_ZOO))
+def test_zoo_entry_verifies_trace_tier(name):
+    assert verify_plan(_ZOO[name], trace=True) == []
+
+
+@pytest.mark.parametrize("name", DEEP)
+def test_zoo_entry_verifies_deep(name):
+    assert verify_plan(_ZOO[name], trace=True, deep=True) == []
+
+
+def _fresh(name):
+    return compile_plan(
+        PLAN_ZOO[name], zoo_schemas(), plan_id=f"mis:{name}"
+    )
+
+
+def test_verify_plans_config_flag_runs_at_compile(monkeypatch):
+    monkeypatch.delenv("FST_VERIFY_PLANS", raising=False)
+    compile_plan(
+        PLAN_ZOO["filter_select"],
+        zoo_schemas(),
+        config=EngineConfig(verify_plans=True),
+    )
+    # and the escape hatch force-disables even explicit True
+    monkeypatch.setenv("FST_VERIFY_PLANS", "0")
+    compile_plan(
+        PLAN_ZOO["filter_select"],
+        zoo_schemas(),
+        config=EngineConfig(verify_plans=True),
+    )
+
+
+# -- deliberate miscompiles ------------------------------------------------
+
+
+def _rules_of(plan, **kw):
+    return {
+        i.rule
+        for i in verify_plan(plan, raise_on_error=False, trace=True, **kw)
+    }
+
+
+def test_dtype_mismatch_rejected():
+    """Declared DOUBLE column silently emitting int32 — the class of
+    miscompile where decode bitcasts garbage — must be PLC105."""
+    plan = _fresh("filter_select")
+    art = plan.artifacts[0]
+    sch = art.output_schema
+    price_i = next(
+        i for i, f in enumerate(sch.fields) if f.name == "price"
+    )
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    bad_fields = list(sch.fields)
+    bad_fields[price_i] = dataclasses.replace(
+        bad_fields[price_i], atype=AttributeType.INT
+    )
+    art.output_schema = dataclasses.replace(
+        sch, fields=tuple(bad_fields)
+    )
+    assert "PLC105" in _rules_of(plan)
+
+
+def test_malformed_nfa_tables_rejected():
+    """Corrupt the slot engine's REAL derived tables (the ones the
+    scan body indexes by): a non-monotone min-count prefix and a group
+    table that lost an element."""
+    plan = _fresh("slot_nfa_quantified")
+    art = plan.artifacts[0]
+    art._min_prefix = np.asarray(
+        art._min_prefix[::-1].copy(), dtype=np.int32
+    )
+    art._groups = art._groups[:-1]
+    rules = _rules_of(plan)
+    assert "PLC207" in rules and "PLC208" in rules
+
+
+def test_guard_on_undeclared_element_rejected():
+    """PLC203 unit: an absence guard pointing at a non-'not' element
+    (or out of its inter-positive window) is a miscompiled table."""
+    base = dict(
+        name="q",
+        n_elements=3,
+        positive=(0, 2),
+        guards=((), (1,)),
+        t_guard=None,
+        negated=(False, False, False),  # 1 is NOT declared absent
+        quantifiers=((1, 1), (1, 1), (1, 1)),
+    )
+    issues = []
+    _check_one_nfa("p", base, issues)
+    assert any(i.rule == "PLC203" for i in issues)
+    issues = []
+    _check_one_nfa(
+        "p",
+        {**base, "negated": (False, True, False), "guards": ((1,), ())},
+        issues,
+    )
+    assert any(i.rule == "PLC203" for i in issues)
+
+
+def test_unreachable_element_rejected():
+    issues = []
+    _check_one_nfa(
+        "p",
+        dict(
+            name="q",
+            n_elements=3,
+            positive=(0, 1),  # element 2 is neither step nor guard
+            guards=((), ()),
+            t_guard=None,
+            negated=(False, False, False),
+            quantifiers=((1, 1), (1, 1), (1, 1)),
+        ),
+        issues,
+    )
+    assert any(i.rule == "PLC205" for i in issues)
+
+
+def test_donation_signature_break_rejected():
+    """A state leaf consumed but not reproduced (the scan carry cannot
+    type, donation frees a live buffer) must be PLC401."""
+    plan = _fresh("length_window_agg")
+    art = plan.artifacts[0]
+    orig_init = art.init_state
+    art.init_state = lambda: {
+        **orig_init(),
+        "@bogus": jnp.zeros(4, jnp.int32),
+    }
+    assert "PLC401" in _rules_of(plan)
+
+
+def test_non_inert_padding_rejected():
+    """An artifact emitting a phantom row for an ALL-INVALID tape (a
+    stale pad row reaching the accumulator) must be PLC311 in deep
+    mode."""
+    plan = _fresh("filter_select")
+    art = plan.artifacts[0]
+    orig_step = art.step
+
+    def leaky_step(state, tape):
+        new_state, (n, ts, cols) = orig_step(state, tape)
+        return new_state, (n + 1, ts, cols)
+
+    art.step = leaky_step
+    assert "PLC311" in _rules_of(plan, deep=True)
+
+
+def test_plancheck_error_renders_rule_ids():
+    plan = _fresh("slot_nfa_quantified")
+    plan.artifacts[0]._groups = plan.artifacts[0]._groups[:-1]
+    with pytest.raises(PlanCheckError, match="PLC208"):
+        verify_plan(plan, trace=False)
