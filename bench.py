@@ -5,7 +5,7 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line (``schema_version: 5``). One invocation measures
+Prints ONE JSON line (``schema_version: 6``). One invocation measures
 THREE execution modes and emits all of them in the same document, so a
 regression in any path stays a tracked number:
 
@@ -81,6 +81,18 @@ historical per-batch dispatch loop).
 prober and emits the full schema-v5 JSON line — the schema gate
 (scripts/check_bench_schema.py + tests/test_bench_schema.py) runs it
 in the tier-1 lane.
+
+Schema v6 (event-time robustness round) adds the disorder contract:
+every line carries a ``disorder`` block — one run per skew in {0, 1 s,
+10 s}, the stream arrival-shuffled/duplicated/straggled/idle-gapped by
+a seeded ``DisorderSchedule`` (runtime/faultinject.py) and the job
+watermarking with ``BoundedDisorderWatermark(skew)`` in EVENT-time
+mode — reporting ev/s + p99 per skew with EXACT late/dup/idle
+accounting (``late_dropped`` == injected stragglers, ``idle_marked``
+== injected gaps, ``processed_events`` reconciles the duplicates; all
+gated by scripts/check_bench_schema.py). ``--disorder`` scales the
+per-skew event count to full size (BENCH_DISORDER_EVENTS /
+BENCH_DISORDER_CONFIG override).
 
 ``--fault`` (composable with ``--dryrun``): appends a ``recovery``
 block — a supervised run (runtime/supervisor.py) under a seeded crash
@@ -960,6 +972,173 @@ def _fault_recovery_block(dryrun):
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 
+# event-time disorder sweep (schema v6): the skews the block must carry
+DISORDER_SKEWS_MS = (0, 1_000, 10_000)
+
+
+def _disorder_block(dryrun, full=False):
+    """Schema v6: event-time robustness as a MEASURED surface.
+
+    One run per skew in :data:`DISORDER_SKEWS_MS`: the stream is
+    arrival-shuffled within the skew bound by a seeded
+    ``DisorderSchedule`` (runtime/faultinject.py) with bursty
+    duplicates, late stragglers, and injected idle gaps, and the job
+    watermarks with ``BoundedDisorderWatermark(skew)`` in EVENT-time
+    mode — the configuration whose claims Karimov et al. (PAPERS.md
+    #4) would accept: throughput + p99 under sustained *disordered*
+    load, not under the sorted stream nobody serves in production.
+
+    Accounting is EXACT, checked here and gated by
+    scripts/check_bench_schema.py: ``late_dropped`` must equal the
+    injected straggler count, ``idle_marked`` the injected gap count,
+    and ``processed_events`` must reconcile as
+    ``events + injected duplicates - late_dropped`` (duplicates are
+    real events to the engine; stragglers are dropped by policy).
+
+    ``--disorder`` (or ``full=True``) scales the per-skew event count
+    up (BENCH_DISORDER_EVENTS overrides either way); the default —
+    and the --dryrun tier-1 gate — runs a small config so the block
+    is always present in a v6 line.
+    """
+    from flink_siddhi_tpu import CEPEnvironment
+    from flink_siddhi_tpu.compiler.config import EngineConfig
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.faultinject import (
+        DisorderSchedule,
+        DisorderSource,
+    )
+    from flink_siddhi_tpu.runtime.sources import (
+        BatchSource,
+        with_watermarks,
+    )
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    config = os.environ.get("BENCH_DISORDER_CONFIG", "headline")
+    n = int(
+        os.environ.get(
+            "BENCH_DISORDER_EVENTS",
+            40_000 if dryrun else (1_000_000 if full else 200_000),
+        )
+    )
+    batch = 4_096  # small batches: the reorder buffer must actually work
+    late_count = 20
+    # feasibility, validated up front with the minimum NAMED: the
+    # 10s-skew run's stragglers need their release threshold
+    # (ts + skew + 2s, + skew of arrival pessimism) crossed >= 3
+    # chunks before the stream end (DisorderSchedule.arrival's
+    # eligibility rule) — below this the schedule raises mid-sweep
+    # and the whole bench line is lost
+    min_n = (
+        3 * batch + 2 * max(DISORDER_SKEWS_MS) + 2_000 + late_count + 1
+    )
+    if n < min_n:
+        raise SystemExit(
+            f"BENCH_DISORDER_EVENTS={n} is too small for the "
+            f"{max(DISORDER_SKEWS_MS) // 1000}s-skew disorder run: "
+            f"need >= {min_n} events at 1ms spacing so the "
+            f"{late_count} injected stragglers have a reachable "
+            "release threshold"
+        )
+    runs = []
+    for skew in DISORDER_SKEWS_MS:
+        env = CEPEnvironment(batch_size=batch, time_mode="event")
+        schema = StreamSchema(
+            [
+                ("id", AttributeType.INT),
+                ("name", AttributeType.STRING),
+                ("price", AttributeType.DOUBLE),
+                ("timestamp", AttributeType.LONG),
+            ],
+            shared_strings=env.shared_strings,
+        )
+        batches = make_batches(n, batch, schema, "inputStream", 50)
+        # stragglers must outrun the strategy skew to be late at all
+        # (DisorderSchedule docstring); +2s margin past the skew
+        sched = DisorderSchedule(
+            seed=1234 + skew,
+            skew_ms=skew,
+            dup_rate=0.001,
+            dup_burst=2,
+            late_count=late_count,
+            late_release_ms=skew + 2_000,
+            # the stream serves in ~n/batch polls; every 5th poll goes
+            # silent for 2 polls so every run exercises idle marking
+            idle_gap_every=5,
+            idle_gap_polls=2,
+        )
+        src = DisorderSource(
+            BatchSource("inputStream", schema, iter(batches)),
+            sched,
+            chunk=batch,
+        )
+        plan = compile_plan(
+            _config_cql(config), {"inputStream": schema},
+            plan_id="bench-disorder",
+            config=EngineConfig(lazy_projection=True, pred_pushdown=True),
+        )
+        job = Job(
+            [plan],
+            [with_watermarks(src, skew_ms=skew)],
+            batch_size=batch,
+            time_mode="event",
+            retain_results=False,
+        )
+        # telemetry stays ON even under BENCH_TELEMETRY=0: the block
+        # is an exactness-accounting surface (idle.marked, drain p99),
+        # not part of the overhead A/B — with the registry off the
+        # always-validated gate would reject its own line
+        job.telemetry.enabled = True
+        job.late_policy = "drop"
+        # idle_timeout_ms=0: an empty poll marks the source idle at
+        # once — deterministic gap accounting at full replay speed
+        job.idle_timeout_ms = 0.0
+        t0 = time.perf_counter()
+        job.run()
+        elapsed = time.perf_counter() - t0
+        counters = job.telemetry.snapshot()["counters"]
+        injected = dict(src.injected)
+        late_ok = job.late_dropped == injected["late"]
+        idle_ok = counters.get("idle.marked", 0) == injected["idle_gaps"]
+        processed_expected = (
+            n + injected["duplicates"] - job.late_dropped
+        )
+        dup_ok = job.processed_events == processed_expected
+        runs.append(
+            {
+                "skew_ms": skew,
+                "events": n,
+                "events_per_sec": round(job.processed_events / elapsed),
+                "p99_ms": _drain_leg_ms(job, 99),
+                "p50_ms": _drain_leg_ms(job, 50),
+                "elapsed_s": round(elapsed, 3),
+                "injected": injected,
+                "late_dropped": int(job.late_dropped),
+                "idle_marked": int(counters.get("idle.marked", 0)),
+                "processed_events": int(job.processed_events),
+                # exactness, per dimension: stragglers all classified,
+                # idle gaps all marked, duplicates all processed
+                "counts_exact": bool(late_ok and idle_ok and dup_ok),
+            }
+        )
+        if not (late_ok and idle_ok and dup_ok):
+            print(
+                f"DISORDER ACCOUNTING MISMATCH at skew {skew}ms: "
+                f"late {job.late_dropped}/{injected['late']}, idle "
+                f"{counters.get('idle.marked', 0)}/"
+                f"{injected['idle_gaps']}, processed "
+                f"{job.processed_events}/{processed_expected}",
+                file=sys.stderr,
+            )
+    return {
+        "config": config,
+        "late_policy": "drop",
+        "watermark": "BoundedDisorderWatermark(skew)",
+        "runs": runs,
+    }
+
+
 def main():
     config = os.environ.get("BENCH_CONFIG", "headline")
     dryrun = "--dryrun" in sys.argv
@@ -1045,7 +1224,7 @@ def main():
         # provenance: which denominator vs_baseline divides by (ADVICE
         # r4: the JSON line should be self-describing off this machine)
         "baseline_source": "pinned-measurement (BASELINE.md)",
-        "schema_version": 5,
+        "schema_version": 6,
         "modes": modes,
     }
     if set(want_modes) != {"resident", "streaming", "sink"}:
@@ -1295,7 +1474,16 @@ def main():
             file=sys.stderr,
         )
 
-    # Phase 3 (optional, --fault): supervised recovery under injected
+    # Phase 3 (schema v6): event-time robustness under disorder — the
+    # stream arrival-shuffled/duplicated/straggled/idled by a seeded
+    # schedule, the job watermarking in event-time mode; ev/s + p99 at
+    # 0/1s/10s skew with EXACT late/dup/idle accounting (gated).
+    # ``--disorder`` scales the per-skew event count up to full size.
+    out["disorder"] = _disorder_block(
+        dryrun, full="--disorder" in sys.argv
+    )
+
+    # Phase 4 (optional, --fault): supervised recovery under injected
     # crashes — recovery_time_ms / events_replayed measured, duplicate
     # and lost rows COUNTED against an unfaulted oracle. The schema
     # gate validates the block whenever present.

@@ -19,7 +19,14 @@ axis and sharded across a key axis with ``shard_map`` over a ``jax.sharding.Mesh
 from .api.cep import SiddhiCEP, CEPEnvironment
 from .api.stream import ExecutionStream, Row
 from .compiler.output import ColumnBatch
-from .runtime.executor import ColumnarSink
+from .runtime.executor import ColumnarSink, late_stream
+from .runtime.sources import (
+    BoundedDisorderWatermark,
+    PunctuatedWatermark,
+    WatermarkStrategy,
+    WatermarkedSource,
+    with_watermarks,
+)
 from .runtime.supervisor import RestartBudgetExceeded, Supervisor
 from .schema.types import AttributeType
 from .schema.stream_schema import StreamSchema
@@ -49,4 +56,10 @@ __all__ = [
     "CONTROL_STREAM",
     "RestartBudgetExceeded",
     "Supervisor",
+    "BoundedDisorderWatermark",
+    "PunctuatedWatermark",
+    "WatermarkStrategy",
+    "WatermarkedSource",
+    "late_stream",
+    "with_watermarks",
 ]

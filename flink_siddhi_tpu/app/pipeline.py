@@ -69,6 +69,20 @@ class PipelineConfig:
     compression: str = "none"  # produce-side codec for kafka:// output
     # ('none' | 'gzip'; connectors.kafka.codecs names — needs a broker
     # negotiating Produce >= 3, i.e. v2 record batches)
+    # -- event-time robustness (docs/event_time.md) -----------------------
+    # watermark generation: when set, the source's watermark is
+    # GENERATED as max-observed-ts - skew - 1 (BoundedDisorderWatermark;
+    # per-partition for kafka:// inputs) instead of trusting the
+    # transport's native claim. None keeps the historical claim
+    # (max ts - allowed_lateness_ms).
+    watermark_skew_ms: Optional[int] = None
+    # late rows (below the released watermark): 'drop' (counted) |
+    # 'side_output' (full rows on '<stream>@late') | 'allow' (in-order
+    # admission within allowed_lateness_ms)
+    late_policy: str = "drop"
+    # a source silent this long stops pinning the min watermark
+    # (None = never; see Job.idle_timeout_ms)
+    idle_timeout_ms: Optional[float] = None
 
     def schema(self) -> StreamSchema:
         return StreamSchema(
@@ -136,6 +150,7 @@ class CEPPipeline:
             # (FlinkKafkaConsumer010, CEPPipeline.scala:49-51); offsets
             # checkpoint as the source position
             from ..runtime.kafka import KafkaSource
+            from ..runtime.sources import BoundedDisorderWatermark
 
             bootstrap, topic = _parse_kafka_url(cfg.input_path)
             src = KafkaSource(
@@ -143,6 +158,13 @@ class CEPPipeline:
                 fmt=cfg.format, delim=cfg.csv_delim,
                 ts_field=cfg.ts_field,
                 allowed_lateness_ms=cfg.allowed_lateness_ms,
+                # per-partition bounded-disorder generation; the source
+                # watermark is the min across assigned partitions
+                watermark=(
+                    BoundedDisorderWatermark(cfg.watermark_skew_ms)
+                    if cfg.watermark_skew_ms is not None
+                    else None
+                ),
             )
         elif cfg.format == "csv":
             src = CsvSource(
@@ -157,6 +179,15 @@ class CEPPipeline:
                 ts_field=cfg.ts_field, chunk_bytes=cfg.chunk_bytes,
                 allowed_lateness_ms=cfg.allowed_lateness_ms,
             )
+        if (
+            cfg.watermark_skew_ms is not None
+            and not cfg.input_path.startswith("kafka://")
+        ):
+            # file/socket inputs: one bounded-disorder strategy per
+            # source, replacing the byte source's native claim
+            from ..runtime.sources import with_watermarks
+
+            src = with_watermarks(src, skew_ms=cfg.watermark_skew_ms)
         plan = compile_plan(
             cfg.cql, {cfg.stream_id: schema}, extensions=self.extensions
         )
@@ -174,6 +205,14 @@ class CEPPipeline:
                 extensions=self.extensions, plan_id=plan_id,
             ),
         )
+        if cfg.late_policy not in ("drop", "side_output", "allow"):
+            raise ValueError(
+                f"late_policy must be drop|side_output|allow, got "
+                f"{cfg.late_policy!r}"
+            )
+        job.late_policy = cfg.late_policy
+        job.allowed_lateness_ms = int(cfg.allowed_lateness_ms)
+        job.idle_timeout_ms = cfg.idle_timeout_ms
         self._attach_sink(job, plan)
         self.job = job
         return job

@@ -167,6 +167,14 @@ class QueryControlService:
                             "processed_events": int(
                                 service.job.processed_events
                             ),
+                            # event-time robustness: silent sources and
+                            # late-row drops are alertable from /health
+                            "idle_sources": (
+                                service.job.idle_source_ids()
+                            ),
+                            "late_dropped": int(
+                                service.job.late_dropped
+                            ),
                         })
                     return self._reply(
                         200, {"alive": True, "supervised": False}

@@ -116,6 +116,22 @@ def snapshot_job(job) -> Dict[str, Any]:
         "epoch_ms": job._epoch_ms,
         "processed_events": job.processed_events,
         "time_mode": job.time_mode,
+        # event-time gate state (docs/event_time.md): the released
+        # horizon and per-source watermarks must survive restore — a
+        # restarted job that forgot how far it released would re-admit
+        # (or re-classify) rows around the crash point, breaking the
+        # exactly-once row account the supervisor commits. Source-side
+        # strategy state (max observed ts per source / per Kafka
+        # partition) rides the per-source state_dict entries below.
+        "event_time": {
+            "source_wm": [int(w) for w in job._source_wm],
+            "released_wm": int(job._released_wm),
+            "gate_wm": int(job._gate_wm),
+            "idle": [bool(b) for b in job._source_idle],
+            "max_event_ts": job._max_event_ts,
+            "late_events": int(job.late_events),
+            "late_dropped": int(job.late_dropped),
+        },
         "plans": plans,
         "strings": strings.state_dict() if strings is not None else None,
         "pending": pending,
@@ -159,6 +175,23 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
         )
     job._epoch_ms = snap["epoch_ms"]
     job.processed_events = snap["processed_events"]
+
+    # event-time gate state (absent in pre-event-time checkpoints:
+    # defaults stand, matching the historical behavior)
+    evt = snap.get("event_time")
+    if evt is not None:
+        src_wm = [int(w) for w in evt.get("source_wm", ())]
+        if len(src_wm) == len(job._source_wm):
+            job._source_wm = src_wm
+        idle = [bool(b) for b in evt.get("idle", ())]
+        if len(idle) == len(job._source_idle):
+            job._source_idle = idle
+        job._released_wm = int(evt.get("released_wm", job._released_wm))
+        job._gate_wm = int(evt.get("gate_wm", job._gate_wm))
+        if evt.get("max_event_ts") is not None:
+            job._max_event_ts = int(evt["max_event_ts"])
+        job.late_events = int(evt.get("late_events", 0))
+        job.late_dropped = int(evt.get("late_dropped", 0))
 
     # dynamically-added queries: replay them (same runtimes, same group
     # slots) BEFORE the plan-set compatibility check below

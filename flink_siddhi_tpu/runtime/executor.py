@@ -61,6 +61,14 @@ def _staging_allow():
 
 MAX_WM = np.iinfo(np.int64).max
 MIN_WM = -(2 ** 62)  # pre-first-event watermark sentinel
+# side-output channel naming: a stream's late rows surface on
+# '<stream_id>@late' (attach sinks there; ColumnarSink-capable)
+LATE_STREAM_SUFFIX = "@late"
+
+
+def late_stream(stream_id: str) -> str:
+    """The side-output stream id carrying ``stream_id``'s late rows."""
+    return stream_id + LATE_STREAM_SUFFIX
 _LAZY_ORD_WRAP = 1 << 30  # reset lazy ordinal space before int32 wrap
 _LOG = logging.getLogger(__name__)
 
@@ -604,6 +612,61 @@ class Job:
         self.shed_policy: str = "block"  # 'block' | 'drop_oldest'
         self.shed_events = 0  # total events ever shed (also a counter)
         self._shed_warned_at = -1e9  # monotonic ts of the last warning
+        # -- event-time robustness (docs/event_time.md) -----------------
+        # LATE-EVENT POLICY at the watermark gate: a row whose event
+        # time is <= the horizon the gate has already released past
+        # cannot merge in order anymore (the window/pattern state it
+        # belongs to has advanced). Policies:
+        #   'drop'        — discard, counted (faults.late_dropped);
+        #   'side_output' — route the FULL input row to the dedicated
+        #                   late channel '<stream>@late' (attach sinks
+        #                   with add_sink(late_stream(sid), ...);
+        #                   ColumnarSink-capable), counted;
+        #   'allow'       — the gate holds its released horizon back by
+        #                   allowed_lateness_ms, so rows late by at
+        #                   most the allowance still release IN ORDER;
+        #                   rows beyond the allowance are dropped with
+        #                   a loud warning — admitting them would need
+        #                   window re-fire (retract + re-emit panes per
+        #                   the Dataflow model's accumulation modes,
+        #                   PAPERS.md #5), which this engine documents
+        #                   as a rejection, not a silent wrong answer.
+        self.late_policy: str = "drop"
+        self.allowed_lateness_ms: int = 0
+        self.late_events = 0  # rows classified late (all policies)
+        self.late_dropped = 0  # subset discarded ('drop'/'allow'-beyond)
+        self._late_warned_at = -1e9
+        # the horizon (event-time ms) the gate has released through —
+        # rows at or below it are late by definition
+        self._released_wm: int = MIN_WM
+        # monotone effective gate watermark: min-across-sources can
+        # REGRESS when an idle source un-idles with an older claim; the
+        # gate never moves backwards (the un-idled source's old rows
+        # are late, handled by policy — Flink's idleness semantics)
+        self._gate_wm: int = MIN_WM
+        # IDLE-SOURCE HANDLING: a source that produces nothing for
+        # idle_timeout_ms is marked temporarily idle and stops pinning
+        # the min watermark (one silent topic must not stall every
+        # stream); it un-idles on its next event. 0 marks a source idle
+        # on its first empty poll (deterministic for tests); None
+        # disables (historical behavior: an idle source pins forever).
+        self.idle_timeout_ms: Optional[float] = None
+        self._source_idle: List[bool] = [False] * len(self._sources)
+        # monotonic time of each source's last produced event (None =
+        # nothing yet; armed at the first poll so a never-producing
+        # source can still go idle)
+        self._source_last_t: List[Optional[float]] = (
+            [None] * len(self._sources)
+        )
+        # max event time ever pulled: watermark.lag = max_ts - gate wm
+        self._max_event_ts: Optional[int] = None
+        # gate residency: per stream, (arrival monotonic, batch max
+        # ts) per pending batch. Per-batch granularity is what keeps
+        # the metric honest under partial releases — e.g. the 'allow'
+        # policy holds every row back by the allowance, and a single
+        # per-stream clock re-armed each cycle would report
+        # milliseconds of residency while rows actually wait seconds
+        self._pending_t: Dict[str, List[Tuple[float, int]]] = {}
         # fault visibility: sources that can report state/transport
         # faults (KafkaSource retry counters, _DecodedLinesSource
         # degraded positions) mirror them into this job's registry
@@ -1031,6 +1094,14 @@ class Job:
         self._last_full_drain = time.monotonic()
         self._last_cycle_t = None
         self._cycle_ema = None
+        # event-time gate phase: a rerun replays the SAME stream, so a
+        # carried released horizon would classify every row late
+        self._released_wm = MIN_WM
+        self._gate_wm = MIN_WM
+        self._max_event_ts = None
+        self._pending_t.clear()
+        self._source_idle = [False] * len(self._sources)
+        self._source_last_t = [None] * len(self._sources)
 
     # -- run loop ------------------------------------------------------------
     def run(self, max_cycles: Optional[int] = None) -> None:
@@ -1656,6 +1727,17 @@ class Job:
             and not self._control_pending
         )
 
+    def idle_source_ids(self) -> List[str]:
+        """Stream ids of sources currently marked idle (safe to call
+        off-thread; the REST health route reports it)."""
+        return [
+            getattr(src, "stream_id", f"source[{i}]")
+            for i, (src, idle) in enumerate(
+                zip(list(self._sources), list(self._source_idle))
+            )
+            if idle
+        ]
+
     def run_cycle(self) -> int:
         """Pull, apply control, reorder, step, decode. Returns events
         processed. Control events take effect at micro-batch boundaries
@@ -1839,22 +1921,47 @@ class Job:
         if not self._control_pending:
             return
         wm = self._watermark()
-        self._control_pending.sort(key=lambda p: p[0])
-        while self._control_pending and (
-            self.time_mode == "processing" or self._control_pending[0][0] <= wm
-        ):
-            _, ev = self._control_pending.pop(0)
+        pending = self._control_pending
+        pending.sort(key=lambda p: p[0])
+        # index walk + one tail-del, not pop(0) per event: a control
+        # backlog held behind the watermark gate can grow long, and the
+        # O(n^2) front-pop drain was quadratic in it
+        n_apply = len(pending)
+        if self.time_mode != "processing":
+            n_apply = 0
+            while n_apply < len(pending) and pending[n_apply][0] <= wm:
+                n_apply += 1
+        for i in range(n_apply):
             try:
-                self._apply_control(ev)
+                self._apply_control(pending[i][1])
             except Exception:
                 # a bad dynamic query (e.g. unparsable CQL pushed through
                 # a control channel with no up-front validation) must not
                 # take down the running queries
-                _LOG.exception("control event rejected: %r", ev)
+                _LOG.exception(
+                    "control event rejected: %r", pending[i][1]
+                )
+        if n_apply:
+            del pending[:n_apply]
 
     def _watermark(self) -> int:
-        wms = self._source_wm + self._control_wm
-        return min(wms) if wms else MAX_WM
+        """min watermark across non-idle sources + control streams.
+
+        Idle sources are EXCLUDED (they stopped producing; their stale
+        claim must not pin every other stream). When every data source
+        is idle and there is no control stream the watermark HOLDS at
+        the last gate value instead of jumping to MAX — idle means "no
+        information", not "stream complete" (Flink idleness semantics).
+        """
+        idle = self._source_idle
+        wms = [
+            wm
+            for i, wm in enumerate(self._source_wm)
+            if not (i < len(idle) and idle[i])
+        ] + self._control_wm
+        if not wms:
+            return self._gate_wm if self._sources else MAX_WM
+        return min(wms)
 
     def _pending_total(self) -> int:
         return sum(len(b) for bs in self._pending.values() for b in bs)
@@ -1871,7 +1978,17 @@ class Job:
         )
         block = over and self.shed_policy == "block"
         if block:
-            wm = self._watermark()
+            # the MONOTONE gate watermark: an idle (or just-un-idled)
+            # laggard compares below it and keeps polling — exactly the
+            # sources that must not stop for the backlog to release
+            wm = max(self._watermark(), self._gate_wm)
+        if len(self._source_idle) != len(self._sources):
+            # bench/profilers swap job._sources directly (re_source);
+            # re-size the per-source idle tracking rather than desync
+            self._source_idle = [False] * len(self._sources)
+            self._source_last_t = [None] * len(self._sources)
+        timeout = self.idle_timeout_ms
+        now = time.monotonic() if timeout is not None else 0.0
         for i, src in enumerate(self._sources):
             if self._source_done[i]:
                 continue
@@ -1880,15 +1997,45 @@ class Job:
                 continue
             batch, swm, done = src.poll(self.batch_size)
             if batch is not None and len(batch):
-                self._pending.setdefault(src.stream_id, []).append(batch)
+                sid = src.stream_id
+                self._pending.setdefault(sid, []).append(batch)
+                bmax = int(batch.timestamps.max())
+                # gate residency: per-batch arrival stamp; an entry is
+                # retired only once the horizon passes ITS max ts
+                self._pending_t.setdefault(sid, []).append(
+                    (time.monotonic(), bmax)
+                )
+                if self._max_event_ts is None or bmax > self._max_event_ts:
+                    self._max_event_ts = bmax
                 # trace sampling stamps INGEST time (pre-reorder), so a
                 # completed trace includes watermark-gate queueing
                 self.tracer.stamp_ingest(batch.timestamps)
+                if timeout is not None:
+                    self._source_last_t[i] = now
+                    if self._source_idle[i]:
+                        # un-idle on the next event: its watermark claim
+                        # rejoins the min from this cycle on
+                        self._source_idle[i] = False
+                        self.telemetry.inc("idle.unidled")
+            elif timeout is not None and not self._source_idle[i]:
+                if self._source_last_t[i] is None:
+                    self._source_last_t[i] = now  # arm at first poll
+                if (now - self._source_last_t[i]) * 1e3 >= timeout:
+                    # temporarily idle: stops pinning the min watermark
+                    # (visible in metrics()["sources"] and /health)
+                    self._source_idle[i] = True
+                    self.telemetry.inc("idle.marked")
+                    _LOG.debug(
+                        "source %s idle for %.0fms; excluded from the "
+                        "min watermark until its next event",
+                        src.stream_id, (now - self._source_last_t[i]) * 1e3,
+                    )
             if swm is not None:
                 self._source_wm[i] = max(self._source_wm[i], swm)
             if done:
                 self._source_done[i] = True
                 self._source_wm[i] = MAX_WM
+                self._source_idle[i] = False
         if (
             self.max_pending_events is not None
             and self.shed_policy == "drop_oldest"
@@ -1938,7 +2085,16 @@ class Job:
 
     def _release_ready(self) -> List[EventBatch]:
         """Watermark gate: release per-stream prefixes with ts <= min
-        watermark (processing mode releases everything)."""
+        watermark (processing mode releases everything).
+
+        Event-time extras (docs/event_time.md): the gate watermark is
+        MONOTONE (idle-source un-idling cannot drag it back); under the
+        'allow' late policy the released horizon is held back by
+        ``allowed_lateness_ms`` so rows late by at most the allowance
+        still release in order; rows at or below the horizon already
+        released are LATE and go to :meth:`_handle_late`. Telemetry:
+        ``watermark.lag`` (max event time minus gate watermark) and
+        ``gate.residency`` (buffer age of released rows)."""
         if self.time_mode == "processing":
             ready = [
                 EventBatch.concat(bs).sort_by_time()
@@ -1946,20 +2102,180 @@ class Job:
                 if bs
             ]
             self._pending.clear()
+            self._pending_t.clear()
             return ready
-        wm = self._watermark()
+        raw = self._watermark()
+        # the MAX end-of-stream sentinel releases everything but is
+        # never PERSISTED as gate state: a checkpoint taken at stream
+        # end restores into jobs that continue with MORE data (the
+        # run-half + restore pattern), and a stored MAX horizon would
+        # classify every continuation row late
+        if raw != MAX_WM and raw > self._gate_wm:
+            self._gate_wm = raw
+        wm = MAX_WM if raw == MAX_WM else self._gate_wm
+        eff = wm
+        if (
+            self.late_policy == "allow"
+            and self.allowed_lateness_ms > 0
+            and wm != MAX_WM
+            and wm > MIN_WM
+        ):
+            # hold the released horizon back by the allowance: an
+            # admitted-late row still merges IN ORDER because nothing
+            # above (horizon - allowance) has been released yet
+            eff = wm - self.allowed_lateness_ms
+        tel = self.telemetry
+        if (
+            tel.enabled
+            and self._max_event_ts is not None
+            and MIN_WM < wm < MAX_WM
+        ):
+            tel.record_seconds(
+                "watermark.lag",
+                max(self._max_event_ts - wm, 0) / 1e3,
+            )
+        horizon = self._released_wm
         ready: List[EventBatch] = []
+        now = time.monotonic()
         for sid in list(self._pending):
             merged = EventBatch.concat(self._pending[sid]).sort_by_time()
-            n_ready = int(np.searchsorted(merged.timestamps, wm, side="right"))
+            if horizon > MIN_WM:
+                # rows at or below the horizon the gate ALREADY
+                # released past arrived too late to merge in order
+                n_late = int(
+                    np.searchsorted(
+                        merged.timestamps, horizon, side="right"
+                    )
+                )
+                if n_late:
+                    self._handle_late(merged.slice(0, n_late))
+                    merged = merged.slice(n_late, len(merged))
+            n_ready = int(np.searchsorted(merged.timestamps, eff, side="right"))
+            entries = self._pending_t.get(sid)
             if n_ready:
                 ready.append(merged.slice(0, n_ready))
+                if entries and tel.enabled:
+                    # buffer age of the oldest batch still pending at
+                    # this release: rows within a batch arrived
+                    # together, so this is row-exact at batch
+                    # granularity even across partial releases (the
+                    # 'allow' holdback keeps rows for the full
+                    # allowance, and the histogram must say so)
+                    tel.record_seconds(
+                        "gate.residency", now - entries[0][0]
+                    )
+            if entries is not None:
+                # retire batches the horizon fully released (all rows
+                # of a batch are <= its max ts); a partially-released
+                # batch keeps its stamp for the rows it still holds
+                while entries and entries[0][1] <= eff:
+                    entries.pop(0)
             rest = merged.slice(n_ready, len(merged))
             if len(rest):
                 self._pending[sid] = [rest]
             else:
                 del self._pending[sid]
+                self._pending_t.pop(sid, None)
+        if eff != MAX_WM:
+            if eff > self._released_wm:
+                self._released_wm = eff
+        elif (
+            self._max_event_ts is not None
+            and self._max_event_ts > self._released_wm
+        ):
+            # end of stream: everything observed has been released, so
+            # the max observed event time IS the horizon (exact), and
+            # unlike the MAX sentinel it survives checkpoint-restore
+            # into a continued stream
+            self._released_wm = self._max_event_ts
         return ready
+
+    def _handle_late(self, batch: EventBatch) -> None:
+        """Apply the configured late policy to rows below the released
+        horizon. Counters are EXACT (the disorder fault-injection tests
+        reconcile them against the injected schedule)."""
+        n = len(batch)
+        self.late_events += n
+        tel = self.telemetry
+        if self.late_policy == "side_output":
+            tel.inc("faults.late_side_output", n)
+            self._emit_late(batch)
+            return
+        self.late_dropped += n
+        tel.inc("faults.late_dropped", n)
+        now = time.monotonic()
+        if now - self._late_warned_at >= 1.0:
+            self._late_warned_at = now
+            if self.late_policy == "allow":
+                _LOG.warning(
+                    "%s: %d rows later than allowed_lateness_ms=%d "
+                    "dropped (%d total). Admitting them would require "
+                    "window RE-FIRE — retracting and re-emitting "
+                    "already-released panes per the Dataflow model's "
+                    "accumulation modes (PAPERS.md #5) — which this "
+                    "engine rejects by design; see docs/event_time.md. "
+                    "Raise allowed_lateness_ms or route them with "
+                    "late_policy='side_output'.",
+                    batch.stream_id, n, self.allowed_lateness_ms,
+                    self.late_dropped,
+                )
+            else:
+                _LOG.warning(
+                    "%s: %d late rows dropped below the released "
+                    "watermark (%d total; policy 'drop'). Use "
+                    "late_policy='side_output' to capture them, or "
+                    "'allow' + allowed_lateness_ms to admit bounded "
+                    "lateness in order (docs/event_time.md).",
+                    batch.stream_id, n, self.late_dropped,
+                )
+
+    def _emit_late(self, batch: EventBatch) -> None:
+        """'side_output' delivery: the FULL input rows surface on the
+        dedicated late channel ``late_stream(stream_id)`` — retained in
+        collected[] under that id when retention is on, delivered to
+        its sinks either way (ColumnarSink-capable: whole decoded
+        column arrays, no per-row tuples for columnar-only consumers).
+        """
+        sid = late_stream(batch.stream_id)
+        schema = batch.schema
+        names = list(schema.field_names)
+        self.output_fields.setdefault(sid, names)
+        self.emitted_counts[sid] = (
+            self.emitted_counts.get(sid, 0) + len(batch)
+        )
+        sinks = self._sinks.get(sid) or []
+        col_sinks = [s for s in sinks if hasattr(s, "accept_columns")]
+        row_sinks = [s for s in sinks if not hasattr(s, "accept_columns")]
+        need_rows = bool(row_sinks) or self.retain_results
+        if col_sinks:
+            cols: Dict[str, np.ndarray] = {}
+            for name in names:
+                col = batch.columns[name]
+                if schema.field_type(name).is_encoded:
+                    cols[name] = np.asarray(
+                        schema.string_tables[name].decode(col),
+                        dtype=object,
+                    )
+                else:
+                    cols[name] = col
+            with self.telemetry.span("sink"):
+                for sink in col_sinks:
+                    sink.accept_columns(batch.timestamps, cols)
+        if not need_rows:
+            return
+        rows = [
+            (int(ts), tuple(rec[n] for n in names))
+            for ts, rec in zip(
+                batch.timestamps.tolist(), batch.records()
+            )
+        ]
+        if self.retain_results:
+            self.collected.setdefault(sid, []).extend(rows)
+        if row_sinks:
+            with self.telemetry.span("sink"):
+                for ts, row in rows:
+                    for sink in row_sinks:
+                        sink(ts, row)
 
     def _plan_windows(
         self, rt: _PlanRuntime, ready: List[EventBatch]
@@ -2464,6 +2780,29 @@ class Job:
                 len(b) for b in list(self._pending.values())
             ),
             "watermark": None if wm in (MAX_WM, MIN_WM) else wm,
+            # event-time robustness view (docs/event_time.md): per-
+            # source watermark + idle state, and the late-row account
+            "sources": [
+                {
+                    "stream_id": getattr(src, "stream_id", None),
+                    "watermark": (
+                        None if swm in (MAX_WM, MIN_WM) else int(swm)
+                    ),
+                    "idle": bool(idle),
+                    "done": bool(done_),
+                }
+                for src, swm, idle, done_ in zip(
+                    list(self._sources),
+                    list(self._source_wm),
+                    list(self._source_idle)
+                    + [False] * len(self._sources),
+                    list(self._source_done),
+                )
+            ],
+            "idle_sources": self.idle_source_ids(),
+            "late_events": self.late_events,
+            "late_dropped": self.late_dropped,
+            "late_policy": self.late_policy,
             # stage-attributed wall clock, latency histograms (drain.*
             # legs at least; jobs under bench add more), counters —
             # an atomic registry snapshot, safe off-thread

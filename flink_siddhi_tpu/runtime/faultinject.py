@@ -2,12 +2,17 @@
 
 Failure is a first-class, injected, measured input here (PAPERS.md #4:
 claims only count under load the system survives — the same standard
-applied to recovery). This module is the process-death half of the
+applied to recovery). This module holds the process-death half of the
 harness, shared by the property tests (tests/faults.py re-exports it
 next to the wire-fault ``FaultSchedule``) and by ``bench.py --fault``
 (the measured-recovery block) — one implementation, so the debris a
 "dying writer" leaves and the pull-boundary crash semantics cannot
-drift between the tests and the bench.
+drift between the tests and the bench — and the EVENT-TIME half:
+:class:`DisorderSchedule` / :class:`DisorderSource` inject seeded
+arrival disorder (bounded skew, bursty duplicates, late stragglers,
+idle partitions) with an exact injected account, shared by the
+disorder oracle tests (tests/test_event_time.py) and ``bench.py
+--disorder`` (docs/event_time.md).
 
 :class:`CrashPlan` + :func:`wrap_job` inject crashes into a SUPERVISED
 job: at scheduled source-pull boundaries (mode-agnostic: streaming
@@ -23,9 +28,18 @@ supervised LIFETIME.
 
 from __future__ import annotations
 
-from typing import Sequence
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
 
-__all__ = ["CrashPlan", "InjectedCrash", "wrap_job"]
+import numpy as np
+
+__all__ = [
+    "CrashPlan",
+    "DisorderSchedule",
+    "DisorderSource",
+    "InjectedCrash",
+    "wrap_job",
+]
 
 
 class InjectedCrash(RuntimeError):
@@ -71,6 +85,252 @@ class CrashPlan:
             raise InjectedCrash(
                 f"killed mid-checkpoint {self.checkpoints}"
             )
+
+
+# -- event-time disorder injection (docs/event_time.md) ---------------------
+
+@dataclass(frozen=True)
+class DisorderSchedule:
+    """Seeded event-time disorder over a recorded stream.
+
+    Four production failure shapes, composable, all DETERMINISTIC from
+    the seed (the late/dup counters the engine reports must reconcile
+    EXACTLY against what was injected — tests and ``bench.py
+    --disorder`` both assert it):
+
+    * ``skew_ms``       — bounded arrival-order shuffle: each event's
+      arrival is displaced by a seeded delay drawn from
+      ``[0, skew_ms)`` event-time ms. An engine watermarking with
+      ``BoundedDisorderWatermark(skew_ms)`` (same bound) re-sorts the
+      stream EXACTLY — zero late rows by construction (the half-open
+      draw keeps the boundary tie out of the late class).
+    * ``dup_rate``/``dup_burst`` — bursty duplicates: a seeded
+      fraction of events is re-emitted ``dup_burst`` extra times,
+      adjacent to the original (the at-least-once-redelivery shape).
+      Duplicates are REAL events to the engine and to the oracle.
+    * ``late_count``/``late_release_ms`` — late stragglers: seeded
+      picks held back and re-injected only after the stream has
+      advanced ``late_release_ms`` of event time past them AND at
+      least one micro-batch boundary — guaranteed below the released
+      watermark of any strategy whose skew is < ``late_release_ms``,
+      so the engine's late policy (not the reorder buffer) must handle
+      them.
+    * ``idle_gap_every``/``idle_gap_polls`` — idle partition: every
+      Nth poll the source goes silent for a run of polls (no batch, no
+      watermark claim), the shape that pins a min-watermark without
+      idle-source handling.
+    """
+
+    seed: int = 0
+    skew_ms: int = 0
+    dup_rate: float = 0.0
+    dup_burst: int = 2
+    late_count: int = 0
+    late_release_ms: int = 0
+    idle_gap_every: int = 0
+    idle_gap_polls: int = 0
+
+    def arrival(
+        self, ts, chunk: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Arrival plan over a pristine timestamp array.
+
+        Returns ``(order, dup_log, late_log)``: ``order`` indexes the
+        pristine arrays in ARRIVAL order (a duplicated index appears
+        ``dup_burst`` extra times, adjacent; a straggler index appears
+        displaced at least two ``chunk``-sized micro-batches past the
+        first arrival position whose running max event time reaches
+        ``its ts + late_release_ms``). ``dup_log``/``late_log`` are the
+        pristine indices duplicated / made stragglers — the EXACT
+        injected account."""
+        ts = np.asarray(ts, dtype=np.int64)
+        n = len(ts)
+        chunk = max(int(chunk), 1)
+        rng = np.random.default_rng(self.seed)
+        if self.skew_ms > 0:
+            # half-open [0, skew): an event's arrival key never ties
+            # the skew bound, so a strategy with the SAME skew never
+            # classifies a shuffled (non-straggler) row late
+            delays = rng.integers(0, self.skew_ms, n, dtype=np.int64)
+        else:
+            delays = np.zeros(n, dtype=np.int64)
+        keys = ts + delays
+        order = np.argsort(keys, kind="stable")
+        # stragglers: seeded picks among events whose release threshold
+        # (ts + late_release_ms, pessimistically + skew for arrival
+        # displacement) is crossed at least THREE chunks before the
+        # stream end — a straggler placed in the stream's final
+        # micro-batch could still merge in order (the horizon only
+        # advances at batch boundaries), which would silently shrink
+        # the injected-late account
+        late_log = np.empty(0, dtype=np.int64)
+        if self.late_count > 0:
+            ts_sorted = np.sort(ts)
+            thr_pos = np.searchsorted(
+                ts_sorted,
+                ts + int(self.late_release_ms) + int(self.skew_ms),
+            )
+            eligible = np.nonzero(thr_pos <= n - 3 * chunk)[0]
+            if len(eligible) < self.late_count:
+                raise ValueError(
+                    f"late_count={self.late_count} stragglers need "
+                    "their release threshold crossed >= 3 chunks "
+                    f"before the stream end; only {len(eligible)} "
+                    "events qualify (lengthen the stream or shrink "
+                    "late_release_ms/chunk)"
+                )
+            late_log = np.sort(
+                rng.choice(eligible, size=self.late_count, replace=False)
+            )
+        is_late = np.zeros(n, dtype=bool)
+        is_late[late_log] = True
+        base = order[~is_late[order]]
+        # bursty duplicates among the normally-arriving events
+        dup_log = np.empty(0, dtype=np.int64)
+        counts = np.ones(len(base), dtype=np.int64)
+        if self.dup_rate > 0.0:
+            dmask = rng.random(len(base)) < self.dup_rate
+            counts[dmask] += int(self.dup_burst)
+            dup_log = np.sort(base[dmask])
+        expanded = np.repeat(base, counts)
+        # straggler placement: two whole micro-batches past the
+        # position where the running max crosses the release
+        # threshold (one boundary guarantees a separate cycle; the
+        # second absorbs the index shift earlier insertions cause)
+        if len(late_log):
+            run_max = np.maximum.accumulate(ts[expanded])
+            pos = []
+            for i in late_log.tolist():
+                p = int(
+                    np.searchsorted(
+                        run_max, ts[i] + int(self.late_release_ms),
+                        side="left",
+                    )
+                )
+                q = (p // chunk + 2) * chunk
+                if q + len(late_log) > len(expanded):
+                    # backstop for the eligibility margin above: a
+                    # straggler that cannot be separated from its
+                    # threshold by a batch boundary is not a straggler
+                    raise ValueError(
+                        f"straggler (ts={int(ts[i])}) cannot be placed "
+                        ">= 2 chunks past its release threshold; the "
+                        "stream is too short for this schedule"
+                    )
+                pos.append(q)
+            expanded = np.insert(
+                expanded, np.asarray(pos, dtype=np.int64), late_log
+            )
+        return expanded, dup_log, late_log
+
+
+class DisorderSource:
+    """Wrap a BOUNDED source with a :class:`DisorderSchedule`.
+
+    The inner source is drained at construction (this is a test/bench
+    harness, not a production transport: the whole stream must be in
+    hand to place stragglers exactly), rearranged by
+    ``schedule.arrival``, and served back in ``chunk``-sized polls with
+    idle gaps injected on the schedule. Publishes NO watermark claim —
+    compose with :func:`runtime.sources.with_watermarks` (that is the
+    point: watermark GENERATION is what is under test). Exposes the
+    exact injected account (``injected``, ``dup_log``, ``late_log``)
+    and the pristine stream (``pristine``) for oracle construction.
+
+    Checkpointable by position: the arranged sequence is a pure
+    function of (schedule, inner stream), so a rebuilt wrapper over
+    the same inner restores exactly (supervised kill->restore runs
+    ride it)."""
+
+    def __init__(self, inner, schedule: DisorderSchedule,
+                 chunk: int = 4096) -> None:
+        from ..schema.batch import EventBatch
+
+        self.stream_id = inner.stream_id
+        self.schema = inner.schema
+        self.schedule = schedule
+        self._chunk = max(int(chunk), 1)
+        batches = []
+        guard = 0
+        while True:
+            batch, _wm, done = inner.poll(1 << 16)
+            if batch is not None and len(batch):
+                batches.append(batch)
+            if done:
+                break
+            guard += 1
+            if batch is None and guard > 1_000_000:
+                raise ValueError(
+                    "DisorderSource needs a bounded inner source "
+                    "(1M empty polls without done)"
+                )
+        if not batches:
+            raise ValueError("inner source produced no events")
+        self.pristine = EventBatch.concat(batches)
+        order, dup_log, late_log = schedule.arrival(
+            self.pristine.timestamps, self._chunk
+        )
+        self._arranged = self.pristine.take(order)
+        self.order = order
+        self.dup_log = dup_log
+        self.late_log = late_log
+        self.injected = {
+            "duplicates": int(len(dup_log) * schedule.dup_burst),
+            "late": int(len(late_log)),
+            "idle_gaps": 0,
+            "idle_polls": 0,
+        }
+        self._pos = 0
+        self._polls = 0
+        self._gap_left = 0
+        self._gap_fresh = False
+
+    def poll(self, max_events: int):
+        if self._pos >= len(self._arranged):
+            return None, np.iinfo(np.int64).max, True
+        if self._gap_left > 0:
+            # injected idle partition: silence, no watermark claim. A
+            # gap counts as injected only when its first silent poll is
+            # actually SERVED — a gap scheduled on the stream's last
+            # data poll never happens (the injected account must match
+            # what the engine could observe)
+            if self._gap_fresh:
+                self.injected["idle_gaps"] += 1
+                self._gap_fresh = False
+            self._gap_left -= 1
+            self.injected["idle_polls"] += 1
+            return None, None, False
+        self._polls += 1
+        every = self.schedule.idle_gap_every
+        if every and self._polls % every == 0:
+            self._gap_left = max(int(self.schedule.idle_gap_polls), 0)
+            self._gap_fresh = self._gap_left > 0
+        n = min(max_events, self._chunk,
+                len(self._arranged) - self._pos)
+        lo, hi = self._pos, self._pos + n
+        self._pos = hi
+        done = self._pos >= len(self._arranged)
+        wm = np.iinfo(np.int64).max if done else None
+        return self._arranged.slice(lo, hi), wm, done
+
+    # -- checkpoint support -------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "pos": self._pos,
+            "polls": self._polls,
+            "gap_left": self._gap_left,
+            "idle_polls": self.injected["idle_polls"],
+            "idle_gaps": self.injected["idle_gaps"],
+            "gap_fresh": self._gap_fresh,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        self._pos = int(d["pos"])
+        self._polls = int(d.get("polls", 0))
+        self._gap_left = int(d.get("gap_left", 0))
+        self._gap_fresh = bool(d.get("gap_fresh", False))
+        self.injected["idle_polls"] = int(d.get("idle_polls", 0))
+        self.injected["idle_gaps"] = int(d.get("idle_gaps", 0))
 
 
 def wrap_job(job, plan: CrashPlan):

@@ -544,6 +544,7 @@ class KafkaSource(Source):
         max_bytes: int = 1 << 20,
         allowed_lateness_ms: int = 0,
         client: Optional[KafkaClient] = None,
+        watermark=None,  # WatermarkStrategy template, cloned per partition
     ) -> None:
         from .sources import make_column_decoder
 
@@ -587,6 +588,32 @@ class KafkaSource(Source):
         # 'arrival'. Re-deciding per batch would let one magic-0
         # message flip the basis mid-stream and wreck the watermark.
         self._ts_basis = "field" if ts_field is not None else None
+        # per-partition watermark generation (docs/event_time.md): one
+        # strategy clone per assigned partition, each observing only
+        # its own records' event times; the SOURCE watermark is the min
+        # across partitions that have produced at least one record (two
+        # partitions never arrive aligned — the min is what makes the
+        # claim safe). A partition that has never produced does not pin
+        # the min; once it produces, its strategy joins it. Without a
+        # strategy the historical max-ts-minus-allowed_lateness claim
+        # stands.
+        self._wm_template = watermark
+        self._wm_strategies = (
+            {p: watermark.clone() for p in parts}
+            if watermark is not None
+            else None
+        )
+
+    def _partition_watermark(self) -> Optional[int]:
+        """min across partitions that have observed >= 1 record."""
+        wms = [
+            w
+            for w in (
+                s.current() for s in self._wm_strategies.values()
+            )
+            if w is not None
+        ]
+        return min(wms) if wms else None
 
     def close(self) -> None:
         """Stop consuming after the current backlog drains."""
@@ -688,11 +715,26 @@ class KafkaSource(Source):
             ts = self._arrival + np.arange(n, dtype=np.int64)
             self._arrival += n
         keep = valid.astype(bool)
+        pids = np.fromiter((t[0] for t in take), np.int32, len(take))
         if not keep.all():
             columns = {k: v[keep] for k, v in columns.items()}
             ts = ts[keep]
+            pids = pids[keep]
         batch = EventBatch(self.stream_id, self.schema, columns, ts)
-        wm = int(ts.max()) - self._lateness if len(ts) else None
+        if self._wm_strategies is not None:
+            # per-partition generation: each partition's strategy sees
+            # only its own records' event times; the published claim is
+            # the min across producing partitions
+            for p in np.unique(pids).tolist():
+                strat = self._wm_strategies.get(p)
+                if strat is None:  # defensive: unassigned pid appeared
+                    strat = self._wm_strategies[p] = (
+                        self._wm_template.clone()
+                    )
+                strat.observe(ts[pids == p])
+            wm = self._partition_watermark()
+        else:
+            wm = int(ts.max()) - self._lateness if len(ts) else None
         done = self._closed and not backlog
         if done:
             wm = np.iinfo(np.int64).max
@@ -701,14 +743,32 @@ class KafkaSource(Source):
 
     # -- checkpoint: CONSUMED offsets are the source position -------------
     def state_dict(self) -> dict:
-        return {
+        d = {
             "offsets": {str(p): o for p, o in self.offsets.items()},
             "arrival": self._arrival,
             "ts_basis": self._ts_basis,
         }
+        if self._wm_strategies is not None:
+            # per-partition watermark state rides the checkpoint: a
+            # restored source must not re-publish an early watermark
+            # (it would re-admit rows the gate already classified)
+            d["wm"] = {
+                str(p): s.state_dict()
+                for p, s in self._wm_strategies.items()
+            }
+        return d
 
     def load_state_dict(self, d: dict) -> None:
         self.offsets = {int(p): int(o) for p, o in d["offsets"].items()}
+        if d.get("wm") is not None and self._wm_strategies is not None:
+            for p, sd in d["wm"].items():
+                strat = self._wm_strategies.get(int(p))
+                if strat is None and self._wm_template is not None:
+                    strat = self._wm_strategies[int(p)] = (
+                        self._wm_template.clone()
+                    )
+                if strat is not None:
+                    strat.load_state_dict(sd)
         # fetched-but-unconsumed records are not part of the snapshot:
         # refetch from the restored consumed position (v2 fetches
         # return the whole containing batch; _refill skips the

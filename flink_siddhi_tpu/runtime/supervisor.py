@@ -339,5 +339,14 @@ class Supervisor:
             "processed_events": (
                 int(job.processed_events) if job is not None else None
             ),
+            # event-time robustness (docs/event_time.md): a probe can
+            # alert on a silent topic (idle_sources) or a late-row
+            # flood without scraping the full metrics route
+            "idle_sources": (
+                job.idle_source_ids() if job is not None else []
+            ),
+            "late_dropped": (
+                int(job.late_dropped) if job is not None else None
+            ),
             "telemetry": self.telemetry.snapshot(),
         }

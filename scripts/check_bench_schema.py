@@ -57,6 +57,23 @@ the dispatch-bound contract:
   exists to close exactly this gap. Pre-v5 files (BENCH_r01..r05)
   are exempt.
 
+Schema v6 (event-time robustness round, bench.py ``schema_version:
+6``) adds the disorder contract:
+
+* the line carries a ``disorder`` block with one run per skew in
+  {0, 1000, 10000} ms: the stream arrival-shuffled/duplicated/
+  straggled/idle-gapped by a seeded DisorderSchedule, the job
+  watermarking with BoundedDisorderWatermark(skew) in EVENT-time mode;
+* each run's ``events_per_sec`` and ``p99_ms`` must be present and
+  finite (throughput + tail under sustained DISORDERED load — the
+  Karimov standard applied to disorder);
+* the late/dup/idle accounting must be EXACT against the injected
+  schedule: ``late_dropped`` == ``injected.late``, ``idle_marked`` ==
+  ``injected.idle_gaps``, ``processed_events`` == ``events`` +
+  ``injected.duplicates`` - ``late_dropped``, and ``counts_exact``
+  must be true. Pre-v6 files are exempt; a ``disorder`` block present
+  in any version is validated.
+
 Optional ``recovery`` block (``bench.py --fault``, any version): when
 present it must carry a finite positive measured ``recovery_time_ms``,
 at least one injected crash, ``stale_tmp_swept: true``, and EXACT
@@ -440,6 +457,108 @@ def validate_v5(doc, errors: List[str], where: str) -> None:
             )
 
 
+DISORDER_SKEWS_MS = (0, 1_000, 10_000)
+
+
+def validate_disorder(dis, errors: List[str], where: str) -> None:
+    """The schema-v6 ``disorder`` block: ev/s + p99 per skew, with the
+    late/dup/idle accounting EXACT against the injected schedule — a
+    disorder line whose counters drift from what was injected is a
+    silently-wrong engine, not a benchmark."""
+    where = f"{where}:disorder"
+    if not isinstance(dis, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    runs = dis.get("runs")
+    if not isinstance(runs, list) or not runs:
+        errors.append(f"{where}: runs missing/empty")
+        return
+    seen = set()
+    for run in runs:
+        if not isinstance(run, dict):
+            errors.append(f"{where}: run entries must be objects")
+            continue
+        skew = run.get("skew_ms")
+        rw = f"{where}:skew={skew}"
+        if not isinstance(skew, int) or isinstance(skew, bool) or skew < 0:
+            errors.append(f"{rw}: skew_ms missing/non-int ({skew!r})")
+            continue
+        seen.add(skew)
+        ev = run.get("events_per_sec")
+        if not _finite(ev) or ev <= 0:
+            errors.append(
+                f"{rw}: events_per_sec missing/non-positive ({ev!r})"
+            )
+        p99 = run.get("p99_ms")
+        if not _finite(p99):
+            errors.append(f"{rw}: p99_ms missing/non-finite ({p99!r})")
+        inj = run.get("injected")
+        if not isinstance(inj, dict):
+            errors.append(f"{rw}: injected block missing")
+            continue
+        for key in ("duplicates", "late", "idle_gaps"):
+            v = inj.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(
+                    f"{rw}: injected.{key} missing/non-int ({v!r})"
+                )
+        for key in (
+            "events", "late_dropped", "idle_marked", "processed_events",
+        ):
+            v = run.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"{rw}: {key} missing/non-int ({v!r})")
+        if run.get("late_dropped") != inj.get("late"):
+            errors.append(
+                f"{rw}: late_dropped {run.get('late_dropped')!r} != "
+                f"injected.late {inj.get('late')!r} — the late account "
+                "drifted from the injected schedule"
+            )
+        if run.get("idle_marked") != inj.get("idle_gaps"):
+            errors.append(
+                f"{rw}: idle_marked {run.get('idle_marked')!r} != "
+                f"injected.idle_gaps {inj.get('idle_gaps')!r} — idle "
+                "gaps the engine never marked (or marked twice)"
+            )
+        if (
+            isinstance(run.get("events"), int)
+            and isinstance(inj.get("duplicates"), int)
+            and isinstance(run.get("late_dropped"), int)
+            and run.get("processed_events")
+            != run["events"] + inj["duplicates"] - run["late_dropped"]
+        ):
+            errors.append(
+                f"{rw}: processed_events {run.get('processed_events')!r}"
+                f" != events {run['events']} + duplicates "
+                f"{inj['duplicates']} - late_dropped "
+                f"{run['late_dropped']} — duplicate accounting drifted"
+            )
+        if run.get("counts_exact") is not True:
+            errors.append(
+                f"{rw}: counts_exact must be true — the engine's "
+                "late/dup/idle counters must reconcile exactly with "
+                "the injected schedule"
+            )
+    missing = set(DISORDER_SKEWS_MS) - seen
+    if missing:
+        errors.append(
+            f"{where}: runs missing skew(s) {sorted(missing)} — the "
+            "contract is ev/s + p99 at 0/1s/10s skew"
+        )
+
+
+def validate_v6(doc, errors: List[str], where: str) -> None:
+    """The event-time disorder contract (on top of v3/v4/v5)."""
+    dis = doc.get("disorder")
+    if dis is None:
+        errors.append(
+            f"{where}: disorder block missing (schema v6 requires the "
+            "0/1s/10s-skew disorder sweep)"
+        )
+    else:
+        validate_disorder(dis, errors, where)
+
+
 def validate_recovery(rec, errors: List[str], where: str) -> None:
     """The ``--fault`` recovery block (optional in every version; when
     present it must carry real measurements and the exactly-once
@@ -533,6 +652,12 @@ def validate_doc(
         validate_v4(doc, errors, where)
     if version >= 5:
         validate_v5(doc, errors, where)
+    if version >= 6:
+        validate_v6(doc, errors, where)
+    elif "disorder" in doc:
+        # pre-v6 lines are exempt from requiring the block, but one
+        # that IS present must hold to its contract
+        validate_disorder(doc["disorder"], errors, where)
     if "recovery" in doc:
         validate_recovery(doc["recovery"], errors, where)
 

@@ -469,6 +469,119 @@ def test_v4_era_docs_unaffected_by_v5_gate():
     assert errors == []
 
 
+# -- schema v6: the event-time disorder contract ---------------------------
+
+def _v6_run(skew, **over):
+    run = {
+        "skew_ms": skew,
+        "events": 60_000,
+        "events_per_sec": 45_000.0,
+        "p99_ms": 3.2,
+        "p50_ms": 0.4,
+        "elapsed_s": 1.3,
+        "injected": {
+            "duplicates": 124, "late": 20,
+            "idle_gaps": 2, "idle_polls": 4,
+        },
+        "late_dropped": 20,
+        "idle_marked": 2,
+        "processed_events": 60_000 + 124 - 20,
+        "counts_exact": True,
+    }
+    run.update(over)
+    return run
+
+
+def _v6_doc(**over):
+    doc = _v5_doc()
+    doc["schema_version"] = 6
+    doc["disorder"] = {
+        "config": "headline",
+        "late_policy": "drop",
+        "watermark": "BoundedDisorderWatermark(skew)",
+        "runs": [_v6_run(s) for s in (0, 1_000, 10_000)],
+    }
+    doc.update(over)
+    return doc
+
+
+def test_valid_v6_doc_passes():
+    errors = []
+    CHECK.validate_doc(_v6_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v6_requires_disorder_block():
+    doc = _v6_doc()
+    del doc["disorder"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("disorder block missing" in e for e in errors)
+
+
+def test_v6_requires_all_three_skews():
+    doc = _v6_doc()
+    doc["disorder"]["runs"] = doc["disorder"]["runs"][:2]  # drop 10s
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("missing skew" in e for e in errors)
+
+
+def test_v6_requires_finite_throughput_and_p99():
+    for bad in (
+        {"events_per_sec": None},
+        {"events_per_sec": 0},
+        {"p99_ms": None},
+        {"p99_ms": float("nan")},
+    ):
+        doc = _v6_doc()
+        doc["disorder"]["runs"][1] = _v6_run(1_000, **bad)
+        errors = []
+        CHECK.validate_doc(doc, errors, "doc")
+        assert errors, bad
+
+
+def test_v6_accounting_must_match_injected_schedule():
+    # late counter drifted from the injected stragglers
+    doc = _v6_doc()
+    doc["disorder"]["runs"][0] = _v6_run(0, late_dropped=19)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("late account drifted" in e for e in errors)
+    # idle marks drifted from the injected gaps
+    doc = _v6_doc()
+    doc["disorder"]["runs"][2] = _v6_run(10_000, idle_marked=1)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("idle" in e and "never marked" in e for e in errors)
+    # duplicate reconciliation: processed != events + dups - late
+    doc = _v6_doc()
+    doc["disorder"]["runs"][0] = _v6_run(0, processed_events=60_000)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("duplicate accounting drifted" in e for e in errors)
+    # a declared counts_exact=false is itself a failure
+    doc = _v6_doc()
+    doc["disorder"]["runs"][0] = _v6_run(0, counts_exact=False)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("counts_exact" in e for e in errors)
+
+
+def test_v5_era_docs_unaffected_by_v6_gate():
+    """BENCH files predating v6 carry no disorder block; the
+    requirement applies from schema_version 6 only — but a disorder
+    block PRESENT in an older line is still held to its contract."""
+    errors = []
+    CHECK.validate_doc(_v5_doc(), errors, "doc")
+    assert errors == []
+    doc = _v5_doc()
+    doc["disorder"] = {"runs": [_v6_run(0, late_dropped=1)]}
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("late account drifted" in e for e in errors)
+
+
 # -- optional recovery block (bench.py --fault) ----------------------------
 
 
@@ -578,13 +691,14 @@ def test_fault_block_live_and_gate_accepts():
     assert errors == []
 
 
-def test_dryrun_emits_schema_complete_v5(tmp_path):
+def test_dryrun_emits_schema_complete_v6(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
-    replay, short paced phase) exercises resident + streaming + sink
-    AND the out-of-process prober, and its JSON line passes the v5
-    schema gate — in the tier-1 lane, under its timeout. (The --fault
-    recovery block has its own in-process live test below, so this
-    subprocess stays at its historical cost.)"""
+    replay, short paced phase) exercises resident + streaming + sink,
+    the out-of-process prober, AND the small-skew disorder sweep, and
+    its JSON line passes the v6 schema gate — in the tier-1 lane,
+    under its timeout. (The --fault recovery block has its own
+    in-process live test below, so this subprocess stays at its
+    historical cost.)"""
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
@@ -633,7 +747,7 @@ def test_dryrun_emits_schema_complete_v5(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 5
+    assert doc["schema_version"] == 6
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]
@@ -673,6 +787,16 @@ def test_dryrun_emits_schema_complete_v5(tmp_path):
     assert 0.0 <= stream_fu["h2d_overlap_frac"] <= 1.0
     assert math.isfinite(doc["streaming_vs_resident_ratio"])
     assert doc["fusion_target"]["verdict"] == "met"
+    # the v6 additions: the disorder sweep really ran at all three
+    # skews in event-time mode with EXACT late/dup/idle accounting
+    runs = {r["skew_ms"]: r for r in doc["disorder"]["runs"]}
+    assert set(runs) == {0, 1_000, 10_000}
+    for skew, run in runs.items():
+        assert run["counts_exact"] is True, (skew, run)
+        assert run["late_dropped"] == run["injected"]["late"] > 0
+        assert run["idle_marked"] == run["injected"]["idle_gaps"] > 0
+        assert run["events_per_sec"] > 0
+        assert math.isfinite(run["p99_ms"])
 
 
 def test_repo_bench_files_validate():
