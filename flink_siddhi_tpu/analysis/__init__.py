@@ -1,19 +1,27 @@
 """Repo-specific static correctness tooling.
 
-Two halves, both wired into the tier-1 lane
+Three layers, all wired into the tier-1 lane
 (scripts/run_static_analysis.py; docs/static_analysis.md):
 
 * ``fstlint`` — an AST linter whose rule set is drawn from JAX hazard
   classes this repo has actually shipped: donation-after-use (the PR 7
   checkpoint-restore aliasing bug), host-sync-in-hot-path, falsy-zero
   ``or``-defaults (the PR 8 ``drain_interval_ms=0`` bug), tracer leaks,
-  and unbounded retraces (the sticky wire-kind widening class).
+  unbounded retraces (the sticky wire-kind widening class), and
+  checkpoint-state completeness (the PR 10 forgotten-gate-state class).
 * ``plancheck`` — a compiled-plan verifier validating invariants of the
   artifact stack the compiler emits (shape/dtype agreement, slot-NFA
   table well-formedness, padded-stack inertness, donation safety)
   before it reaches the device; run at ``compile()`` time behind
   ``EngineConfig.verify_plans`` / ``FST_VERIFY_PLANS=1`` and standalone
   over the query zoo in CI.
+* ``admit`` — admission-time resource analysis over the same compiled
+  plan: worst-case HBM state footprint, per-event output
+  amplification, unbounded-residency rejection, and the shape-bucket
+  plan signature (the control plane's AOT executable-cache key), with
+  ADM-series verdicts against configurable ``AdmissionBudgets``
+  (``EngineConfig.admission_budgets``) and a hostile query zoo that
+  must be rejected by exact rule id.
 
 The analog of the reference's parse-time plan validation
 (SiddhiManager.validateExecutionPlan — every SiddhiQL plan is checked
@@ -21,11 +29,33 @@ before it ever runs): our compiler emits artifact stacks into a donated,
 jitted, scanned hot loop, so the machine-checkable invariants live here.
 """
 
+from .admit import (
+    ADM_RULES,
+    AdmissionBudgets,
+    AdmissionError,
+    AdmissionIssue,
+    AdmissionReport,
+    DEFAULT_BUDGETS,
+    STRICT_BUDGETS,
+    admit_plan,
+    analyze_plan,
+    plan_signature,
+)
 from .findings import Finding, RULES
 from .fstlint import lint_paths, main
 from .plancheck import PlanCheckError, PlanIssue, verify_plan
 
 __all__ = [
+    "ADM_RULES",
+    "AdmissionBudgets",
+    "AdmissionError",
+    "AdmissionIssue",
+    "AdmissionReport",
+    "DEFAULT_BUDGETS",
+    "STRICT_BUDGETS",
+    "admit_plan",
+    "analyze_plan",
+    "plan_signature",
     "Finding",
     "RULES",
     "lint_paths",

@@ -1,0 +1,563 @@
+"""Admission-time static analysis: resource bounds, plan signatures,
+verdicts.
+
+plancheck (PR 9) answers "is this compiled artifact stack WELL-FORMED";
+this module answers the question the dynamic control plane (ROADMAP
+direction #1) has to ask before a tenant query touches the running
+stack: "what does it COST, is that cost bounded, and which AOT shape
+class does it belong to". All three analyses run over the compiled
+plan at the same hook point as plancheck — no XLA compile, no device
+allocation:
+
+* **resource bounds** — worst-case HBM state footprint (window rings at
+  their declared/bucketed capacities, slot-NFA pools, sketch/group
+  tables, the device output accumulator) via ``jax.eval_shape`` of the
+  plan's state constructors, plus per-event output amplification and
+  residency facts from per-artifact ``cost_info()`` hooks (the cost
+  twin of PR 9's ``nfa_check_info()``).
+* **unbounded-state detection** — per the Dataflow model (Akidau et
+  al., VLDB 2015; PAPERS.md #5) unbounded out-of-order state must be
+  *explicitly* bounded: an ``every`` pattern with no ``within`` clause
+  pins partial-match slots forever, and a window-less join side retains
+  semantically-unbounded history (the engine truncates both at fixed
+  capacity with counted overflow — i.e. silently degraded answers, not
+  memory growth). Under a residency budget these are REJECTED, not
+  estimated.
+* **shape-bucket plan signatures** — a canonical, process-stable hash
+  of the step's shape/dtype fixed point (states/acc/outputs) plus the
+  bucket-padded tape dims and a constants-masked structural descriptor.
+  This is the control plane's AOT executable-cache key: the ~3.4 s
+  first compile is paid once per *shape class*, not once per query.
+  Contract (property-tested in tests/test_admit.py): two queries
+  differing only in constants collide; a window width (or batch size)
+  change that crosses a shape/bucket boundary splits.
+
+Verdicts are findings with ADM-series rule ids evaluated against a
+configurable :class:`AdmissionBudgets`; ``compile_plan`` wires this in
+behind ``EngineConfig.admission_budgets`` / ``FST_VERIFY_PLANS`` tiers
+exactly like plancheck (docs/static_analysis.md has the rule
+reference). Per Karimov et al. (ICDE 2018; PAPERS.md #4), a sustainable
+multi-tenant service must know a workload's resource envelope *before*
+it runs — this module is that envelope, statically decided.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# rule id -> one-line description (docs/static_analysis.md is the full
+# reference; scripts/run_static_analysis.py prints these on rejection)
+ADM_RULES = {
+    "ADM001": (
+        "artifact exposes no cost_info() hook — its resource envelope "
+        "is unknowable, so admission rejects it (conservative default: "
+        "a new artifact class must declare its costs in the PR that "
+        "adds it, like nfa_check_info/zoo rows)"
+    ),
+    "ADM002": (
+        "malformed cost_info(): hook returned something the analyzer "
+        "cannot read (missing keys / wrong types)"
+    ),
+    "ADM003": (
+        "footprint analysis failed: the plan's state constructors do "
+        "not trace under eval_shape"
+    ),
+    "ADM101": "worst-case device state footprint exceeds the budget",
+    "ADM102": "device output accumulator footprint exceeds the budget",
+    "ADM110": (
+        "unbounded slot residency: an 'every' pattern with no 'within' "
+        "clause arms a new partial match per trigger event and never "
+        "expires any — slots pin until pool exhaustion (then matches "
+        "drop with counted overflow). Rejected under a residency "
+        "budget; add 'within <t>'"
+    ),
+    "ADM111": "declared state residency exceeds the budget",
+    "ADM112": (
+        "unbounded window retention: a window-less join side (or "
+        "equivalent) semantically retains all history; the engine "
+        "truncates at ring capacity with counted overflow — silently "
+        "degraded answers. Rejected under a residency budget; declare "
+        "#window.length/#window.time"
+    ),
+    "ADM120": (
+        "per-event output amplification exceeds the budget (joins / "
+        "patterns that can emit many rows per input event demand that "
+        "multiple of sink bandwidth and accumulator space)"
+    ),
+}
+
+_REQUIRED_COST_KEYS = ("name", "kind", "amplification", "residency_ms")
+
+
+@dataclass(frozen=True)
+class AdmissionIssue:
+    rule: str
+    where: str  # "plan_id/artifact" locator
+    message: str
+
+    def render(self) -> str:
+        return f"{self.rule} [{self.where}] {self.message}"
+
+
+class AdmissionError(Exception):
+    def __init__(self, issues: Sequence[AdmissionIssue], report=None):
+        self.issues = list(issues)
+        self.report = report
+        super().__init__(
+            "plan admission rejected:\n"
+            + "\n".join(f"  {i.render()}" for i in self.issues)
+        )
+
+
+@dataclass(frozen=True)
+class AdmissionBudgets:
+    """The tenant resource envelope admission enforces. ``None`` knobs
+    impose no constraint (budgets are *policy* — the engine cannot
+    guess them, so the defaults are deliberately generous: they bound
+    the pathological, not the merely large)."""
+
+    # worst-case device state footprint per plan (ADM101); the window
+    # rings / NFA pools / group+sketch tables at admission-time bucket
+    # shapes
+    max_state_bytes: int = 8 << 20
+    # device output accumulator (ADM102) — separately knobbed because
+    # EngineConfig.acc_budget_bytes already bounds it per plan
+    max_acc_bytes: int = 512 << 20
+    # worst-case rows emitted per input event, per artifact (ADM120)
+    max_amplification: int = 1 << 16
+    # max time an admitted event may influence retained state
+    # (ADM110/111/112). None = no residency requirement: patterns
+    # without 'within' pass (the single-tenant default); a multi-tenant
+    # profile sets it and unbounded residency is REJECTED, not estimated
+    max_residency_ms: Optional[int] = None
+
+
+DEFAULT_BUDGETS = AdmissionBudgets()
+# the multi-tenant admission profile: every admitted plan must bound
+# how long state can live (docs/static_analysis.md "budget knobs")
+STRICT_BUDGETS = AdmissionBudgets(max_residency_ms=60_000)
+
+
+@dataclass
+class AdmissionReport:
+    plan_id: str
+    # sha256 hex of the shape-bucket class (None in the static tier)
+    signature: Optional[str] = None
+    # worst-case byte footprints (None in the static tier)
+    state_bytes: Optional[int] = None
+    acc_bytes: Optional[int] = None
+    # max per-artifact worst-case rows-out per input event
+    amplification: int = 0
+    # max residency across artifacts: 0 stateless, float('inf')
+    # unbounded, None = count-bounded eviction (no time dimension)
+    residency_ms: Optional[float] = None
+    per_artifact: Dict[str, dict] = field(default_factory=dict)
+    findings: List[AdmissionIssue] = field(default_factory=list)
+
+    @property
+    def admitted(self) -> bool:
+        return not self.findings
+
+    def summary(self) -> dict:
+        """JSON-safe verdict payload — what a MetadataControlEvent
+        carries next to the CQL on add/update (control/events.py)."""
+        res = self.residency_ms
+        if res is not None and math.isinf(res):
+            res = "unbounded"
+        return {
+            "admitted": self.admitted,
+            "signature": self.signature,
+            "state_bytes": self.state_bytes,
+            "acc_bytes": self.acc_bytes,
+            "amplification": int(self.amplification),
+            "residency_ms": res,
+            "findings": [
+                {"rule": i.rule, "where": i.where, "message": i.message}
+                for i in self.findings
+            ],
+        }
+
+
+# --------------------------------------------------------------------------
+# cost_info collection (the static tier: pure python, microseconds)
+# --------------------------------------------------------------------------
+
+
+def _collect_costs(plan, issues: List[AdmissionIssue]) -> List[dict]:
+    infos: List[dict] = []
+    for a in plan.artifacts:
+        where = f"{plan.plan_id}/{a.name}"
+        hook = getattr(a, "cost_info", None)
+        if hook is None:
+            issues.append(
+                AdmissionIssue(
+                    "ADM001",
+                    where,
+                    f"{type(a).__name__} exposes no cost_info() hook",
+                )
+            )
+            continue
+        try:
+            info = dict(hook())
+        except Exception as e:  # noqa: BLE001 — a broken hook is a reject
+            issues.append(
+                AdmissionIssue(
+                    "ADM002",
+                    where,
+                    f"cost_info() raised {type(e).__name__}: {e}",
+                )
+            )
+            continue
+        missing = [k for k in _REQUIRED_COST_KEYS if k not in info]
+        if missing:
+            issues.append(
+                AdmissionIssue(
+                    "ADM002", where, f"cost_info() lacks keys {missing}"
+                )
+            )
+            continue
+        amp = info["amplification"]
+        res = info["residency_ms"]
+        if not isinstance(amp, (int, np.integer)) or amp < 0:
+            issues.append(
+                AdmissionIssue(
+                    "ADM002", where, f"amplification {amp!r} is not a "
+                    "non-negative int",
+                )
+            )
+            continue
+        if res is not None and not (
+            isinstance(res, (int, float, np.integer, np.floating))
+            and (res >= 0 or math.isinf(res))
+        ):
+            issues.append(
+                AdmissionIssue(
+                    "ADM002", where, f"residency_ms {res!r} is not "
+                    "None, a non-negative number, or inf",
+                )
+            )
+            continue
+        info["where"] = where
+        infos.append(info)
+    return infos
+
+
+# --------------------------------------------------------------------------
+# footprint (eval_shape of the state constructors — no device alloc)
+# --------------------------------------------------------------------------
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def _footprints(plan, issues: List[AdmissionIssue]):
+    import jax
+
+    try:
+        states = jax.eval_shape(plan.init_state)
+        acc = jax.eval_shape(plan.init_acc)
+    except Exception as e:  # noqa: BLE001
+        issues.append(
+            AdmissionIssue(
+                "ADM003",
+                plan.plan_id,
+                f"state constructors do not trace: "
+                f"{type(e).__name__}: {e}",
+            )
+        )
+        return None, None
+    return _tree_nbytes(states), _tree_nbytes(acc)
+
+
+# --------------------------------------------------------------------------
+# shape-bucket plan signature (the AOT cache key)
+# --------------------------------------------------------------------------
+
+_SIGNATURE_VERSION = 1
+
+# AST int fields that hold parsed CONSTANTS (time spans), masked to
+# presence so e.g. `within 5 sec` vs `within 6 sec` collide — they
+# compile to literal operands of the same program shape, exactly like
+# filter constants
+_MASKED_INT_FIELDS = {
+    ("PatternInput", "within"),
+    ("JoinInput", "within"),
+    ("PatternElement", "absent_for"),
+    ("OutputRate", "n_events"),
+    ("OutputRate", "ms"),
+}
+
+
+def _canon_ast(node):
+    """Canonical, constants-masked rendering of a query-AST subtree:
+    pure JSON-able lists/strings, stable across processes."""
+    from ..query import ast as qast
+    from ..schema.types import AttributeType
+
+    if isinstance(node, qast.Literal):
+        return ["const", node.atype.name]
+    if isinstance(node, qast.TimeLiteral):
+        return ["const", "time"]
+    if isinstance(node, AttributeType):
+        return node.name
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        cls = type(node).__name__
+        out = [cls]
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if (cls, f.name) in _MASKED_INT_FIELDS:
+                out.append([f.name, ["const?", v is not None]])
+            else:
+                out.append([f.name, _canon_ast(v)])
+        return out
+    if isinstance(node, (tuple, list)):
+        return [_canon_ast(x) for x in node]
+    if isinstance(node, frozenset):
+        return sorted(_canon_ast(x) for x in node)
+    if node is None or isinstance(node, (str, bool)):
+        return node
+    if isinstance(node, (int, float, np.integer, np.floating)):
+        # bare numbers in the AST are STRUCTURE (quantifier bounds,
+        # window grid slots), not user constants — those are Literals
+        return node if np.isfinite(node) else str(node)
+    return repr(node)
+
+
+def _canon_shapes(tree) -> List:
+    import jax
+
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        out.append(
+            [
+                jax.tree_util.keystr(path),
+                list(int(d) for d in leaf.shape),
+                np.dtype(leaf.dtype).str,
+            ]
+        )
+    return sorted(out)
+
+
+def plan_signature(plan, capacity: int = 128) -> str:
+    """The shape-bucket class key for ``plan`` stepped at micro-batches
+    of up to ``capacity`` events (padded to ``bucket_size``).
+
+    Built from (1) the bucket-padded tape layout, (2) the step's
+    shape/dtype fixed point — eval_shape of states, accumulator, and
+    per-artifact outputs; the exact shapes XLA compiles — and (3) a
+    constants-masked structural descriptor of the source queries.
+    Identical keys <=> same compiled shape class: an AOT executable
+    cache keyed by this hash pays the first-compile cost once per
+    shape, and two tenants differing only in constants land in the
+    same class (their constants are data in the dynamic-group world,
+    literal operands of an identical program shape otherwise).
+
+    Process-stable by construction: sha256 over canonical JSON, no
+    Python ``hash()``, no id()s, no iteration-order dependence."""
+    import jax
+
+    from ..runtime.tape import bucket_size
+
+    cap = bucket_size(int(capacity))
+    from .plancheck import _zero_tape
+
+    states = jax.eval_shape(plan.init_state)
+    acc = jax.eval_shape(plan.init_acc)
+    tape = _zero_tape(plan, cap)
+    outputs = jax.eval_shape(
+        lambda s, t: plan.step(s, t), states, tape
+    )
+    payload = {
+        "v": _SIGNATURE_VERSION,
+        "capacity": cap,
+        "tape": {
+            "streams": sorted(plan.spec.stream_codes.items()),
+            "columns": [
+                [k, np.dtype(
+                    plan.spec.column_types[k].device_dtype
+                ).str]
+                for k in plan.spec.columns
+            ],
+            "device_columns": (
+                None
+                if plan.spec.device_columns is None
+                else list(plan.spec.device_columns)
+            ),
+            "host_preds": [
+                [hp.out_key, np.dtype(hp.dtype).str]
+                for hp in plan.spec.host_preds
+            ],
+            "encoded": [
+                [e.out_key, list(e.in_keys), bool(e.materialize)]
+                for e in plan.spec.encoded
+            ],
+        },
+        "state": _canon_shapes(states),
+        "acc": _canon_shapes(acc),
+        "outputs": _canon_shapes(outputs),
+        "artifacts": [
+            [type(a).__name__, a.name, getattr(a, "output_mode", None)]
+            for a in plan.artifacts
+        ],
+        "chained": sorted(
+            [c, ci.producer, ci.stream_id, ci.mode]
+            for c, ci in plan.chained.items()
+        ),
+        "structure": _canon_ast(plan.source_ast),
+        "tape_capacity_limit": plan.tape_capacity_limit,
+    }
+    blob = json.dumps(
+        payload, sort_keys=True, separators=(",", ":"), default=str
+    )
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+# --------------------------------------------------------------------------
+# verdicts
+# --------------------------------------------------------------------------
+
+
+def _budget_findings(
+    report: AdmissionReport,
+    infos: List[dict],
+    budgets: AdmissionBudgets,
+) -> List[AdmissionIssue]:
+    out: List[AdmissionIssue] = []
+    if (
+        report.state_bytes is not None
+        and report.state_bytes > budgets.max_state_bytes
+    ):
+        out.append(
+            AdmissionIssue(
+                "ADM101",
+                report.plan_id,
+                f"worst-case device state footprint "
+                f"{report.state_bytes} B exceeds the "
+                f"{budgets.max_state_bytes} B budget",
+            )
+        )
+    if (
+        report.acc_bytes is not None
+        and report.acc_bytes > budgets.max_acc_bytes
+    ):
+        out.append(
+            AdmissionIssue(
+                "ADM102",
+                report.plan_id,
+                f"output accumulator footprint {report.acc_bytes} B "
+                f"exceeds the {budgets.max_acc_bytes} B budget",
+            )
+        )
+    for info in infos:
+        where = info["where"]
+        amp = int(info["amplification"])
+        if amp > budgets.max_amplification:
+            out.append(
+                AdmissionIssue(
+                    "ADM120",
+                    where,
+                    f"per-event output amplification {amp} exceeds "
+                    f"the {budgets.max_amplification} budget",
+                )
+            )
+        res = info["residency_ms"]
+        if budgets.max_residency_ms is None or res is None:
+            continue
+        if math.isinf(res):
+            kind = info.get("kind", "")
+            rule = "ADM110" if kind in ("pattern",) else "ADM112"
+            out.append(
+                AdmissionIssue(
+                    rule,
+                    where,
+                    info.get("unbounded")
+                    or "state residency is unbounded",
+                )
+            )
+        elif res > budgets.max_residency_ms:
+            out.append(
+                AdmissionIssue(
+                    "ADM111",
+                    where,
+                    f"declared residency {int(res)} ms exceeds the "
+                    f"{budgets.max_residency_ms} ms budget",
+                )
+            )
+    return out
+
+
+def analyze_plan(
+    plan,
+    budgets: Optional[AdmissionBudgets] = None,
+    capacity: int = 128,
+    deep: bool = True,
+) -> AdmissionReport:
+    """Produce an :class:`AdmissionReport` for one CompiledPlan.
+
+    Tiers (mirroring plancheck's cost ladder):
+
+    * static (always): per-artifact ``cost_info()`` collection +
+      validation (ADM001/002) — pure python, microseconds. This is
+      what ``FST_VERIFY_PLANS=1`` applies to EVERY test-lane compile.
+    * ``deep=True``: footprint via eval_shape of the state
+      constructors + the shape-bucket plan signature (~0.1 s/plan, no
+      XLA compile, no device allocation).
+    * ``budgets`` set: verdicts — findings against the budget knobs
+      (implies the deep tier: a budget cannot be checked against an
+      uncomputed footprint).
+    """
+    report = AdmissionReport(plan_id=plan.plan_id)
+    issues: List[AdmissionIssue] = []
+    infos = _collect_costs(plan, issues)
+    amp = 0
+    res: Optional[float] = None
+    for info in infos:
+        amp = max(amp, int(info["amplification"]))
+        r = info["residency_ms"]
+        if r is not None:
+            res = float(r) if res is None else max(res, float(r))
+    report.amplification = amp
+    report.residency_ms = res
+    report.per_artifact = {
+        i["where"]: {k: v for k, v in i.items() if k != "where"}
+        for i in infos
+    }
+    if deep or budgets is not None:
+        report.state_bytes, report.acc_bytes = _footprints(plan, issues)
+        if not issues:
+            report.signature = plan_signature(plan, capacity=capacity)
+    if budgets is not None and not issues:
+        issues.extend(_budget_findings(report, infos, budgets))
+    report.findings = issues
+    return report
+
+
+def admit_plan(
+    plan,
+    budgets: Optional[AdmissionBudgets] = None,
+    capacity: int = 128,
+    deep: bool = True,
+    raise_on_reject: bool = True,
+) -> AdmissionReport:
+    """``analyze_plan`` + raise :class:`AdmissionError` on findings —
+    the ``compile_plan`` hook point (same contract as
+    ``plancheck.verify_plan``)."""
+    report = analyze_plan(
+        plan, budgets=budgets, capacity=capacity, deep=deep
+    )
+    if report.findings and raise_on_reject:
+        raise AdmissionError(report.findings, report)
+    return report

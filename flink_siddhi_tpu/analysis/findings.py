@@ -54,4 +54,14 @@ RULES = {
         "compiles a fresh executable (the sticky wire-kind widening "
         "retrace-explosion class)"
     ),
+    "FST106": (
+        "checkpoint-state-incomplete: a mutable `self._*` attribute is "
+        "assigned outside __init__ in a checkpoint-covered class "
+        "(state_dict/load_state_dict, or `# fst:checkpointed by=`) but "
+        "appears in neither the snapshot coverage nor an explicit "
+        "`# fst:ephemeral <reason>` annotation — state that silently "
+        "dies on restore (the PR 10 event-time-gate bug class: gate "
+        "watermarks had to be hand-added to checkpoints after the "
+        "fact)"
+    ),
 }

@@ -3,13 +3,17 @@
 Usage::
 
     fstlint [paths...] [--baseline FILE | --no-baseline]
+            [--rule FSTnnn[,FSTnnn...]]
             [--write-baseline FILE] [--list-rules] [--json]
 
 With no paths, lints the default surface: the ``flink_siddhi_tpu``
-package, ``bench.py``, and ``scripts/``. Exit codes: 0 clean; 1
-unsuppressed findings; 2 baseline problems (stale entries, missing or
-REVIEWME reasons, parse errors). ``scripts/run_static_analysis.py``
-runs this (plus plancheck over the query zoo) in the tier-1 lane.
+package, ``bench.py``, and ``scripts/``. ``--rule`` restricts output
+to the named rule id(s) — iterate on ONE rule without wading through
+a full-repo sweep (staleness is not enforced on a filtered run, like
+a targeted-paths run). Exit codes: 0 clean; 1 unsuppressed findings;
+2 baseline problems (stale entries, missing or REVIEWME reasons,
+parse errors). ``scripts/run_static_analysis.py`` runs this (plus
+plancheck and admission over the query zoo) in the tier-1 lane.
 """
 
 from __future__ import annotations
@@ -106,16 +110,49 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="emit a baseline covering current findings (reasons left "
         "REVIEWME; the linter rejects them until a human explains)",
     )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        default=[],
+        metavar="FSTnnn",
+        help="only report these rule id(s) (repeatable / comma-"
+        "separated); staleness is not enforced on a filtered run",
+    )
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
+    rule_filter = {
+        r.strip().upper()
+        for chunk in args.rule
+        for r in chunk.split(",")
+        if r.strip()
+    }
+    unknown = rule_filter - set(RULES)
+    if unknown:
+        ap.error(
+            f"unknown rule id(s) {sorted(unknown)}; --list-rules "
+            "prints the registry"
+        )
+    if rule_filter and args.write_baseline:
+        # a baseline regenerated from a filtered sweep would silently
+        # DROP every other rule's suppressions (and their human-written
+        # reasons) — refuse the combination
+        ap.error(
+            "--rule cannot be combined with --write-baseline (the "
+            "regenerated baseline would drop other rules' entries)"
+        )
+
     if args.list_rules:
         for rid, desc in sorted(RULES.items()):
+            if rule_filter and rid not in rule_filter:
+                continue
             print(f"{rid}  {desc}")
         return 0
 
     findings = lint_paths(args.paths or None)
+    if rule_filter:
+        findings = [f for f in findings if f.rule in rule_filter]
 
     if args.write_baseline:
         # regenerating a live baseline must PRESERVE human-written
@@ -160,11 +197,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "REVIEWME reason — explain it or fix the finding"
                 )
         findings, stale = apply_baseline(findings, sups)
-        if args.paths:
-            # a targeted run lints a SUBSET of the surface, so a
-            # suppression for an out-of-scope file matching nothing is
-            # expected, not stale — staleness is only meaningful (and
-            # only enforced) against the full default sweep
+        if args.paths or rule_filter:
+            # a targeted run lints a SUBSET of the surface (by path or
+            # by rule), so a suppression for an out-of-scope finding
+            # matching nothing is expected, not stale — staleness is
+            # only meaningful (and only enforced) against the full
+            # default sweep
             stale = []
 
     if args.json:

@@ -17,6 +17,7 @@ inherit the annotation (scan bodies run under the same trace).
 from __future__ import annotations
 
 import ast
+import os
 import re
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
@@ -822,6 +823,298 @@ def rule_unbounded_retrace(
 
 
 # --------------------------------------------------------------------------
+# FST106: checkpoint-state completeness
+# --------------------------------------------------------------------------
+
+_CHECKPOINTED_MARK = re.compile(
+    r"#\s*fst:checkpointed(?:\s+by=([\w./:,-]+))?"
+)
+_EPHEMERAL_MARK = re.compile(r"#\s*fst:ephemeral\b[ \t]*(.*)")
+
+# snapshot functions parsed out of `by=path:func` targets, cached per
+# process (the default sweep visits checkpoint.py coverage once per
+# referencing class otherwise)
+_EXT_COVERAGE_CACHE: Dict[Tuple[str, str], Optional[Set[str]]] = {}
+
+_RULES_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _method_nodes(cls: ast.ClassDef):
+    for st in cls.body:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield st
+
+
+def _walk_skip_classes(node: ast.AST):
+    """ast.walk that does not descend into nested class definitions
+    (a nested class's `self` is not the method's `self`)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, ast.ClassDef):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _self_attrs_everywhere(fn: ast.AST) -> Set[str]:
+    """Every attribute touched on `self` anywhere in the method —
+    reads AND writes both count as snapshot coverage (state_dict reads
+    what it saves; load_state_dict assigns what it restores)."""
+    out: Set[str] = set()
+    for node in _walk_skip_classes(fn):
+        name = _self_attr(node)
+        if name is not None:
+            out.add(name)
+    return out
+
+
+def _first_param_attrs(fn: ast.AST) -> Set[str]:
+    """Attributes accessed on the function's first parameter (the
+    `job` of snapshot_job/restore_job)."""
+    args = fn.args.posonlyargs + fn.args.args
+    if not args:
+        return set()
+    root = args[0].arg
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == root
+        ):
+            out.add(node.attr)
+    return out
+
+
+def _external_coverage(target: str) -> Optional[Set[str]]:
+    """Coverage from one `path:func` target of `# fst:checkpointed
+    by=...` (path repo-root-relative). None when unresolvable — the
+    annotation is then wrong and every mutation flags, which is the
+    loud outcome we want."""
+    key = tuple(target.rsplit(":", 1))
+    if len(key) != 2:
+        return None
+    if key in _EXT_COVERAGE_CACHE:
+        return _EXT_COVERAGE_CACHE[key]
+    rel_path, func = key
+    cov: Optional[Set[str]] = None
+    fp = os.path.join(_RULES_REPO_ROOT, rel_path)
+    try:
+        with open(fp, "r", encoding="utf-8") as fh:
+            ext_tree = ast.parse(fh.read(), filename=rel_path)
+    except (OSError, SyntaxError):
+        ext_tree = None
+    if ext_tree is not None:
+        for node in ast.walk(ext_tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name == func
+            ):
+                cov = _first_param_attrs(node)
+                break
+    _EXT_COVERAGE_CACHE[key] = cov
+    return cov
+
+
+def _class_mark(
+    cls: ast.ClassDef, source_lines: Sequence[str]
+) -> Optional[str]:
+    """The `# fst:checkpointed` annotation's by= payload ('' when
+    bare), or None when the class is unmarked. Decorators shift
+    cls.lineno, so scan from the first decorator (or the def) upward
+    one line."""
+    first = min(
+        [cls.lineno] + [d.lineno for d in cls.decorator_list]
+    )
+    for ln in (cls.lineno, first - 1):
+        if 1 <= ln <= len(source_lines):
+            m = _CHECKPOINTED_MARK.search(source_lines[ln - 1])
+            if m:
+                return m.group(1) or ""
+    return None
+
+
+def _line_has_ephemeral(
+    source_lines: Sequence[str], lineno: int
+) -> Optional[bool]:
+    """True: annotated with a reason; False: annotated WITHOUT a
+    reason (reported); None: not annotated."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(source_lines):
+            m = _EPHEMERAL_MARK.search(source_lines[ln - 1])
+            if m:
+                return bool(m.group(1).strip())
+    return None
+
+
+def rule_checkpoint_completeness(
+    tree: ast.Module, source_lines: Sequence[str], path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    classes = {
+        n.name: n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+    }
+
+    def _covered_class(
+        cls: ast.ClassDef, seen: Optional[Set[str]] = None
+    ) -> bool:
+        seen = set() if seen is None else seen
+        if cls.name in seen:
+            return False  # textually cyclic bases: degenerate, not ours
+        seen.add(cls.name)
+        if _class_mark(cls, source_lines) is not None:
+            return True
+        if any(m.name == "state_dict" for m in _method_nodes(cls)):
+            return True
+        for base in cls.bases:
+            bn = _tail(base)
+            if bn in classes and classes[bn] is not cls:
+                if _covered_class(classes[bn], seen):
+                    return True
+        return False
+
+    def _coverage(cls: ast.ClassDef, seen: Set[str]) -> Set[str]:
+        if cls.name in seen:
+            return set()
+        seen.add(cls.name)
+        cov: Set[str] = set()
+        for m in _method_nodes(cls):
+            if m.name in ("state_dict", "load_state_dict"):
+                cov |= _self_attrs_everywhere(m)
+        mark = _class_mark(cls, source_lines)
+        if mark:
+            for target in mark.split(","):
+                ext = _external_coverage(target.strip())
+                if ext is not None:
+                    cov |= ext
+        for base in cls.bases:
+            bn = _tail(base)
+            if bn in classes and classes[bn] is not cls:
+                cov |= _coverage(classes[bn], seen)
+        return cov
+
+    def _ephemerals(cls: ast.ClassDef) -> Tuple[Set[str], List[Finding]]:
+        """Attrs with a reasoned `# fst:ephemeral` on ANY assignment to
+        them in the class (conventionally the __init__ declaration);
+        a reason-less annotation is itself a finding, like baseline
+        suppressions without reasons."""
+        out: Set[str] = set()
+        bad: List[Finding] = []
+        for m in _method_nodes(cls):
+            for node in _walk_skip_classes(m):
+                if not isinstance(
+                    node, (ast.Assign, ast.AugAssign, ast.AnnAssign)
+                ):
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                names = []
+                for t in targets:
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        names.extend(
+                            a for a in map(_self_attr, t.elts)
+                            if a is not None
+                        )
+                    else:
+                        a = _self_attr(t)
+                        if a is not None:
+                            names.append(a)
+                if not names:
+                    continue
+                has = _line_has_ephemeral(source_lines, node.lineno)
+                if has is True:
+                    out.update(names)
+                elif has is False:
+                    bad.append(
+                        Finding(
+                            path,
+                            node.lineno,
+                            "FST106",
+                            "`# fst:ephemeral` without a reason — "
+                            "explain why this state may die on "
+                            "restore (like baseline suppressions, "
+                            "the reason is mandatory)",
+                        )
+                    )
+        return out, bad
+
+    for cls in classes.values():
+        if not _covered_class(cls):
+            continue
+        covered = _coverage(cls, set())
+        ephemeral, bad_marks = _ephemerals(cls)
+        findings.extend(bad_marks)
+        reported: Set[str] = set()
+        for m in _method_nodes(cls):
+            if m.name in (
+                "__init__", "__post_init__", "state_dict",
+                "load_state_dict",
+            ) or (m.name.startswith("__") and m.name.endswith("__")):
+                continue
+            for node in _walk_skip_classes(m):
+                if not isinstance(
+                    node, (ast.Assign, ast.AugAssign, ast.AnnAssign)
+                ):
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                flat = []
+                for t in targets:
+                    flat.extend(
+                        t.elts if isinstance(t, (ast.Tuple, ast.List))
+                        else [t]
+                    )
+                for t in flat:
+                    attr = _self_attr(t)
+                    if (
+                        attr is None
+                        or not attr.startswith("_")
+                        or attr.startswith("__")
+                        or attr in covered
+                        or attr in ephemeral
+                        or attr in reported
+                    ):
+                        continue
+                    if _line_has_ephemeral(
+                        source_lines, node.lineno
+                    ) is not None:
+                        continue  # handled by _ephemerals above
+                    reported.add(attr)
+                    findings.append(
+                        Finding(
+                            path,
+                            node.lineno,
+                            "FST106",
+                            f"mutable state `self.{attr}` assigned in "
+                            f"{cls.name}.{m.name} is covered by "
+                            "neither snapshot/state_dict nor an "
+                            "explicit `# fst:ephemeral <reason>` "
+                            "annotation — it silently dies on "
+                            "checkpoint restore",
+                        )
+                    )
+    return findings
+
+
+# --------------------------------------------------------------------------
 # entry
 # --------------------------------------------------------------------------
 
@@ -836,4 +1129,5 @@ def lint_module(source: str, path: str) -> List[Finding]:
     findings.extend(rule_falsy_zero_default(tree, path))
     findings.extend(rule_tracer_leak(tree, info, path))
     findings.extend(rule_unbounded_retrace(tree, info, path))
+    findings.extend(rule_checkpoint_completeness(tree, lines, path))
     return sorted(set(findings))

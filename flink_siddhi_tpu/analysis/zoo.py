@@ -90,6 +90,70 @@ MULTIQUERY_STACK = "; ".join(
 )
 PLAN_ZOO["multiquery_stack6"] = MULTIQUERY_STACK
 
+# -- the hostile zoo (analysis/admit.py) ------------------------------------
+#
+# Syntactically perfect, plancheck-clean tenant queries a production
+# admission gate must REJECT: each entry names the exact ADM rule it
+# must trip and the budget profile it is judged under ("default" =
+# AdmissionBudgets(); "strict" = STRICT_BUDGETS, the multi-tenant
+# profile that demands bounded residency). scripts/run_static_analysis
+# and tests/test_admit.py both enforce rejection BY RULE ID — a hostile
+# entry slipping through (or tripping the wrong rule) fails the gate.
+HOSTILE_ZOO: Dict[str, Tuple[str, str, str]] = {
+    # a 2^20-row window: ~13 MB of ring state for ONE tenant query —
+    # over the default per-plan state budget
+    "hostile_length_window_1m": (
+        "from S#window.length(1048576) select sum(price) as s "
+        "insert into out",
+        "ADM101",
+        "default",
+    ),
+    # 128k-row join rings: each arriving event demands up to 131072
+    # output rows — over the default amplification budget (the
+    # emission buffer would truncate with counted overflow, i.e.
+    # silently degraded answers at the tenant's chosen scale)
+    "hostile_join_amplification": (
+        "from S#window.length(131072) as a join "
+        "Trades#window.length(131072) as b on a.id == b.vol "
+        "select a.id, b.price insert into out",
+        "ADM120",
+        "default",
+    ),
+    # 'every' with no 'within': armed partials never expire — the
+    # unbounded-slot-residency class the strict profile rejects
+    "hostile_pattern_no_within": (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] "
+        "select s1.price as p1, s2.price as p2 insert into out",
+        "ADM110",
+        "strict",
+    ),
+    # a declared-but-absurd residency: one-hour partial matches under
+    # a 60 s tenant budget
+    "hostile_eternal_within": (
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] "
+        "within 3600 sec "
+        "select s1.price as p1, s2.price as p2 insert into out",
+        "ADM111",
+        "strict",
+    ),
+    # window-less join: semantically retains ALL history, truncated at
+    # ring capacity with counted overflow — unbounded retention under
+    # the strict profile
+    "hostile_unbounded_join": (
+        "from S as a join Trades as b on a.id == b.vol "
+        "select a.id, b.price insert into out",
+        "ADM112",
+        "strict",
+    ),
+}
+
+
+def hostile_budgets(profile: str):
+    """Budget profile for a HOSTILE_ZOO entry."""
+    from .admit import DEFAULT_BUDGETS, STRICT_BUDGETS
+
+    return {"default": DEFAULT_BUDGETS, "strict": STRICT_BUDGETS}[profile]
+
 
 def zoo_schemas():
     """Fresh schema objects per call (schemas carry shared string
@@ -135,6 +199,31 @@ def compile_zoo(
                 compile_plan(
                     cql, zoo_schemas(), plan_id=f"zoo:{name}", config=cfg
                 ),
+            )
+        )
+    return out
+
+
+def compile_hostile() -> List[Tuple[str, object, str, str]]:
+    """Compile every hostile zoo plan; returns
+    [(name, CompiledPlan, expected ADM rule, budget profile)]. These
+    are well-formed (plancheck passes) — only ADMISSION must reject
+    them, so the caller runs analysis/admit.py explicitly with the
+    entry's profile."""
+    from ..compiler.config import EngineConfig
+    from ..compiler.plan import compile_plan
+
+    out = []
+    cfg = EngineConfig()
+    for name, (cql, rule, profile) in HOSTILE_ZOO.items():
+        out.append(
+            (
+                name,
+                compile_plan(
+                    cql, zoo_schemas(), plan_id=f"zoo:{name}", config=cfg
+                ),
+                rule,
+                profile,
             )
         )
     return out

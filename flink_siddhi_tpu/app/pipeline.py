@@ -165,6 +165,9 @@ class CEPPipeline:
                     if cfg.watermark_skew_ms is not None
                     else None
                 ),
+                # one silent PARTITION unpins at the same timeout the
+                # job applies per SOURCE (runtime/kafka.py idleness)
+                idle_timeout_ms=cfg.idle_timeout_ms,
             )
         elif cfg.format == "csv":
             src = CsvSource(

@@ -71,6 +71,14 @@ class EngineConfig:
     # (tests/conftest.py), and FST_VERIFY_PLANS=0 force-disables even
     # an explicit True (bench escape hatch).
     verify_plans: bool = False
+    # admission-time resource budgets (analysis/admit.py
+    # AdmissionBudgets): when set, every compile is analyzed for
+    # worst-case state footprint / output amplification / residency
+    # and REJECTED (AdmissionError) on any ADM finding — the control
+    # plane's per-tenant envelope. None = report-only tiers still run
+    # under FST_VERIFY_PLANS (static hook validation on =1, full
+    # footprint+signature on =full), but no budget verdicts.
+    admission_budgets: Optional[object] = None
 
 
 DEFAULT_CONFIG = EngineConfig()

@@ -803,6 +803,39 @@ def _spec_check_info(name: str, spec: "_PatternSpec", **extra) -> Dict:
     return info
 
 
+def _pattern_cost(name: str, spec: "_PatternSpec", pool: int) -> Dict:
+    """One pattern's admission-cost descriptor (analysis/admit.py).
+
+    Residency: ``within`` when declared; without it an ``every``
+    pattern (incl. mid-chain ``-> every`` forks) arms partials that
+    NEVER expire — unbounded slot residency, the ADM110 reject class.
+    A non-every pattern keeps a single instance in flight, so its
+    unexpired state is one slot, not a growing population."""
+    every = spec.every or any(spec.every_marks or ())
+    if spec.within is not None:
+        res: object = float(spec.within)
+        unbounded = None
+    elif every:
+        res = float("inf")
+        unbounded = (
+            "'every' pattern with no 'within' clause: armed partial "
+            f"matches never expire and pin the {pool}-slot pool "
+            "(matches beyond it drop with counted overflow)"
+        )
+    else:
+        res = None
+        unbounded = None
+    info = {
+        "name": name,
+        "kind": "pattern",
+        "amplification": int(pool) if every else 1,
+        "residency_ms": res,
+    }
+    if unbounded is not None:
+        info["unbounded"] = unbounded
+    return info
+
+
 @dataclass(frozen=True)
 class _ChainCfg:
     """Static (hashable) chain-matcher configuration — everything the
@@ -1158,6 +1191,15 @@ class ChainPatternArtifact:
         """Transition-table descriptors for analysis.plancheck (PLC2xx:
         positive/guard partition, quantifier bounds, bitmask width)."""
         return [_spec_check_info(self.name, self.spec)]
+
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor (analysis/admit.py): under
+        ``every`` each trigger event arms a fresh partial, and one
+        later event can complete EVERY armed prefix at once — worst
+        case ``pool`` rows per input event, and without ``within`` the
+        armed partials never expire (the ADM110 unbounded-residency
+        surface)."""
+        return _pattern_cost(self.name, self.spec, self.pool)
 
     def _row_plan(self):
         """Emission block layout. Legacy: [ts, one row per projection].
@@ -1779,6 +1821,36 @@ class StackedChainArtifact:
             for m in self.members
         ]
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: one event feeds EVERY stacked
+        member, so the group's worst-case output demand is the sum of
+        the members' (the emission buffer truncates beyond
+        min(Q, out_cap_factor)*E + Q*pool with counted overflow)."""
+        member_costs = [
+            _pattern_cost(f"{self.name}[{m.name}]", m.spec, m.pool)
+            for m in self.members
+        ]
+        res: object = None
+        unbounded = None
+        for mc in member_costs:
+            r = mc["residency_ms"]
+            if r is not None:
+                res = r if res is None else max(res, r)
+            if unbounded is None and "unbounded" in mc:
+                unbounded = mc["unbounded"]
+        info = {
+            "name": self.name,
+            "kind": "pattern",
+            "amplification": int(
+                sum(mc["amplification"] for mc in member_costs)
+            ),
+            "residency_ms": res,
+            "members": [mc["name"] for mc in member_costs],
+        }
+        if unbounded is not None:
+            info["unbounded"] = unbounded
+        return info
+
     def _build_vec_preds(self):
         """Per-element conjunct vectors for the broadcast predicate path:
         when every member's element-k filter flattens to the same
@@ -2365,6 +2437,31 @@ class DynamicChainGroup:
             min(q, self.out_cap_factor) * tape_capacity + q * self.pool
         )
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: the padded group's worst case is
+        every slot occupied and every slot's pool completable by one
+        event. Per-member ``within`` values are device DATA (each
+        member's own compile was admitted separately before folding);
+        ``has_within=False`` under ``every`` is the unbounded-residency
+        class for the whole slot family."""
+        t = self.template
+        per_member = self.pool if t.every else 1
+        info = {
+            "name": self.name,
+            "kind": "pattern",
+            "amplification": int(self.capacity * per_member),
+            "residency_ms": (
+                None if t.has_within else
+                (float("inf") if t.every else None)
+            ),
+        }
+        if t.every and not t.has_within:
+            info["unbounded"] = (
+                "dynamic chain group compiled without 'within' "
+                "support: every member's armed partials never expire"
+            )
+        return info
+
     def _param_dtype(self, key: str):
         return self.column_types[key].device_dtype
 
@@ -2832,6 +2929,12 @@ class SlotNFAArtifact:
         self._min_prefix = np.concatenate(
             [[0], np.cumsum(self._mins)]
         ).astype(np.int32)
+
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: the slot engine's partial-match
+        population is its ``slots`` pool — same every/within residency
+        semantics as the chain matcher."""
+        return _pattern_cost(self.name, self.spec, self.slots)
 
     def nfa_check_info(self) -> List[Dict]:
         """Slot-engine tables for analysis.plancheck: the generic chain

@@ -865,6 +865,24 @@ def compile_plan(
         verify_plan(
             plan, trace=bool(config.verify_plans) or _env == "full"
         )
+    # admission analysis (analysis/admit.py) rides the same tier
+    # ladder: =1 validates every artifact's cost_info() hook for ~free
+    # on every test-lane compile; =full / verify_plans adds the
+    # footprint + shape-bucket signature (eval_shape, no compile); a
+    # configured AdmissionBudgets turns findings into a hard reject —
+    # the control plane's per-tenant envelope (docs/static_analysis.md).
+    if (
+        config.verify_plans
+        or config.admission_budgets is not None
+        or _env in ("1", "full")
+    ) and _env != "0":
+        from ..analysis.admit import admit_plan
+
+        admit_plan(
+            plan,
+            budgets=config.admission_budgets,
+            deep=bool(config.verify_plans) or _env == "full",
+        )
     return plan
 
 

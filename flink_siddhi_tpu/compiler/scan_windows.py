@@ -92,6 +92,20 @@ class ScanWindowArtifact:
     def _partitioned_sort(self) -> bool:
         return self.kind == "sort" and self.part_key is not None
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor (analysis/admit.py): sort keeps a
+        fixed top-N buffer; unique keeps the last event per key in a
+        bucketed table that grows with key cardinality."""
+        info = {
+            "name": self.name,
+            "kind": "scan_window",
+            "amplification": 1,
+            "residency_ms": None,
+        }
+        if self.kind == "unique":
+            info["grows_with"] = "keys"
+        return info
+
     def init_state(self) -> Dict:
         shape = self._buf_shape()
         st = {
@@ -590,6 +604,18 @@ class SessionWindowArtifact:
             _MIN_UNIQUE_CAPACITY,
         )
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: per-key session aggregates (no
+        events retained); one closed-session row per closing event.
+        The session table grows with key cardinality."""
+        return {
+            "name": self.name,
+            "kind": "session_window",
+            "amplification": 1,
+            "residency_ms": None,
+            "grows_with": "keys",
+        }
+
     def init_state(self) -> Dict:
         G = self._cap()
         st = {
@@ -880,6 +906,17 @@ class FrequencyWindowArtifact:
     arg_types: List[AttributeType]
     proj_fns: List
     output_mode: str = "aligned"
+
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: fixed-slot heavy-hitter sketch —
+        the canonical bounded-memory shape; one row per admitted
+        arrival."""
+        return {
+            "name": self.name,
+            "kind": "sketch_window",
+            "amplification": 1,
+            "residency_ms": None,
+        }
 
     def init_state(self) -> Dict:
         C = self.cap

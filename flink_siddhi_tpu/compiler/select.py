@@ -73,6 +73,17 @@ class SelectArtifact:
             state["seen"] = jnp.zeros((), jnp.int32)
         return state
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor (analysis/admit.py): stateless
+        pass-through — at most one row out per input event, nothing
+        retained."""
+        return {
+            "name": self.name,
+            "kind": "select",
+            "amplification": 1,
+            "residency_ms": 0,
+        }
+
     # fst:hotpath device=state,tape
     def step(self, state: Dict, tape) -> Tuple[Dict, Tuple]:
         env: ColumnEnv = dict(tape.cols)

@@ -27,6 +27,7 @@ from ..query import ast
 from ..query.lexer import SiddhiQLError
 from ..schema.stream_schema import StreamSchema
 from ..schema.types import AttributeType
+from .config import DEFAULT_CONFIG
 from .expr import ColumnEnv, ExprResolver, ResolvedAttr, compile_expr
 from .output import OutputField, OutputSchema
 
@@ -180,6 +181,17 @@ class TableInsertArtifact:
         return {"enabled": jnp.asarray(True),
                 "overflow": jnp.asarray(0, jnp.int32)}
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: table writes emit no stream rows;
+        table rows are user-managed state in a fixed ring (the @tables
+        footprint rides the plan state eval_shape)."""
+        return {
+            "name": self.name,
+            "kind": "table_write",
+            "amplification": 0,
+            "residency_ms": None,
+        }
+
     def step_tables(self, state, tables, tape):
         env: ColumnEnv = dict(tape.cols)
         mask = _masked(
@@ -240,6 +252,22 @@ class WindowedTableInsertArtifact:
             "win": self.inner.init_state(),
             "overflow": jnp.asarray(0, jnp.int32),
         }
+
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: the inner window/aggregation's
+        retention with the table write's zero stream emission."""
+        inner_hook = getattr(self.inner, "cost_info", None)
+        inner = inner_hook() if inner_hook is not None else {}
+        info = {
+            "name": self.name,
+            "kind": "table_write",
+            "amplification": 0,
+            "residency_ms": inner.get("residency_ms"),
+        }
+        for k in ("grows_with", "unbounded"):
+            if k in inner:
+                info[k] = inner[k]
+        return info
 
     def grow_state(self, state: Dict) -> Dict:
         g = getattr(self.inner, "grow_state", None)
@@ -321,6 +349,16 @@ class TableMutateArtifact:
     def init_state(self) -> Dict:
         return {"enabled": jnp.asarray(True)}
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: in-place table mutation — no
+        stream emission, no retention of its own."""
+        return {
+            "name": self.name,
+            "kind": "table_write",
+            "amplification": 0,
+            "residency_ms": 0,
+        }
+
     def step_tables(self, state, tables, tape):
         env: ColumnEnv = dict(tape.cols)
         mask = _masked(
@@ -385,10 +423,25 @@ class TableJoinArtifact:
     table_col_keys: List[str]
     uses_tables: bool = True
     output_mode: str = "buffered"
+    table_capacity: int = 1024  # the joined table's ring slots
 
     def init_state(self) -> Dict:
         return {"enabled": jnp.asarray(True),
                 "overflow": jnp.asarray(0, jnp.int32)}
+
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: one stream event can match every
+        current table row — the table capacity is the worst-case
+        per-event output demand. Table rows are user-managed (insert/
+        update/delete), so no residency clock applies."""
+        return {
+            "name": self.name,
+            "kind": "table_join",
+            "amplification": int(
+                self.table_capacity + (1 if self.outer else 0)
+            ),
+            "residency_ms": None,
+        }
 
     def step_tables(self, state, tables, tape):
         env: ColumnEnv = dict(tape.cols)
@@ -681,4 +734,5 @@ def compile_table_join(
         table_col_keys=[
             table_key(tid, f) for f in tschema.field_names
         ],
+        table_capacity=(config or DEFAULT_CONFIG).table_capacity,
     )

@@ -339,6 +339,24 @@ class SlidingWindowArtifact:
         out["groups"] = jnp.zeros(self._gcap(), jnp.int32)
         return out
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor (analysis/admit.py): one aligned
+        row per input event; retention is the ring (length windows
+        evict by count, time windows by span)."""
+        info = {
+            "name": self.name,
+            "kind": "window",
+            "amplification": 1,
+            "residency_ms": (
+                int(self.time_ms)
+                if self.window_mode == "time" and self.time_ms is not None
+                else None
+            ),
+        }
+        if self.encoder is not None:
+            info["grows_with"] = "groups"
+        return info
+
     def _blocked(self) -> bool:
         """Sort-free tiled path: per-group running sums over the merged
         arrival/expiry sequence via one-hot / lower-triangular matmuls
@@ -1001,6 +1019,19 @@ class CumulativeAggArtifact:
     def _stats(self) -> Dict[int, set]:
         return _acc_stats_for(self.aggs)
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: running aggregates — one row per
+        event, no events retained (per-group scalar state only)."""
+        info = {
+            "name": self.name,
+            "kind": "aggregate",
+            "amplification": 1,
+            "residency_ms": 0,
+        }
+        if self.encoder is not None:
+            info["grows_with"] = "groups"
+        return info
+
     def _chained_tables(self, G: int):
         """(sorted values, codes) arrays for the device value->code map.
         Cached on (encoder size, G): grow_state calls this every cycle
@@ -1284,6 +1315,23 @@ class BatchWindowArtifact:
         """Widest per-cycle emission block: every window-grid cell can
         flush (drain-cadence contract)."""
         return self._grid_shape(tape_capacity) * self._G(state)
+
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: batch windows emit one aggregate
+        row per closed window per group — per input event that is
+        amortized <= 1; retention is one batch span."""
+        res = None
+        if self.window_mode == "timeBatch" and self.time_ms is not None:
+            res = int(self.time_ms)
+        info = {
+            "name": self.name,
+            "kind": "batch_window",
+            "amplification": 1,
+            "residency_ms": res,
+        }
+        if self.encoder is not None:
+            info["grows_with"] = "groups"
+        return info
 
     def _G(self, state) -> int:
         return state["cnt"].shape[0]
@@ -2152,6 +2200,21 @@ class ExpiredWindowArtifact:
     ref_keys: List[str]  # tape columns the projections read
     ref_dtypes: Dict[str, object]  # device dtype per ref column
 
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: each event expires exactly once
+        — one expired row out per input event; retention is the window
+        it leaves."""
+        return {
+            "name": self.name,
+            "kind": "expired_window",
+            "amplification": 1,
+            "residency_ms": (
+                int(self.time_ms)
+                if self.window_mode == "time" and self.time_ms is not None
+                else None
+            ),
+        }
+
     def init_state(self) -> Dict:
         C = self.capacity
         ring: Dict[str, jnp.ndarray] = {
@@ -2406,6 +2469,18 @@ class PerKeyWindowArtifact:
 
     def _G(self) -> int:
         return _bucket(len(self.encoder), MIN_GROUP_CAPACITY)
+
+    def cost_info(self) -> Dict:
+        """Admission-cost descriptor: per-key count-evicted windows —
+        one row per event; state grows with key cardinality (bucketed
+        [G, C] re-buckets as keys intern)."""
+        return {
+            "name": self.name,
+            "kind": "perkey_window",
+            "amplification": 1,
+            "residency_ms": None,
+            "grows_with": "keys",
+        }
 
     def init_state(self) -> Dict:
         G, C = self._G(), self.capacity

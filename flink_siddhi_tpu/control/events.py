@@ -37,11 +37,21 @@ class ControlEvent:
 @dataclass
 class MetadataControlEvent(ControlEvent):
     """Add / update / delete execution plans at runtime
-    (MetadataControlEvent.java:26-56 + Builder :67-104)."""
+    (MetadataControlEvent.java:26-56 + Builder :67-104).
+
+    ``admission`` optionally carries the admission-time analysis
+    verdict per added/updated plan id (``AdmissionReport.summary()``,
+    analysis/admit.py): the JSON-safe resource envelope — shape-bucket
+    signature (the AOT-cache key), worst-case state/accumulator bytes,
+    amplification, residency, and any ADM findings. A verdict with
+    ``admitted=False`` makes the executor REFUSE the add/update instead
+    of compiling a plan the admission gate already rejected (the
+    control-plane groundwork for ROADMAP direction #1)."""
 
     added_plans: Dict[str, str] = field(default_factory=dict)       # id -> cql
     updated_plans: Dict[str, str] = field(default_factory=dict)     # id -> cql
     deleted_plan_ids: tuple = ()
+    admission: Dict[str, dict] = field(default_factory=dict)  # id -> summary
 
     @staticmethod
     def new_plan_id() -> str:
@@ -52,10 +62,15 @@ class MetadataControlEvent(ControlEvent):
             self._added: Dict[str, str] = {}
             self._updated: Dict[str, str] = {}
             self._deleted: list = []
+            self._admission: Dict[str, dict] = {}
 
-        def add_execution_plan(self, cql: str) -> str:
+        def add_execution_plan(
+            self, cql: str, admission: Optional[dict] = None
+        ) -> str:
             plan_id = MetadataControlEvent.new_plan_id()
             self._added[plan_id] = cql
+            if admission is not None:
+                self._admission[plan_id] = dict(admission)
             return plan_id
 
         def update_execution_plan(self, plan_id: str, cql: str) -> "MetadataControlEvent.Builder":
@@ -66,11 +81,20 @@ class MetadataControlEvent(ControlEvent):
             self._deleted.append(plan_id)
             return self
 
+        def with_admission(
+            self, plan_id: str, summary: dict
+        ) -> "MetadataControlEvent.Builder":
+            """Attach an admission verdict (AdmissionReport.summary())
+            to an added/updated plan id."""
+            self._admission[plan_id] = dict(summary)
+            return self
+
         def build(self) -> "MetadataControlEvent":
             return MetadataControlEvent(
                 added_plans=dict(self._added),
                 updated_plans=dict(self._updated),
                 deleted_plan_ids=tuple(self._deleted),
+                admission=dict(self._admission),
             )
 
     @staticmethod
@@ -107,6 +131,8 @@ def control_event_to_json(ev: ControlEvent) -> str:
             "updated": ev.updated_plans,
             "deleted": list(ev.deleted_plan_ids),
         }
+        if ev.admission:
+            payload["admission"] = ev.admission
     elif isinstance(ev, OperationControlEvent):
         payload = {
             "type": "operation",
@@ -129,6 +155,7 @@ def control_event_from_json(text: str) -> ControlEvent:
             added_plans=dict(obj.get("added", {})),
             updated_plans=dict(obj.get("updated", {})),
             deleted_plan_ids=tuple(obj.get("deleted", ())),
+            admission=dict(obj.get("admission", {})),
         )
     elif kind == "operation":
         ev = OperationControlEvent(

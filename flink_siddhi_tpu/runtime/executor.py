@@ -480,8 +480,16 @@ class _OutputRateLimiter:
         return out
 
 
+# fst:checkpointed by=flink_siddhi_tpu/runtime/checkpoint.py:snapshot_job,flink_siddhi_tpu/runtime/checkpoint.py:restore_job
 class Job:
-    """One running pipeline: sources -> compiled plan(s) -> collectors/sinks."""
+    """One running pipeline: sources -> compiled plan(s) -> collectors/sinks.
+
+    Checkpoint coverage lives out-of-class in ``runtime/checkpoint.py``
+    (``snapshot_job``/``restore_job``) — the ``fst:checkpointed``
+    annotation above points FST106 at it: any NEW mutable ``self._*``
+    state added to the run loop must either join the snapshot or carry
+    an explicit ``# fst:ephemeral <reason>`` (the PR 10 event-time-gate
+    class: state that silently dies on restore)."""
 
     def __init__(
         self,
@@ -541,7 +549,9 @@ class Job:
         # so this knob trades p99 match latency against tunnel traffic.
         # None disables scheduled drains (capacity swaps still happen).
         self.drain_interval_ms = 500.0
+        # fst:ephemeral drain-cadence phase is monotonic-clock-relative; restore re-arms the interval
         self._last_full_drain = time.monotonic()
+        # fst:ephemeral drain-cadence phase restarts at resume (accumulators are drained pre-snapshot)
         self._cycles_since_drain = 0
         # backpressure: cap dispatched-but-unfinished device cycles per
         # plan. Without it the host races ahead of the device and match
@@ -567,7 +577,9 @@ class Job:
         # half the latency target (the other half is drain staleness +
         # fetch time). None = fixed depth.
         self.target_p99_ms: Optional[float] = None
+        # fst:ephemeral adaptive-depth pace estimate; re-measured from scratch after restore
         self._cycle_ema: Optional[float] = None
+        # fst:ephemeral monotonic-clock stamp backing the pace estimate above
         self._last_cycle_t: Optional[float] = None
         # per-plan capacity-check cadence (recomputed as plans come and go)
         self._drain_hints: Dict[str, int] = {}
@@ -611,6 +623,7 @@ class Job:
         self.max_pending_events: Optional[int] = None
         self.shed_policy: str = "block"  # 'block' | 'drop_oldest'
         self.shed_events = 0  # total events ever shed (also a counter)
+        # fst:ephemeral warning rate-limit clock (monotonic); counters stay exact
         self._shed_warned_at = -1e9  # monotonic ts of the last warning
         # -- event-time robustness (docs/event_time.md) -----------------
         # LATE-EVENT POLICY at the watermark gate: a row whose event
@@ -635,6 +648,7 @@ class Job:
         self.allowed_lateness_ms: int = 0
         self.late_events = 0  # rows classified late (all policies)
         self.late_dropped = 0  # subset discarded ('drop'/'allow'-beyond)
+        # fst:ephemeral warning rate-limit clock (monotonic); late counters ARE checkpointed
         self._late_warned_at = -1e9
         # the horizon (event-time ms) the gate has released through —
         # rows at or below it are late by definition
@@ -655,6 +669,7 @@ class Job:
         # monotonic time of each source's last produced event (None =
         # nothing yet; armed at the first poll so a never-producing
         # source can still go idle)
+        # fst:ephemeral monotonic idle clocks re-arm at resume; the idle FLAGS are checkpointed
         self._source_last_t: List[Optional[float]] = (
             [None] * len(self._sources)
         )
@@ -1029,12 +1044,37 @@ class Job:
                     "control event adds a plan but the job has no plan "
                     "compiler (create it through the dynamic cql() path)"
                 )
+            # admission verdicts carried on the event (analysis/admit.py
+            # summaries; getattr covers pre-admission checkpointed
+            # events): a plan the gate already REJECTED must never
+            # reach the compiler/runtime — counted + logged, the rest
+            # of the event still applies
+            verdicts = getattr(ev, "admission", None) or {}
+
+            def _rejected(plan_id: str) -> bool:
+                v = verdicts.get(plan_id)
+                if v is None or v.get("admitted", True):
+                    return False
+                self.telemetry.inc("control.admission_rejected")
+                _LOG.warning(
+                    "control event %s plan %s refused: admission "
+                    "verdict rejected it (%s)",
+                    "adds" if plan_id in ev.added_plans else "updates",
+                    plan_id,
+                    [f.get("rule") for f in v.get("findings", ())],
+                )
+                return True
+
             for plan_id, cql in ev.added_plans.items():
+                if _rejected(plan_id):
+                    continue
                 self.add_plan(
                     self._plan_compiler(cql, plan_id), dynamic=True
                 )
                 self._dynamic_cql[plan_id] = cql
             for plan_id, cql in ev.updated_plans.items():
+                if _rejected(plan_id):
+                    continue  # the running plan stays as-is
                 self.remove_plan(plan_id)
                 self.add_plan(
                     self._plan_compiler(cql, plan_id), dynamic=True
@@ -1186,6 +1226,7 @@ class Job:
             pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="fst-warm"
             )
+            # fst:ephemeral lazily-created background compile pool; a fresh process rebuilds it
             self._compile_pool = pool
         rt.flush_warm = (sig, pool.submit(compile_it))
 
@@ -1441,6 +1482,7 @@ class Job:
             pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="fst-fetch"
             )
+            # fst:ephemeral lazily-created drain fetch-thread pool; a fresh process rebuilds it
             self._fetch_pool_ = pool
         return pool
 

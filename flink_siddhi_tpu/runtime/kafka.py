@@ -545,6 +545,7 @@ class KafkaSource(Source):
         allowed_lateness_ms: int = 0,
         client: Optional[KafkaClient] = None,
         watermark=None,  # WatermarkStrategy template, cloned per partition
+        idle_timeout_ms: Optional[float] = None,
     ) -> None:
         from .sources import make_column_decoder
 
@@ -559,6 +560,7 @@ class KafkaSource(Source):
         self._max_bytes = max_bytes
         self._lateness = int(allowed_lateness_ms)
         self._arrival = 0
+        # fst:ephemeral close() marker: a restored source is open by construction
         self._closed = False
         if client is None:
             host, _, port = bootstrap.partition(":")
@@ -603,17 +605,88 @@ class KafkaSource(Source):
             if watermark is not None
             else None
         )
+        # PER-PARTITION IDLENESS (the event-time carried item from
+        # PR 10): a partition that produced at least once pins this
+        # source's min-across-partitions claim FOREVER if it goes
+        # silent — before this knob, only the job-level idle timeout
+        # (which silences the whole source) could unpin the stream.
+        # A partition with no records for idle_timeout_ms is excluded
+        # from the min (0 = excluded on the first poll it sits out,
+        # deterministic for tests; None disables — historical
+        # behavior); it un-idles on its next record, and its
+        # now-possibly-late rows are the gate's late-policy problem,
+        # exactly like an un-idling source (Flink idleness semantics).
+        # Idle FLAGS are checkpointed; the monotonic clocks re-arm.
+        self._idle_timeout_ms = (
+            None if idle_timeout_ms is None else float(idle_timeout_ms)
+        )
+        self._part_idle: Dict[int, bool] = {p: False for p in parts}
+        # fst:ephemeral monotonic idle clocks re-arm at resume; the per-partition idle FLAGS are checkpointed
+        self._part_last_t: Dict[int, Optional[float]] = {
+            p: None for p in parts
+        }
+        # fst:ephemeral registry handle; Job.__init__ re-binds after restore
+        self._telemetry = None
 
     def _partition_watermark(self) -> Optional[int]:
-        """min across partitions that have observed >= 1 record."""
+        """min across partitions that have observed >= 1 record,
+        excluding partitions currently marked idle. All-idle = None
+        (the claim HOLDS at its last published value — idle means 'no
+        information', not 'stream complete')."""
         wms = [
             w
-            for w in (
-                s.current() for s in self._wm_strategies.values()
-            )
+            for p, s in self._wm_strategies.items()
+            if not self._part_idle.get(p, False)
+            for w in (s.current(),)
             if w is not None
         ]
         return min(wms) if wms else None
+
+    def _pending_partitions(self) -> set:
+        """Partitions with EVIDENCE of data not yet consumed: records
+        waiting in the fetch buffer, a fetch position behind the known
+        broker high watermark, or no high watermark observed yet
+        (unknown = assume a backlog, the same rule _refill applies).
+        These are not silent — idling one would misclassify its
+        still-queued rows as late once they drain (a high-volume
+        sibling partition can monopolize poll's max_events slice for
+        many polls)."""
+        pending = {pid for pid, _o, _t, _v in self._buffer}
+        for p, pos in self._fetch_pos.items():
+            if pos < self._hw.get(p, 1 << 62):
+                pending.add(p)
+        return pending
+
+    def _track_partition_idleness(self, produced) -> None:
+        """Advance the per-partition idle state machine for one poll:
+        ``produced`` partitions — consumed this poll OR with pending
+        unconsumed evidence (see _pending_partitions) — re-arm (and
+        un-idle); the rest idle once their clock passes the timeout.
+        Runs on EMPTY polls too — a backlog on one partition must not
+        need fresh records on another to unpin."""
+        now = time.monotonic()
+        produced = set(produced) | self._pending_partitions()
+        for p in self._part_idle:
+            if p in produced:
+                self._part_last_t[p] = now
+                if self._part_idle[p]:
+                    self._part_idle[p] = False
+                    if self._telemetry is not None:
+                        self._telemetry.inc("idle.partition_unidled")
+            elif not self._part_idle[p]:
+                if self._part_last_t[p] is None:
+                    self._part_last_t[p] = now  # arm at first poll
+                if (now - self._part_last_t[p]) * 1e3 >= (
+                    self._idle_timeout_ms
+                ):
+                    self._part_idle[p] = True
+                    if self._telemetry is not None:
+                        self._telemetry.inc("idle.partition_marked")
+                    _LOG.debug(
+                        "%s/%d: partition idle; excluded from the "
+                        "min watermark until its next record",
+                        self.topic, p,
+                    )
 
     def close(self) -> None:
         """Stop consuming after the current backlog drains."""
@@ -622,8 +695,10 @@ class KafkaSource(Source):
     def bind_telemetry(self, registry) -> None:
         """Mirror the client's faults.kafka.* counters into the job's
         registry (Job.__init__ calls this for every source that has
-        it)."""
+        it); partition-idleness transitions count here too."""
         self.client.bind_telemetry(registry)
+        # fst:ephemeral registry handle; Job.__init__ re-binds after restore
+        self._telemetry = registry
 
     def _refill(self) -> None:
         """One Fetch for every partition whose fetch position is not
@@ -684,6 +759,17 @@ class KafkaSource(Source):
             if self._closed and not backlog:
                 self.client.close()
                 return None, np.iinfo(np.int64).max, True
+            if (
+                self._wm_strategies is not None
+                and self._idle_timeout_ms is not None
+            ):
+                # an all-empty poll still advances the idle state
+                # machine AND republishes the min: the laggard's
+                # exclusion must not wait for fresh records on some
+                # other partition (the claim only ever tightens — the
+                # executor maxes source claims)
+                self._track_partition_idleness(produced=frozenset())
+                return None, self._partition_watermark(), False
             return None, None, False
         from .sources import decoded_columns
 
@@ -724,14 +810,20 @@ class KafkaSource(Source):
         if self._wm_strategies is not None:
             # per-partition generation: each partition's strategy sees
             # only its own records' event times; the published claim is
-            # the min across producing partitions
+            # the min across producing, non-idle partitions
+            produced = set()
             for p in np.unique(pids).tolist():
                 strat = self._wm_strategies.get(p)
                 if strat is None:  # defensive: unassigned pid appeared
                     strat = self._wm_strategies[p] = (
                         self._wm_template.clone()
                     )
+                    self._part_idle.setdefault(p, False)
+                    self._part_last_t.setdefault(p, None)
                 strat.observe(ts[pids == p])
+                produced.add(p)
+            if self._idle_timeout_ms is not None:
+                self._track_partition_idleness(produced)
             wm = self._partition_watermark()
         else:
             wm = int(ts.max()) - self._lateness if len(ts) else None
@@ -756,6 +848,12 @@ class KafkaSource(Source):
                 str(p): s.state_dict()
                 for p, s in self._wm_strategies.items()
             }
+            # idle FLAGS survive restore (an idle partition must not
+            # re-pin the claim it was excluded from); the monotonic
+            # clocks re-arm at resume
+            d["part_idle"] = {
+                str(p): bool(b) for p, b in self._part_idle.items()
+            }
         return d
 
     def load_state_dict(self, d: dict) -> None:
@@ -769,6 +867,10 @@ class KafkaSource(Source):
                     )
                 if strat is not None:
                     strat.load_state_dict(sd)
+        if d.get("part_idle") is not None:
+            for p, b in d["part_idle"].items():
+                self._part_idle[int(p)] = bool(b)
+                self._part_last_t.setdefault(int(p), None)
         # fetched-but-unconsumed records are not part of the snapshot:
         # refetch from the restored consumed position (v2 fetches
         # return the whole containing batch; _refill skips the

@@ -500,6 +500,7 @@ class _DecodedLinesSource(Source):
         # with no tell/seek at all (sockets) are not degraded — an
         # arrival-order position was never promised for them.
         self._state_degraded = False
+        # fst:ephemeral registry handle; Job.__init__ re-binds after restore
         self._telemetry = None
 
     def bind_telemetry(self, registry) -> None:
@@ -693,10 +694,12 @@ class SocketLineSource(_DecodedLinesSource):
         self._fmt = fmt
         self._delim = delim
         self._q: list = []
+        # fst:ephemeral live socket buffer accounting; network data is not checkpointable (sockets have no position)
         self._q_bytes = 0
         self._max_buffer = max_buffer_bytes
         self.dropped_bytes = 0
         self._qlock = threading.Lock()
+        # fst:ephemeral close() marker: a restored listener is open by construction
         self._closed = False
 
         src = self

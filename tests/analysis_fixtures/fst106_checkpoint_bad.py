@@ -1,0 +1,31 @@
+"""KNOWN-BAD fixture: the PR 10 event-time-gate bug class,
+reconstructed — a checkpoint-covered class (it has ``state_dict``)
+grows mutable run-loop state that never joins the snapshot. The gate
+horizon advanced per cycle but died on restore, so a restarted job
+re-admitted rows it had already released past. fstlint must flag both
+uncovered attributes (FST106). Lint fixture only."""
+
+
+class Gate:
+    def __init__(self):
+        self._source_wm = 0
+        self._released_wm = 0
+        self._gate_wm = 0
+
+    def release(self, wm):
+        # BAD: mutated every cycle, absent from state_dict below and
+        # not annotated ephemeral — silently dies on restore
+        self._released_wm = max(self._released_wm, wm)
+        # BAD: same class of forgotten state
+        self._gate_wm = max(self._gate_wm, self._released_wm)
+        return self._gate_wm
+
+    def observe(self, wm):
+        self._source_wm = max(self._source_wm, wm)
+
+    def state_dict(self):
+        # covers _source_wm only; the gate horizons were forgotten
+        return {"source_wm": self._source_wm}
+
+    def load_state_dict(self, d):
+        self._source_wm = int(d["source_wm"])

@@ -1,0 +1,35 @@
+"""Corrected twin of fst106_checkpoint_bad.py: the gate horizons join
+``state_dict``/``load_state_dict`` (the actual PR 10 fix), and a
+genuinely un-checkpointable monotonic clock carries an explicit
+``# fst:ephemeral`` annotation with its reason. fstlint must stay
+quiet."""
+
+
+class Gate:
+    def __init__(self):
+        self._source_wm = 0
+        self._released_wm = 0
+        self._gate_wm = 0
+        # fst:ephemeral warning rate-limit clock (monotonic); restore re-arms it
+        self._warned_at = -1e9
+
+    def release(self, wm, now=0.0):
+        self._released_wm = max(self._released_wm, wm)
+        self._gate_wm = max(self._gate_wm, self._released_wm)
+        self._warned_at = now
+        return self._gate_wm
+
+    def observe(self, wm):
+        self._source_wm = max(self._source_wm, wm)
+
+    def state_dict(self):
+        return {
+            "source_wm": self._source_wm,
+            "released_wm": self._released_wm,
+            "gate_wm": self._gate_wm,
+        }
+
+    def load_state_dict(self, d):
+        self._source_wm = int(d["source_wm"])
+        self._released_wm = int(d["released_wm"])
+        self._gate_wm = int(d["gate_wm"])

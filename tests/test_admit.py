@@ -1,0 +1,312 @@
+"""Admission-time query analysis (analysis/admit.py).
+
+Pins the three tentpole contracts: (1) every legitimate zoo entry is
+admitted under default budgets with FINITE reported bounds, and the
+footprint bound dominates the actually-materialized state; (2) every
+hostile zoo entry is rejected with its exact ADM rule id; (3) the
+shape-bucket plan signature collides on constants-only changes, splits
+across shape/bucket boundaries, and is stable across process restarts
+— the AOT executable-cache key contract (docs/static_analysis.md).
+"""
+
+import dataclasses
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.analysis.admit import (
+    ADM_RULES,
+    AdmissionBudgets,
+    AdmissionError,
+    DEFAULT_BUDGETS,
+    STRICT_BUDGETS,
+    admit_plan,
+    analyze_plan,
+    plan_signature,
+)
+from flink_siddhi_tpu.analysis.zoo import (
+    HOSTILE_ZOO,
+    PLAN_ZOO,
+    compile_zoo,
+    hostile_budgets,
+    zoo_schemas,
+)
+from flink_siddhi_tpu.compiler.config import EngineConfig
+from flink_siddhi_tpu.compiler.plan import compile_plan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def zoo():
+    return dict(compile_zoo())
+
+
+def _sig(cql, capacity=128, plan_id="p", **schemas_kw):
+    plan = compile_plan(cql, zoo_schemas(), plan_id=plan_id)
+    return plan_signature(plan, capacity=capacity)
+
+
+# -- resource bounds --------------------------------------------------------
+
+
+def test_all_zoo_entries_admitted_with_finite_bounds(zoo):
+    for name, plan in zoo.items():
+        rep = analyze_plan(plan, budgets=DEFAULT_BUDGETS)
+        assert rep.admitted, (name, [i.render() for i in rep.findings])
+        assert isinstance(rep.state_bytes, int) and rep.state_bytes >= 0
+        assert isinstance(rep.acc_bytes, int) and rep.acc_bytes > 0
+        assert 0 <= rep.amplification < 1 << 20
+        assert rep.signature is not None
+        # per-artifact cost rows surfaced for every artifact
+        assert len(rep.per_artifact) == len(plan.artifacts)
+
+
+@pytest.mark.parametrize(
+    "entry", sorted(HOSTILE_ZOO), ids=sorted(HOSTILE_ZOO)
+)
+def test_hostile_zoo_rejected_by_exact_rule(entry):
+    cql, expected_rule, profile = HOSTILE_ZOO[entry]
+    plan = compile_plan(cql, zoo_schemas(), plan_id=f"hostile:{entry}")
+    rep = analyze_plan(plan, budgets=hostile_budgets(profile))
+    assert not rep.admitted, entry
+    assert expected_rule in {i.rule for i in rep.findings}, (
+        entry, [i.render() for i in rep.findings],
+    )
+    assert expected_rule in ADM_RULES
+    # and the SAME entry under no-residency default budgets still
+    # rejects for the default-profile entries (they are hostile per
+    # se, not just under the strict profile)
+    if profile == "default":
+        with pytest.raises(AdmissionError) as ei:
+            admit_plan(plan, budgets=DEFAULT_BUDGETS)
+        assert expected_rule in {i.rule for i in ei.value.issues}
+
+
+@pytest.mark.parametrize(
+    "name",
+    ["length_window_agg", "chain_pattern", "multiquery_stack6"],
+)
+def test_footprint_bound_dominates_measured_state(name, zoo):
+    """The reported worst-case state footprint must be >= the nbytes
+    the plan ACTUALLY materializes at init (the bound is the
+    admission-time bucket shapes, which is exactly what init builds)."""
+    plan = zoo[name]
+    rep = analyze_plan(plan, budgets=DEFAULT_BUDGETS)
+    states = plan.init_state()
+    import jax
+
+    actual = sum(
+        np.asarray(leaf).nbytes
+        for leaf in jax.tree_util.tree_leaves(states)
+    )
+    assert actual > 0
+    assert rep.state_bytes >= actual, (name, rep.state_bytes, actual)
+
+
+def test_missing_cost_info_hook_is_adm001(zoo, monkeypatch):
+    from flink_siddhi_tpu.compiler.select import SelectArtifact
+
+    monkeypatch.delattr(SelectArtifact, "cost_info")
+    rep = analyze_plan(zoo["filter_select"], budgets=DEFAULT_BUDGETS)
+    assert [i.rule for i in rep.findings] == ["ADM001"]
+
+
+def test_malformed_cost_info_is_adm002(zoo, monkeypatch):
+    from flink_siddhi_tpu.compiler.select import SelectArtifact
+
+    monkeypatch.setattr(
+        SelectArtifact, "cost_info", lambda self: {"name": self.name}
+    )
+    rep = analyze_plan(zoo["filter_select"], budgets=DEFAULT_BUDGETS)
+    assert [i.rule for i in rep.findings] == ["ADM002"]
+
+
+def test_residency_budget_passes_bounded_patterns(zoo):
+    """STRICT (bounded-residency) budgets admit the 'within'-bounded
+    chain while rejecting its unbounded twin — the knob rejects the
+    hazard, not the feature."""
+    ok = analyze_plan(zoo["chain_pattern_within"], budgets=STRICT_BUDGETS)
+    assert ok.admitted, [i.render() for i in ok.findings]
+    bad = analyze_plan(zoo["chain_pattern"], budgets=STRICT_BUDGETS)
+    assert {i.rule for i in bad.findings} == {"ADM110"}
+
+
+# -- compile_plan wiring ----------------------------------------------------
+
+
+def test_engineconfig_budgets_reject_at_compile(monkeypatch):
+    cql, expected_rule, _ = HOSTILE_ZOO["hostile_length_window_1m"]
+    cfg = EngineConfig(admission_budgets=DEFAULT_BUDGETS)
+    with pytest.raises(AdmissionError) as ei:
+        compile_plan(cql, zoo_schemas(), plan_id="p", config=cfg)
+    assert expected_rule in {i.rule for i in ei.value.issues}
+    # FST_VERIFY_PLANS=0 is the bench escape hatch: even explicit
+    # budgets are skipped (same contract as plancheck)
+    monkeypatch.setenv("FST_VERIFY_PLANS", "0")
+    plan = compile_plan(cql, zoo_schemas(), plan_id="p", config=cfg)
+    assert plan.plan_id == "p"
+
+
+def test_budget_knobs_are_enforced_individually(zoo):
+    plan = zoo["length_window_agg"]
+    tight_state = AdmissionBudgets(max_state_bytes=16)
+    assert {
+        i.rule
+        for i in analyze_plan(plan, budgets=tight_state).findings
+    } == {"ADM101"}
+    tight_acc = AdmissionBudgets(max_acc_bytes=1024)
+    assert {
+        i.rule
+        for i in analyze_plan(plan, budgets=tight_acc).findings
+    } == {"ADM102"}
+    tight_amp = AdmissionBudgets(max_amplification=0)
+    got = {
+        i.rule
+        for i in analyze_plan(plan, budgets=tight_amp).findings
+    }
+    assert got == {"ADM120"}
+
+
+# -- shape-bucket plan signatures -------------------------------------------
+
+
+def test_signature_constants_only_change_collides():
+    a = _sig("from S[id == 2] select id, name, price insert into out")
+    b = _sig(
+        "from S[id == 7] select id, name, price insert into out",
+        plan_id="other-tenant",
+    )
+    assert a == b  # filter constants AND plan ids are not shape
+
+
+def test_signature_time_span_constants_collide():
+    a = _sig("from S#window.time(3 sec) select sum(price) as t "
+             "insert into out")
+    b = _sig("from S#window.time(5 sec) select sum(price) as t "
+             "insert into out")
+    assert a == b  # span is a literal operand; state shapes identical
+
+
+def test_signature_within_constants_collide_presence_splits():
+    p5 = _sig("from every s1 = S[id == 1] -> s2 = S[id == 2] "
+              "within 5 sec select s1.price as a insert into out")
+    p6 = _sig("from every s1 = S[id == 1] -> s2 = S[id == 2] "
+              "within 6 sec select s1.price as a insert into out")
+    p0 = _sig("from every s1 = S[id == 1] -> s2 = S[id == 2] "
+              "select s1.price as a insert into out")
+    assert p5 == p6
+    assert p5 != p0  # with/without within are different programs
+
+
+def test_signature_operator_change_splits():
+    a = _sig("from S[id == 2] select id, name, price insert into out")
+    c = _sig("from S[id > 2] select id, name, price insert into out")
+    assert a != c  # == vs > is structure, not a constant
+
+
+def test_signature_window_width_across_shape_boundary_splits():
+    w16 = _sig("from S#window.length(16) select sum(price) as t "
+               "insert into out")
+    w17 = _sig("from S#window.length(17) select sum(price) as t "
+               "insert into out")
+    assert w16 != w17  # the ring shape IS the executable's shape
+
+
+def test_signature_batch_capacity_buckets():
+    q = "from S[id == 2] select id, name, price insert into out"
+    assert _sig(q, capacity=100) == _sig(q, capacity=128)
+    assert _sig(q, capacity=128) != _sig(q, capacity=129)
+
+
+def test_signature_stable_across_process_restart(zoo):
+    """The AOT-cache key must be reproducible in a FRESH process (no
+    Python hash(), no id()s, no iteration-order dependence) — a
+    restart that recomputed different keys would cold-compile every
+    running tenant's plan again."""
+    names = ["filter_select", "chain_pattern_within", "window_join"]
+    here = {n: plan_signature(zoo[n]) for n in names}
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['FST_VERIFY_PLANS'] = '0'\n"
+        "from flink_siddhi_tpu.analysis.zoo import PLAN_ZOO, zoo_schemas\n"
+        "from flink_siddhi_tpu.analysis.admit import plan_signature\n"
+        "from flink_siddhi_tpu.compiler.plan import compile_plan\n"
+        f"for n in {names!r}:\n"
+        "    p = compile_plan(PLAN_ZOO[n], zoo_schemas(),\n"
+        "                     plan_id=f'zoo:{n}')\n"
+        "    print(n, plan_signature(p))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=REPO, timeout=240,
+        check=True,
+    ).stdout
+    fresh = dict(line.split() for line in out.strip().splitlines())
+    assert fresh == here
+
+
+# -- verdicts on the control plane ------------------------------------------
+
+
+def test_admission_summary_rides_metadata_events_json():
+    from flink_siddhi_tpu.control.events import (
+        MetadataControlEvent,
+        control_event_from_json,
+        control_event_to_json,
+    )
+
+    plan = compile_plan(
+        PLAN_ZOO["filter_select"], zoo_schemas(), plan_id="t1"
+    )
+    rep = analyze_plan(plan, budgets=DEFAULT_BUDGETS)
+    b = MetadataControlEvent.builder()
+    pid = b.add_execution_plan(
+        PLAN_ZOO["filter_select"], admission=rep.summary()
+    )
+    ev = control_event_from_json(control_event_to_json(b.build()))
+    assert ev.admission[pid]["admitted"] is True
+    assert ev.admission[pid]["signature"] == rep.signature
+    assert ev.admission[pid]["state_bytes"] == rep.state_bytes
+
+
+def test_rejected_admission_verdict_refuses_control_add():
+    """An add whose carried verdict says admitted=False must never
+    reach the compiler/runtime — counted, logged, the rest of the
+    event still applies (the control-plane groundwork)."""
+    import dataclasses as dc
+
+    from flink_siddhi_tpu import CEPEnvironment, MetadataControlEvent, SiddhiCEP
+
+    @dc.dataclass
+    class Event:
+        id: int
+        price: float
+        timestamp: int
+
+    events = [Event(1, float(i), 1000 * (i + 1)) for i in range(6)]
+    b = MetadataControlEvent.builder()
+    pid_ok = b.add_execution_plan(
+        "from S select id, price insert into ok"
+    )
+    pid_bad = b.add_execution_plan(
+        "from S select id, price insert into bad",
+        admission={
+            "admitted": False,
+            "findings": [{"rule": "ADM110", "where": "x", "message": "m"}],
+        },
+    )
+    env = CEPEnvironment(batch_size=2)
+    es = SiddhiCEP.define(
+        "S", events, ["id", "price", "timestamp"], env=env
+    ).cql([(0, b.build())])
+    job = es.execute()
+    assert len(job.results("ok")) == len(events)
+    assert job.results("bad") == []
+    assert pid_bad not in job.plan_ids and pid_ok in job.plan_ids
+    snap = job.telemetry.snapshot()
+    assert snap["counters"]["control.admission_rejected"] == 1

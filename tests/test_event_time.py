@@ -720,6 +720,125 @@ def test_kafka_empty_partition_does_not_pin_watermark():
         broker.close()
 
 
+def test_kafka_partition_idleness_unpins_min_watermark():
+    """One silent PARTITION must stop pinning the source's min claim
+    after idle_timeout_ms (0 = the first poll it sits out), un-idle on
+    its next record, and carry its idle flag through checkpoints — the
+    PR 10 carried item (before this, only the job-level timeout could
+    unpin, by silencing the whole SOURCE)."""
+    import json
+
+    from tests.fake_kafka import FakeBroker
+    from flink_siddhi_tpu.runtime.kafka import KafkaSource
+    from flink_siddhi_tpu.telemetry import MetricsRegistry
+
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t", partitions=2)
+
+        def rec(i, t):
+            return json.dumps(
+                {"id": i, "price": 1.0, "timestamp": t}
+            ).encode()
+
+        broker.append("t", 0, [rec(1, 10_000)])
+        broker.append("t", 1, [rec(2, 5_000)])
+        schema = _schema()
+
+        def make_src():
+            return KafkaSource(
+                "S", schema, broker.bootstrap, "t",
+                ts_field="timestamp",
+                watermark=BoundedDisorderWatermark(1_000),
+                idle_timeout_ms=0,
+            )
+
+        src = make_src()
+        reg = MetricsRegistry()
+        src.bind_telemetry(reg)
+        _b, wm, _d = src.poll(64)
+        assert wm == 3_999  # both produced: plain min across partitions
+        # partition 1 goes silent while 0 keeps producing: with the
+        # 0ms timeout it idles on the first poll it sits out, and the
+        # claim advances to partition 0's alone
+        broker.append("t", 0, [rec(3, 30_000)])
+        _b, wm, _d = src.poll(64)
+        assert src._part_idle[1] and not src._part_idle[0]
+        assert wm == 28_999
+        assert reg.counter("idle.partition_marked").value == 1
+        # the idle FLAG rides the checkpoint
+        d = src.state_dict()
+        assert d["part_idle"] == {"0": False, "1": True}
+        src2 = make_src()
+        src2.load_state_dict(d)
+        assert src2._part_idle[1]
+        assert src2._partition_watermark() == 28_999
+        # an all-empty poll idles the remaining partition too (0ms =
+        # first sit-out): ALL-idle means the claim HOLDS (None), not
+        # jump-to-MAX — idle is "no information", Flink semantics
+        _b, wm, done = src.poll(64)
+        assert (wm, done) == (None, False)
+        assert src._part_idle[0] and src._part_idle[1]
+        # un-idles on its next record: the claim is that partition's
+        # own again (the source claim may regress; the job's gate
+        # watermark is monotone and classifies stragglers by policy)
+        broker.append("t", 1, [rec(4, 6_000)])
+        _b, wm, _d = src.poll(64)
+        assert not src._part_idle[1] and src._part_idle[0]
+        assert wm == 4_999
+        assert reg.counter("idle.partition_unidled").value == 1
+    finally:
+        broker.close()
+
+
+def test_kafka_partition_with_buffered_backlog_is_not_idle():
+    """A partition whose records are fetched-but-unconsumed (a
+    high-volume sibling can monopolize poll's max_events slice) is NOT
+    silent: idling it would misclassify its still-queued rows as late
+    once they drain."""
+    import json
+
+    from tests.fake_kafka import FakeBroker
+    from flink_siddhi_tpu.runtime.kafka import KafkaSource
+
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t", partitions=2)
+
+        def rec(i, t):
+            return json.dumps(
+                {"id": i, "price": 1.0, "timestamp": t}
+            ).encode()
+
+        broker.append(
+            "t", 0, [rec(i, 10_000 + 1_000 * i) for i in range(4)]
+        )
+        broker.append("t", 1, [rec(9, 5_000)])
+        schema = _schema()
+        src = KafkaSource(
+            "S", schema, broker.bootstrap, "t",
+            ts_field="timestamp",
+            watermark=BoundedDisorderWatermark(1_000),
+            idle_timeout_ms=0,
+        )
+        # poll(2) consumes only partition 0's head; partition 1's
+        # record waits in the fetch buffer — it must not idle even at
+        # the 0ms timeout. (The claim is p0's alone for now: a
+        # partition that never PRODUCED does not pin the min — the
+        # pre-existing PR 10 semantics; idleness must not make that
+        # permanent.)
+        _b, wm, _d = src.poll(2)
+        assert not src._part_idle[1]
+        assert wm == 9_999
+        # draining the backlog rejoins p1: the true min again (the
+        # executor's per-source max keeps the gate monotone)
+        _b, wm, _d = src.poll(64)
+        assert not src._part_idle[1]
+        assert wm == 3_999
+    finally:
+        broker.close()
+
+
 # -- checkpoint / supervised recovery ---------------------------------------
 
 def test_gate_watermark_state_survives_checkpoint_roundtrip(tmp_path):

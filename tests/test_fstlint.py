@@ -33,6 +33,7 @@ CASES = {
     "FST103": ("fst103_falsy_zero", 2),  # PR 8 reconstruction
     "FST104": ("fst104_tracer_leak", 2),
     "FST105": ("fst105_retrace", 2),
+    "FST106": ("fst106_checkpoint", 2),  # PR 10 reconstruction
 }
 
 
@@ -103,6 +104,79 @@ def test_fst101_mutually_exclusive_branches_do_not_flag():
     )
     findings = lint_module(src, "t.py")
     assert [(f.rule, f.line) for f in findings] == [("FST101", 11)]
+
+
+def test_fst106_ephemeral_requires_reason():
+    """A bare `# fst:ephemeral` is itself a finding — like baseline
+    suppressions, the reason is mandatory."""
+    src = (
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        # fst:ephemeral\n"
+        "        self._clock = 0\n"
+        "    def tick(self):\n"
+        "        self._clock += 1\n"
+        "    def state_dict(self):\n"
+        "        return {}\n"
+    )
+    findings = lint_module(src, "t.py")
+    # the bare mark is flagged AND the attr stays uncovered until the
+    # reason lands — both surface
+    assert [(f.rule, f.line) for f in findings] == [
+        ("FST106", 4), ("FST106", 6),
+    ]
+    assert "without a reason" in findings[0].message
+
+
+def test_fst106_uncovered_class_is_out_of_scope():
+    """Classes with no checkpoint story (no state_dict, no
+    fst:checkpointed mark) are not linted — the rule polices snapshot
+    COMPLETENESS, not snapshot existence."""
+    src = (
+        "class Scratch:\n"
+        "    def tick(self):\n"
+        "        self._n = 1\n"
+    )
+    assert lint_module(src, "t.py") == []
+
+
+def test_fst106_external_by_coverage_resolves_snapshot_job():
+    """The `# fst:checkpointed by=` annotation pulls coverage from
+    runtime/checkpoint.py: an attr snapshot_job reads is covered, a
+    made-up one is flagged."""
+    src = (
+        "# fst:checkpointed by=flink_siddhi_tpu/runtime/checkpoint.py:snapshot_job\n"
+        "class J:\n"
+        "    def run(self):\n"
+        "        self._epoch_ms = 5\n"      # snapshot_job reads job._epoch_ms
+        "        self._never_saved = 1\n"
+    )
+    findings = lint_module(src, "t.py")
+    assert [(f.rule, f.line) for f in findings] == [("FST106", 5)]
+    assert "_never_saved" in findings[0].message
+
+
+def test_rule_filter_cli(tmp_path):
+    """`fstlint --rule` restricts output to one rule so it can be
+    iterated without a full-repo sweep."""
+    bad = tmp_path / "planted.py"
+    bad.write_text(
+        "def f(j):\n"
+        "    return j.drain_interval_ms or 500\n"
+    )
+    # the planted file has an FST103 finding; filtered to FST106 it
+    # reads clean, filtered to FST103 it fails
+    assert main([str(bad), "--no-baseline", "--rule", "FST106"]) == 0
+    assert main([str(bad), "--no-baseline", "--rule", "FST103"]) == 1
+    with pytest.raises(SystemExit):
+        main([str(bad), "--rule", "FST999"])
+    # a baseline regenerated from a filtered sweep would drop other
+    # rules' suppressions — the combination is refused
+    with pytest.raises(SystemExit):
+        main([
+            str(bad), "--rule", "FST103",
+            "--write-baseline", str(tmp_path / "gen.toml"),
+        ])
 
 
 def test_repo_lints_clean_with_checked_in_baseline():
