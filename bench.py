@@ -5,7 +5,7 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line (``schema_version: 6``). One invocation measures
+Prints ONE JSON line (``schema_version: 7``). One invocation measures
 THREE execution modes and emits all of them in the same document, so a
 regression in any path stays a tracked number:
 
@@ -93,6 +93,18 @@ accounting (``late_dropped`` == injected stragglers, ``idle_marked``
 gated by scripts/check_bench_schema.py). ``--disorder`` scales the
 per-skew event count to full size (BENCH_DISORDER_EVENTS /
 BENCH_DISORDER_CONFIG override).
+
+Schema v7 (dynamic-control-plane round) adds the ``control`` block:
+one sustained-load run against a live control plane — Q tenant
+queries admitted/retired/paused at micro-batch epoch boundaries while
+the load flows (``admit_rate_qps``, ``steady_state_events_per_sec``
+at the concurrent stack, ``added_latency_p99_ms`` vs
+``baseline_p99_ms``), a hostile no-'within' tenant refused by exact
+ADM rule id under the strict admission budgets, ``dropped_events``
+gated == 0, and the stack-join / AOT-executable-cache counters
+showing admits are data updates and the first-compile cost is paid
+once per shape class (docs/control_plane.md). ``--control`` scales
+to O(100s) of concurrent queries (BENCH_CONTROL_QUERIES overrides).
 
 ``--fault`` (composable with ``--dryrun``): appends a ``recovery``
 block — a supervised run (runtime/supervisor.py) under a seeded crash
@@ -1139,6 +1151,228 @@ def _disorder_block(dryrun, full=False):
     }
 
 
+class _CyclingSource:
+    """Sustained-load source for the control block: serves
+    ``n_batches`` prebuilt-template batches with monotonically
+    advancing timestamps (one np add per poll — no per-record work)."""
+
+    def __init__(self, schema, batch, n_batches, n_ids=50):
+        self.stream_id = "S"
+        self.schema = schema
+        self.batch = batch
+        self.n_batches = n_batches
+        self.i = 0
+        self.served = 0
+        ids = (np.arange(batch) % n_ids).astype(np.int64)
+        self._ids = ids
+        self._price = np.arange(batch, dtype=np.float64)
+        self._ts0 = 1_000 + np.arange(batch, dtype=np.int64)
+
+    def poll(self, max_events):
+        from flink_siddhi_tpu.schema.batch import EventBatch
+
+        if self.i >= self.n_batches:
+            return None, None, True
+        ts = self._ts0 + self.i * self.batch
+        b = EventBatch(
+            self.stream_id,
+            self.schema,
+            {
+                "id": self._ids,
+                "price": self._price,
+                "timestamp": ts,
+            },
+            ts,
+        )
+        self.i += 1
+        self.served += len(b)
+        return b, int(ts.max()), self.i >= self.n_batches
+
+
+def _control_block(dryrun, full=False):
+    """Schema v7: the dynamic query control plane as a MEASURED
+    surface (docs/control_plane.md; ROADMAP direction #1 done-when).
+
+    One sustained-load run, three phases against the same live job:
+
+    * **baseline** — per-cycle wall time with one admitted query;
+    * **admit churn** — Q-1 further tenant queries admitted through
+      control events (plus one HOSTILE no-within query that must be
+      refused by ADM rule id under the strict budgets), then a
+      retire/disable/enable mix — all applied at micro-batch epoch
+      boundaries while the load keeps flowing. ``admit_rate_qps`` is
+      Q / the wall time from push to every query live;
+      ``added_latency_p99_ms`` is the churn phase's per-cycle p99
+      (admission + stack-join + cache work included) next to
+      ``baseline_p99_ms``;
+    * **steady state** — ev/s with all ``concurrent_queries`` live.
+
+    The structural claims ride as counters, gated by
+    scripts/check_bench_schema.py: ``dropped_events`` must be 0 (every
+    served event processed — no shed, no late drops, no tear at any
+    mutation boundary), ``stack_joins`` counts the admits that were
+    pure data updates, and the AOT ``cache`` block shows the
+    first-compile cost was paid once per shape class, not once per
+    query (hosts 2..N are cache hits). ``--control`` (or ``full``)
+    scales to O(100s) of concurrent queries; the default — and the
+    --dryrun tier-1 gate — runs a small config so the block is always
+    present in a v7 line."""
+    from flink_siddhi_tpu.analysis.admit import STRICT_BUDGETS
+    from flink_siddhi_tpu.app.service import ControlQueueSource
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.control import ControlPlane
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+    from flink_siddhi_tpu.telemetry import LatencyHistogram
+
+    n_queries = int(
+        os.environ.get(
+            "BENCH_CONTROL_QUERIES", 128 if full else 24
+        )
+    )
+    batch = 2_048 if dryrun and not full else 4_096
+    baseline_cycles = 16 if dryrun else 40
+    steady_cycles = 24 if dryrun else 80
+    n_ids = 50
+    schema = StreamSchema(
+        [
+            ("id", AttributeType.INT),
+            ("price", AttributeType.DOUBLE),
+            ("timestamp", AttributeType.LONG),
+        ]
+    )
+
+    def compiler(cql, pid):
+        return compile_plan(cql, {"S": schema}, plan_id=pid)
+
+    def tenant_cql(q):
+        a, b = q % n_ids, (q * 7 + 1) % n_ids
+        return (
+            f"from every s1 = S[id == {a}] -> s2 = S[id == {b}] "
+            "within 5 sec "
+            "select s1.timestamp as t1, s2.timestamp as t2 "
+            "insert into out"
+        )
+
+    # generous supply; the run stops when the phases are done
+    src = _CyclingSource(schema, batch, n_batches=1 << 20, n_ids=n_ids)
+    ctrl = ControlQueueSource()
+    job = Job(
+        [], [src], batch_size=batch, time_mode="processing",
+        control_sources=[ctrl], plan_compiler=compiler,
+        retain_results=False,
+    )
+    job.telemetry.enabled = True  # accounting surface, as in disorder
+    # the multi-tenant admission profile: unbounded-residency tenants
+    # are refused at apply time by rule id
+    job.admission_budgets = STRICT_BUDGETS
+    plane = ControlPlane(job, ctrl)
+
+    def cycles(n, hist=None):
+        for _ in range(n):
+            t0 = time.perf_counter()
+            job.run_cycle()
+            if hist is not None:
+                hist.record_seconds(time.perf_counter() - t0)
+
+    # warmup: first admit compiles the shape class's executables (the
+    # one first-compile the whole block exists to amortize)
+    plane.admit(tenant_cql(0), plan_id="q0")
+    cycles(4)
+
+    base_hist = LatencyHistogram()
+    cycles(baseline_cycles, base_hist)
+
+    # admit churn: Q-1 tenants + one hostile, applied at the next
+    # epoch boundary; the load never stops
+    churn_hist = LatencyHistogram()
+    want = {f"q{q}" for q in range(n_queries)}
+    t_admit0 = time.perf_counter()
+    for q in range(1, n_queries):
+        plane.admit(tenant_cql(q), plan_id=f"q{q}")
+    hostile_id = plane.admit(
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] "
+        "select s1.price as p1, s2.price as p2 insert into out",
+        plan_id="hostile",
+    )
+    admit_wall = None
+    for _ in range(200):
+        t0 = time.perf_counter()
+        job.run_cycle()
+        churn_hist.record_seconds(time.perf_counter() - t0)
+        if admit_wall is None and want <= set(job.plan_ids):
+            admit_wall = time.perf_counter() - t_admit0
+            break
+    hostile_rej = job.control_rejections.get(hostile_id, {})
+    # retire/disable/enable mix at epoch boundaries, load still on
+    for q in range(0, n_queries, 8):
+        plane.set_enabled(f"q{q}", False)
+    plane.retire(f"q{n_queries - 1}")
+    cycles(4, churn_hist)
+    for q in range(0, n_queries, 8):
+        plane.set_enabled(f"q{q}", True)
+    cycles(2, churn_hist)
+
+    # steady state at the full concurrent stack
+    served0 = src.served
+    t0 = time.perf_counter()
+    cycles(steady_cycles)
+    steady_elapsed = time.perf_counter() - t0
+    steady_events = src.served - served0
+    job.drain_outputs()
+
+    counters = job.telemetry.snapshot()["counters"]
+    # served - processed = shed + late_dropped + truly-lost (shed and
+    # late rows never reach processed_events); shed/late are separately
+    # accounted mechanisms, so the gated number is the truly-lost
+    # remainder only a torn mutation boundary could create
+    dropped = (
+        src.served
+        - job.processed_events
+        - int(job.shed_events)
+        - int(job.late_dropped)
+    )
+    block = {
+        "concurrent_queries": len(job.plan_ids),
+        "queries_admitted": int(counters.get("control.admitted", 0)),
+        "queries_retired": int(counters.get("control.retired", 0)),
+        "admission_rejected": int(
+            counters.get("control.admission_rejected", 0)
+        ),
+        "hostile_refused_rule": (hostile_rej.get("rules") or [None])[0],
+        "stack_joins": int(counters.get("control.stack_join", 0)),
+        "admit_wall_ms": (
+            round(admit_wall * 1e3, 1) if admit_wall else None
+        ),
+        "admit_rate_qps": (
+            round(n_queries / admit_wall, 1) if admit_wall else None
+        ),
+        "steady_state_events_per_sec": round(
+            steady_events / max(steady_elapsed, 1e-9)
+        ),
+        "events": int(src.served),
+        "dropped_events": int(dropped),
+        "baseline_p99_ms": base_hist.percentile_ms(99),
+        "added_latency_p99_ms": churn_hist.percentile_ms(99),
+        "cache": {
+            k: int(v)
+            for k, v in job.aot_cache.stats().items()
+            if k in ("hits", "misses", "evictions", "entries")
+        },
+        "dryrun": bool(dryrun and not full),
+    }
+    if dropped != 0:
+        print(
+            f"CONTROL BLOCK DROPPED EVENTS: served {src.served}, "
+            f"processed {job.processed_events} (shed "
+            f"{job.shed_events}, late {job.late_dropped}) — a "
+            "mutation boundary lost rows",
+            file=sys.stderr,
+        )
+    return block
+
+
 def main():
     config = os.environ.get("BENCH_CONFIG", "headline")
     dryrun = "--dryrun" in sys.argv
@@ -1224,7 +1458,7 @@ def main():
         # provenance: which denominator vs_baseline divides by (ADVICE
         # r4: the JSON line should be self-describing off this machine)
         "baseline_source": "pinned-measurement (BASELINE.md)",
-        "schema_version": 6,
+        "schema_version": 7,
         "modes": modes,
     }
     if set(want_modes) != {"resident", "streaming", "sink"}:
@@ -1489,6 +1723,15 @@ def main():
     # gate validates the block whenever present.
     if "--fault" in sys.argv:
         out["recovery"] = _fault_recovery_block(dryrun)
+
+    # Phase 5 (schema v7): the dynamic query control plane under
+    # sustained load — queries/s admit rate, steady-state ev/s at the
+    # concurrent stack, zero dropped events, bounded added latency,
+    # stack-join and AOT-cache accounting (gated). ``--control``
+    # scales to O(100s) of concurrent queries.
+    out["control"] = _control_block(
+        dryrun, full="--control" in sys.argv
+    )
     print(json.dumps(out))
 
 

@@ -37,6 +37,7 @@ from .control.events import (
     OperationControlEvent,
     CONTROL_STREAM,
 )
+from .control.plane import AdmissionGate, ControlPlane, ControlRejected
 
 __version__ = "0.1.0"
 
@@ -50,7 +51,10 @@ __all__ = [
     "AttributeType",
     "StreamSchema",
     "EventBatch",
+    "AdmissionGate",
     "ControlEvent",
+    "ControlPlane",
+    "ControlRejected",
     "MetadataControlEvent",
     "OperationControlEvent",
     "CONTROL_STREAM",

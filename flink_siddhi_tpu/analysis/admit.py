@@ -538,7 +538,7 @@ def analyze_plan(
     if deep or budgets is not None:
         report.state_bytes, report.acc_bytes = _footprints(plan, issues)
         if not issues:
-            report.signature = plan_signature(plan, capacity=capacity)
+            report.signature = plan.signature(capacity)
     if budgets is not None and not issues:
         issues.extend(_budget_findings(report, infos, budgets))
     report.findings = issues

@@ -15,14 +15,28 @@ Routes (JSON in/out):
                                            last-checkpoint age + restart
                                            count (Supervisor.health();
                                            503 once the restart budget
-                                           is exhausted)
+                                           is exhausted) + the control-
+                                           plane counters/cache/refusal
+                                           block (job.control_status())
     GET    /api/v1/queries               -> {"queries": [plan ids]}
-    POST   /api/v1/queries   {"cql": s}  -> {"id": plan_id}
+    GET    /api/v1/queries/<id>          -> per-query status: enabled,
+                                           fold host/slot, or the
+                                           recorded refusal (rule ids)
+    POST   /api/v1/queries   {"cql": s,
+                              "tenant"?} -> {"id": plan_id,
+                                            "admission": summary}
     PUT    /api/v1/queries/<id> {"cql"}  -> {"id": id}
     DELETE /api/v1/queries/<id>          -> {"id": id}
     POST   /api/v1/queries/<id>/enable   -> {"id": id}
     POST   /api/v1/queries/<id>/disable  -> {"id": id}
-"""
+
+Admission (docs/control_plane.md): construct the service with
+``admission=control.plane.AdmissionGate(compile_fn, budgets)`` and every
+POST/PUT body is compiled + plancheck-verified + admission-analyzed
+BEFORE an event is pushed — a hostile or over-budget query is refused
+at the boundary with HTTP 422 and the exact PLC/ADM rule ids in the
+body, and the verdict summary rides the control event so the executor
+re-checks it at apply time (defense in depth)."""
 
 from __future__ import annotations
 
@@ -110,11 +124,13 @@ class QueryControlService:
         port: int = 0,
         validate=None,  # callable(cql) raising on bad queries
         supervisor=None,  # runtime.supervisor.Supervisor for /health
+        admission=None,  # AdmissionGate: (cql, plan_id) -> summary
     ) -> None:
         self.control = control
         self.job = job
         self.validate = validate
         self.supervisor = supervisor
+        self.admission = admission
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -175,6 +191,14 @@ class QueryControlService:
                             "late_dropped": int(
                                 service.job.late_dropped
                             ),
+                            # control-plane observability: admitted /
+                            # retired / refused counters, AOT cache
+                            # hit/miss/evict, and the refusal ring — a
+                            # refused tenant add is alertable from
+                            # /health alone
+                            "control": _json_safe(
+                                service.job.control_status()
+                            ),
                         })
                     return self._reply(
                         200, {"alive": True, "supervised": False}
@@ -201,7 +225,16 @@ class QueryControlService:
                         200, _json_safe(tracer.snapshot())
                     )
                 tail = self._route()
-                if tail is None or tail:
+                if tail is None:
+                    return self._reply(404, {"error": "not found"})
+                if len(tail) == 1:
+                    # per-query status: live state, fold host/slot, or
+                    # the recorded refusal (by rule id) for a plan the
+                    # gate turned away
+                    return self._reply(
+                        *service._query_status(tail[0])
+                    )
+                if tail:
                     return self._reply(404, {"error": "not found"})
                 ids = (
                     service.job.plan_ids
@@ -215,16 +248,29 @@ class QueryControlService:
                 if tail is None:
                     return self._reply(404, {"error": "not found"})
                 if not tail:  # add query
-                    cql = self._body().get("cql")
+                    body = self._body()
+                    cql = body.get("cql")
                     if not cql:
                         return self._reply(400, {"error": "missing cql"})
                     err = service._check(cql)
                     if err:
                         return self._reply(400, {"error": err})
+                    plan_id = MetadataControlEvent.new_plan_id()
+                    summary, reject = service._admit(
+                        cql, plan_id, tenant=body.get("tenant")
+                    )
+                    if reject is not None:
+                        return self._reply(422, reject)
                     b = MetadataControlEvent.builder()
-                    plan_id = b.add_execution_plan(cql)
-                    service.control.push(b.build())
-                    return self._reply(201, {"id": plan_id})
+                    b.add_execution_plan(
+                        cql, admission=summary, plan_id=plan_id
+                    )
+                    ev = b.build()
+                    ev.tenant = body.get("tenant")
+                    service.control.push(ev)
+                    return self._reply(
+                        201, {"id": plan_id, "admission": summary}
+                    )
                 if len(tail) == 2 and tail[1] in ("enable", "disable"):
                     ev = (
                         OperationControlEvent.enable_query(tail[0])
@@ -239,16 +285,26 @@ class QueryControlService:
                 tail = self._route()
                 if tail is None or len(tail) != 1:
                     return self._reply(404, {"error": "not found"})
-                cql = self._body().get("cql")
+                body = self._body()
+                cql = body.get("cql")
                 if not cql:
                     return self._reply(400, {"error": "missing cql"})
                 err = service._check(cql)
                 if err:
                     return self._reply(400, {"error": err})
+                summary, reject = service._admit(
+                    cql, tail[0], tenant=body.get("tenant")
+                )
+                if reject is not None:
+                    return self._reply(422, reject)
                 b = MetadataControlEvent.builder()
                 b.update_execution_plan(tail[0], cql)
-                service.control.push(b.build())
-                self._reply(200, {"id": tail[0]})
+                if summary is not None:
+                    b.with_admission(tail[0], summary)
+                ev = b.build()
+                ev.tenant = body.get("tenant")
+                service.control.push(ev)
+                self._reply(200, {"id": tail[0], "admission": summary})
 
             def do_DELETE(self):
                 tail = self._route()
@@ -261,6 +317,70 @@ class QueryControlService:
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+
+    def _admit(self, cql: str, plan_id: str, tenant=None):
+        """Run the admission gate at the REST boundary. Returns
+        ``(summary, None)`` on pass (summary None when no gate is
+        configured) or ``(None, reject_payload)`` carrying the exact
+        PLC/ADM rule ids — the 422 body. A refusal is also recorded in
+        the attached job's rejection ring (source ``"service"``), so a
+        tenant add turned away at the boundary shows up in
+        ``GET /health`` and ``GET /queries/<id>`` like an apply-time
+        one — not only in the 422 response the caller may have
+        dropped."""
+        if self.admission is None:
+            return None, None
+        from ..control.plane import ControlRejected
+
+        try:
+            return self.admission(cql, plan_id), None
+        except ControlRejected as e:
+            rules, findings = e.rules, e.findings
+        except Exception as e:  # noqa: BLE001 — unparsable CQL etc.
+            rules, findings = ["CQL000"], [f"{type(e).__name__}: {e}"]
+        if self.job is not None:
+            self.job._record_rejection(
+                plan_id, rules, findings, tenant, source="service"
+            )
+        return None, {
+            "error": "admission rejected",
+            "id": plan_id,
+            "rules": rules,
+            "findings": findings,
+        }
+
+    def _query_status(self, plan_id: str):
+        """(code, payload) for GET /api/v1/queries/<id>."""
+        job = self.job
+        if job is None:
+            return 404, {"error": "no job attached"}
+        folded = job._folded.get(plan_id)
+        if folded is not None:
+            host, slot = folded
+            return 200, {
+                "id": plan_id,
+                "state": "live",
+                "enabled": bool(
+                    job._folded_enabled.get(plan_id, True)
+                ),
+                "folded": {"host": host, "slot": int(slot)},
+            }
+        rt = job._plans.get(plan_id)
+        if rt is not None:
+            return 200, {
+                "id": plan_id,
+                "state": "live",
+                "enabled": bool(rt.enabled),
+                "folded": None,
+            }
+        rej = job.control_rejections.get(plan_id)
+        if rej is not None:
+            return 200, {
+                "id": plan_id,
+                "state": "rejected",
+                **_json_safe(rej),
+            }
+        return 404, {"error": f"unknown query {plan_id!r}"}
 
     def _check(self, cql: str) -> Optional[str]:
         """Fail-fast validation at the REST boundary (parity with the

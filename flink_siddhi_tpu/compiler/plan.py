@@ -77,6 +77,23 @@ class CompiledPlan:
     # batch size is unchanged; only the compiled window shrinks).
     tape_capacity_limit: Optional[int] = None
 
+    def signature(self, capacity: int = 128) -> str:
+        """The shape-bucket class key (``analysis/admit.plan_signature``)
+        memoized per capacity — the control plane's AOT-cache key and
+        the admission summary's ``signature`` field hash the same plan
+        more than once per admit, and the eval_shape walk behind it is
+        the expensive half."""
+        memo = self.__dict__.setdefault("_signature_memo", {})
+        from ..runtime.tape import bucket_size
+
+        cap = bucket_size(int(capacity))
+        sig = memo.get(cap)
+        if sig is None:
+            from ..analysis.admit import plan_signature
+
+            sig = memo[cap] = plan_signature(self, capacity=cap)
+        return sig
+
     def recompiled(self, **config_overrides) -> "CompiledPlan":
         """Recompile this plan from its original CQL with EngineConfig
         overrides (state shapes may change; use before a runtime is

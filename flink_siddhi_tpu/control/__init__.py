@@ -1,3 +1,4 @@
+from .aotcache import AOTExecutableCache, CachedExecutables, cache_key
 from .events import (
     CONTROL_STREAM,
     ControlEvent,
@@ -6,12 +7,19 @@ from .events import (
     control_event_from_json,
     control_event_to_json,
 )
+from .plane import AdmissionGate, ControlPlane, ControlRejected
 
 __all__ = [
+    "AOTExecutableCache",
+    "AdmissionGate",
     "CONTROL_STREAM",
+    "CachedExecutables",
     "ControlEvent",
+    "ControlPlane",
+    "ControlRejected",
     "MetadataControlEvent",
     "OperationControlEvent",
+    "cache_key",
     "control_event_from_json",
     "control_event_to_json",
 ]

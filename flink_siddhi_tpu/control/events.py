@@ -32,6 +32,11 @@ class ControlEvent:
         default_factory=lambda: int(time.time() * 1000)
     )
     expired_ms: Optional[int] = None
+    # multi-tenant attribution: which tenant issued this mutation. Pure
+    # metadata — admission budgets are enforced per event via the
+    # carried verdicts, but rejections/status report by tenant so one
+    # tenant's refused add is attributable without log-diving
+    tenant: Optional[str] = None
 
 
 @dataclass
@@ -65,9 +70,15 @@ class MetadataControlEvent(ControlEvent):
             self._admission: Dict[str, dict] = {}
 
         def add_execution_plan(
-            self, cql: str, admission: Optional[dict] = None
+            self,
+            cql: str,
+            admission: Optional[dict] = None,
+            plan_id: Optional[str] = None,
         ) -> str:
-            plan_id = MetadataControlEvent.new_plan_id()
+            """``plan_id=None`` mints a fresh uuid (the reference's
+            Builder behavior); the control plane passes an explicit id
+            so REST callers learn it before the event applies."""
+            plan_id = plan_id or MetadataControlEvent.new_plan_id()
             self._added[plan_id] = cql
             if admission is not None:
                 self._admission[plan_id] = dict(admission)
@@ -144,6 +155,8 @@ def control_event_to_json(ev: ControlEvent) -> str:
     payload["created_ms"] = ev.created_ms
     if ev.expired_ms is not None:
         payload["expired_ms"] = ev.expired_ms
+    if ev.tenant is not None:
+        payload["tenant"] = ev.tenant
     return json.dumps(payload)
 
 
@@ -166,4 +179,5 @@ def control_event_from_json(text: str) -> ControlEvent:
     if "created_ms" in obj:
         ev.created_ms = obj["created_ms"]
     ev.expired_ms = obj.get("expired_ms")
+    ev.tenant = obj.get("tenant")
     return ev

@@ -528,6 +528,25 @@ class Job:
         self._folded: Dict[str, Tuple[str, int]] = {}
         self._folded_enabled: Dict[str, bool] = {}  # host-side mirror
         self._dynamic_cql: Dict[str, str] = {}  # for checkpoint replay
+        # shape-keyed AOT executable cache (control/aotcache.py): a
+        # dynamic add whose shape class was compiled before reuses the
+        # whole jit wrapper set — the ~3.4s first-compile cost is paid
+        # once per SHAPE, not once per query. Telemetry binds below
+        # (the registry does not exist yet at this point in __init__).
+        from ..control.aotcache import AOTExecutableCache
+
+        self.aot_cache = AOTExecutableCache()
+        # admission at APPLY time (docs/control_plane.md): the tenant
+        # resource envelope every control-path add/update is judged
+        # against (analysis/admit.AdmissionBudgets). None = structural
+        # (PLC) + cost-hook (ADM001/002) tiers only, no budget verdicts.
+        self.admission_budgets = None
+        # recent control-path refusals, keyed by plan id: rule ids +
+        # rendered findings + tenant — what GET /api/v1/health and
+        # metrics() surface so a refused add is observable without
+        # log-diving. Bounded ring (oldest evicted past the cap).
+        self.control_rejections: Dict[str, dict] = {}
+        self.MAX_REJECTIONS_KEPT = 64
         # output rate limiting: stream_id -> limiter (from plan
         # ``output ... every ...`` clauses, applied at emission)
         self._rate_limiters: Dict[str, _OutputRateLimiter] = {}
@@ -595,6 +614,7 @@ class Job:
         # the jitted device path. Set .enabled = False to reduce every
         # span/record to a no-op (the bench overhead A/B switch).
         self.telemetry = MetricsRegistry()
+        self.aot_cache.bind_telemetry(self.telemetry)
         # per-event trace sampling: a deterministic 1-in-N sample of
         # events (abs_ts % sample_every == 0) is stamped at source pull
         # and completed when a row carrying that timestamp surfaces to
@@ -716,53 +736,99 @@ class Job:
             if cql is not None:
                 self._dynamic_cql[plan.plan_id] = cql
             if self._try_fold(plan):
-                return  # data update into an existing group slot
+                # data update into an existing group slot — the cheapest
+                # admit: no runtime, no compile, no cache traffic
+                self._inc_control("control.admitted")
+                self._inc_control("control.stack_join")
+                return
             plan, admit0 = self._wrap_dynamic(plan)
-        self._create_runtime(plan, admit0)
+            self._inc_control("control.admitted")
+        self._create_runtime(plan, admit0, cacheable=dynamic)
 
-    def _create_runtime(self, plan: CompiledPlan, admit0=None) -> None:
+    def _inc_control(self, name: str, n: int = 1) -> None:
+        """Control-plane counters, safe during __init__ (the registry
+        is created after the static add_plan loop)."""
+        tel = getattr(self, "telemetry", None)
+        if tel is not None:
+            tel.inc(name, n)
+
+    def _create_runtime(
+        self, plan: CompiledPlan, admit0=None, cacheable: bool = False
+    ) -> None:
         from ..compiler import pallas_ops
+        from ..control.aotcache import CachedExecutables, cache_key
 
         pallas_ops.warmup()  # probe TPU kernels outside any trace
-        init_acc = jax.jit(plan.init_acc)
-        traces = {"n": 0}
+        # the AOT executable cache (dynamic adds only — a static plan
+        # is constructed once per job and pays signature hashing for
+        # nothing): a hit reuses the whole jit wrapper set, so every
+        # XLA executable already compiled for this shape class serves
+        # the new plan with zero lowering (control/aotcache.py has the
+        # soundness contract — dynamic-group hosts share by signature,
+        # everything else only on exact source text)
+        key = cache_key(plan, capacity=self.batch_size) if cacheable \
+            else None
+        entry = self.aot_cache.lookup(key) if cacheable else None
+        if entry is None:
+            init_acc = jax.jit(plan.init_acc)
+            traces = {"n": 0}
 
-        # fst:hotpath
-        def step_wire(states, acc, wire):
-            traces["n"] += 1  # python body runs only while TRACING
-            return plan.step_acc(states, acc, wire.expand())
+            # fst:hotpath
+            def step_wire(states, acc, wire):
+                traces["n"] += 1  # python body runs only while TRACING
+                return plan.step_acc(states, acc, wire.expand())
 
-        # fst:hotpath
-        def seg_scan(states, acc, seg):
-            # the fused streaming dispatch: ONE device call advances K
-            # stacked micro-batches — the exact scan body the bounded
-            # replay proves row-identical (runtime/replay.py), fed from
-            # live tapes instead of a pre-staged stream
-            def body(carry, wire):
-                s, a = plan.step_acc(carry[0], carry[1], wire.expand())
-                return (s, a), None
+            # fst:hotpath
+            def seg_scan(states, acc, seg):
+                # the fused streaming dispatch: ONE device call advances
+                # K stacked micro-batches — the exact scan body the
+                # bounded replay proves row-identical
+                # (runtime/replay.py), fed from live tapes instead of a
+                # pre-staged stream
+                def body(carry, wire):
+                    s, a = plan.step_acc(
+                        carry[0], carry[1], wire.expand()
+                    )
+                    return (s, a), None
 
-            (states, acc), _ = jax.lax.scan(body, (states, acc), seg)
-            return states, acc
+                (states, acc), _ = jax.lax.scan(
+                    body, (states, acc), seg
+                )
+                return states, acc
 
+            entry = CachedExecutables(
+                jitted=jax.jit(plan.step),
+                # donate states + accumulator: XLA updates the
+                # (potentially 100s-of-MB) output buffer in place
+                # instead of copying it every micro-batch
+                jitted_acc=jax.jit(step_wire, donate_argnums=(0, 1)),
+                # donation survives the scan carry: states + acc thread
+                # through as the carry and come back as the only
+                # outputs, so XLA updates both in place across the
+                # whole segment
+                jitted_seg=jax.jit(seg_scan, donate_argnums=(0, 1)),
+                jitted_init_acc=init_acc,
+                jitted_flush=jax.jit(plan.flush),
+                traces=traces,
+                first_plan_id=plan.plan_id,
+            )
+            if cacheable:
+                self.aot_cache.insert(key, entry)
         rt = _PlanRuntime(
             plan=plan,
             states=plan.init_state(),
-            jitted=jax.jit(plan.step),
-            # donate states + accumulator: XLA updates the (potentially
-            # 100s-of-MB) output buffer in place instead of copying it
-            # every micro-batch
-            jitted_acc=jax.jit(step_wire, donate_argnums=(0, 1)),
-            # donation survives the scan carry: states + acc thread
-            # through as the carry and come back as the only outputs,
-            # so XLA updates both in place across the whole segment
-            jitted_seg=jax.jit(seg_scan, donate_argnums=(0, 1)),
-            jitted_init_acc=init_acc,
-            jitted_flush=jax.jit(plan.flush),
-            acc=init_acc(),
+            jitted=entry.jitted,
+            jitted_acc=entry.jitted_acc,
+            jitted_seg=entry.jitted_seg,
+            jitted_init_acc=entry.jitted_init_acc,
+            jitted_flush=entry.jitted_flush,
+            acc=entry.jitted_init_acc(),
             wire_kinds={},
         )
-        rt.traces = traces
+        rt.traces = entry.traces
+        # drain pack programs ride the cache entry too: a cache-hit
+        # admit's first drain must not pay a pack recompile
+        rt.pack_jits = entry.pack_jits
         if admit0 is not None:
             rt.states = admit0(rt.states)
         lazy_keys = {
@@ -926,7 +992,10 @@ class Job:
                     wrapped, admit0 = self._wrap_dynamic(
                         plan, host_id=host_id, slot=slot
                     )
-                    self._create_runtime(wrapped, admit0)
+                    self._create_runtime(
+                        wrapped, admit0,
+                        cacheable=wrapped.plan_id == host_id,
+                    )
                     if wrapped.plan_id != host_id:
                         # wrap fell through (template underivable / id
                         # collision): the host runtime does not exist, so
@@ -978,21 +1047,31 @@ class Job:
         self._dynamic_cql.pop(plan_id, None)
         if folded is not None:
             host_id, slot = folded
+            self._inc_control("control.retired")
             rt = self._plans.get(host_id)
             if rt is None:
                 return
             self._drain_plan(rt)  # don't lose already-produced matches
+            # retire leaves the slot as a ROW-INERT padded member
+            # (enabled=False, active cleared — plancheck's padded-row
+            # inertness class): a later admit reclaims it via
+            # free_slot, so retire/admit churn never grows the group
             group = rt.plan.artifacts[0]
             states = dict(rt.states)
             states[group.name] = group.evict(states[group.name], slot)
             rt.states = states
             if all(m is None for m in group.members):
+                # last member gone: the host runtime is dropped too —
+                # its executables stay warm in the AOT cache, so a
+                # later admit of this shape class re-forms the host
+                # without recompiling
                 self._plans.pop(host_id, None)
                 self._drain_hints.pop(host_id, None)
             return
         rt = self._plans.get(plan_id)
         if rt is not None:
             self._drain_plan(rt)
+            self._inc_control("control.retired")
         self._plans.pop(plan_id, None)
         self._drain_hints.pop(plan_id, None)
 
@@ -1050,12 +1129,19 @@ class Job:
             # reach the compiler/runtime — counted + logged, the rest
             # of the event still applies
             verdicts = getattr(ev, "admission", None) or {}
+            tenant = getattr(ev, "tenant", None)
 
             def _rejected(plan_id: str) -> bool:
                 v = verdicts.get(plan_id)
                 if v is None or v.get("admitted", True):
                     return False
-                self.telemetry.inc("control.admission_rejected")
+                self._record_rejection(
+                    plan_id,
+                    [f.get("rule") for f in v.get("findings", ())],
+                    [f.get("message", "") for f in v.get("findings", ())],
+                    tenant,
+                    source="carried-verdict",
+                )
                 _LOG.warning(
                     "control event %s plan %s refused: admission "
                     "verdict rejected it (%s)",
@@ -1068,17 +1154,19 @@ class Job:
             for plan_id, cql in ev.added_plans.items():
                 if _rejected(plan_id):
                     continue
-                self.add_plan(
-                    self._plan_compiler(cql, plan_id), dynamic=True
-                )
+                plan = self._compile_admitted(plan_id, cql, tenant)
+                if plan is None:
+                    continue
+                self.add_plan(plan, dynamic=True)
                 self._dynamic_cql[plan_id] = cql
             for plan_id, cql in ev.updated_plans.items():
                 if _rejected(plan_id):
                     continue  # the running plan stays as-is
+                plan = self._compile_admitted(plan_id, cql, tenant)
+                if plan is None:
+                    continue  # refused update: the running plan stays
                 self.remove_plan(plan_id)
-                self.add_plan(
-                    self._plan_compiler(cql, plan_id), dynamic=True
-                )
+                self.add_plan(plan, dynamic=True)
                 self._dynamic_cql[plan_id] = cql
             for plan_id in ev.deleted_plan_ids:
                 self.remove_plan(plan_id)
@@ -1086,6 +1174,86 @@ class Job:
             self.set_plan_enabled(ev.plan_id, ev.action == "enable")
         else:
             raise TypeError(f"unknown control event {type(ev)!r}")
+
+    def _compile_admitted(
+        self, plan_id: str, cql: str, tenant: Optional[str] = None
+    ):
+        """APPLY-time admission (docs/control_plane.md): compile the
+        candidate, run plancheck's static tier and the admission
+        analyzer against ``self.admission_budgets``, and return the
+        plan — or None after counting + recording the refusal. Defense
+        in depth behind the service-boundary gate: an event injected
+        past the REST layer (a raw control topic, a checkpointed
+        pre-gate event) is still judged before it touches the stack."""
+        from ..analysis.admit import AdmissionError, analyze_plan
+        from ..analysis.plancheck import PlanCheckError, verify_plan
+
+        rules: List[str] = []
+        rendered: List[str] = []
+        try:
+            plan = self._plan_compiler(cql, plan_id)
+            issues = verify_plan(
+                plan, trace=False, raise_on_error=False
+            )
+            rules += [i.rule for i in issues]
+            rendered += [i.render() for i in issues]
+            if not issues:
+                # deep tier (eval_shape footprint + signature) only
+                # under a configured budget — the static cost-hook
+                # tier is microseconds and always runs
+                report = analyze_plan(
+                    plan,
+                    budgets=self.admission_budgets,
+                    deep=self.admission_budgets is not None,
+                )
+                rules += [i.rule for i in report.findings]
+                rendered += [i.render() for i in report.findings]
+        except (PlanCheckError, AdmissionError) as e:
+            # compile_plan itself verifies under FST_VERIFY_PLANS /
+            # config budgets and raises — same refusal, same record
+            rules += [i.rule for i in e.issues]
+            rendered += [i.render() for i in e.issues]
+        except Exception as e:  # noqa: BLE001 — unparsable/uncompilable
+            # CQL pushed through a control channel must refuse THIS
+            # add, not take down the running queries (the historical
+            # catch in _apply_ready_control kept the loop alive but
+            # left the refusal unobservable)
+            rules += ["CQL000"]
+            rendered += [f"{type(e).__name__}: {e}"]
+        if rules:
+            self._record_rejection(
+                plan_id, rules, rendered, tenant, source="apply-time"
+            )
+            _LOG.warning(
+                "control-path plan %s refused at apply time: %s",
+                plan_id, rules,
+            )
+            return None
+        return plan
+
+    def _record_rejection(
+        self,
+        plan_id: str,
+        rules,
+        findings,
+        tenant: Optional[str] = None,
+        source: str = "apply-time",
+    ) -> None:
+        self._inc_control("control.admission_rejected")
+        # re-insert at the ring's tail: a repeated refusal of the same
+        # plan id must refresh its eviction position, or the freshest
+        # rejection could be the first one evicted
+        self.control_rejections.pop(plan_id, None)
+        self.control_rejections[plan_id] = {
+            "rules": [r for r in rules if r],
+            "findings": list(findings),
+            "tenant": tenant,
+            "source": source,
+        }
+        while len(self.control_rejections) > self.MAX_REJECTIONS_KEPT:
+            self.control_rejections.pop(
+                next(iter(self.control_rejections))
+            )
 
     def add_sink(self, output_stream: str, fn: Callable) -> None:
         """Attach a sink. Drains already in flight are completed first:
@@ -1959,32 +2127,41 @@ class Job:
                 self._control_done[i] = True
                 self._control_wm[i] = MAX_WM
 
-    def _apply_ready_control(self) -> None:
-        if not self._control_pending:
-            return
-        wm = self._watermark()
+    def _pop_ready_control(self) -> List:
+        """Ready control events — ts at or below the current watermark
+        (processing mode: all of them) — removed from the pending list
+        in timestamp order. ONE definition of the epoch-boundary
+        selection: the streaming loop applies what this returns, and
+        control-in-replay (runtime/replay.py) partitions the bounded
+        stream at the same boundaries, so the two modes cannot
+        diverge."""
         pending = self._control_pending
+        if not pending:
+            return []
         pending.sort(key=lambda p: p[0])
         # index walk + one tail-del, not pop(0) per event: a control
         # backlog held behind the watermark gate can grow long, and the
         # O(n^2) front-pop drain was quadratic in it
         n_apply = len(pending)
         if self.time_mode != "processing":
+            wm = self._watermark()
             n_apply = 0
             while n_apply < len(pending) and pending[n_apply][0] <= wm:
                 n_apply += 1
-        for i in range(n_apply):
+        out = [ev for _ts, ev in pending[:n_apply]]
+        if n_apply:
+            del pending[:n_apply]
+        return out
+
+    def _apply_ready_control(self) -> None:
+        for ev in self._pop_ready_control():
             try:
-                self._apply_control(pending[i][1])
+                self._apply_control(ev)
             except Exception:
                 # a bad dynamic query (e.g. unparsable CQL pushed through
                 # a control channel with no up-front validation) must not
                 # take down the running queries
-                _LOG.exception(
-                    "control event rejected: %r", pending[i][1]
-                )
-        if n_apply:
-            del pending[:n_apply]
+                _LOG.exception("control event rejected: %r", ev)
 
     def _watermark(self) -> int:
         """min watermark across non-idle sources + control streams.
@@ -2845,10 +3022,39 @@ class Job:
             "late_events": self.late_events,
             "late_dropped": self.late_dropped,
             "late_policy": self.late_policy,
+            # control-plane view (docs/control_plane.md): the control.*
+            # counters also land in telemetry["counters"]; this block
+            # adds the AOT cache stats and the recent-refusal ring so a
+            # refused tenant add is diagnosable from one snapshot
+            "control": self.control_status(
+                counters=telemetry.get("counters", {})
+            ),
             # stage-attributed wall clock, latency histograms (drain.*
             # legs at least; jobs under bench add more), counters —
             # an atomic registry snapshot, safe off-thread
             "telemetry": telemetry,
+        }
+
+    def control_status(self, counters=None) -> Dict[str, object]:
+        """Host-side control-plane snapshot (safe off-thread): the
+        control.* counters, AOT cache stats, and recent refusals.
+        ``counters`` lets a caller that already holds a telemetry
+        snapshot (``metrics()``) avoid taking a second one."""
+        if counters is None:
+            tel = getattr(self, "telemetry", None)
+            counters = (
+                tel.snapshot().get("counters", {})
+                if tel is not None
+                else {}
+            )
+        return {
+            "counters": {
+                k.split("control.", 1)[1]: v
+                for k, v in counters.items()
+                if k.startswith("control.")
+            },
+            "aot_cache": self.aot_cache.stats(),
+            "rejections": dict(self.control_rejections),
         }
 
     # -- results -------------------------------------------------------------

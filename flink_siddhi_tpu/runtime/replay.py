@@ -24,6 +24,16 @@ calls the same ``plan.step_acc``); only the number of host->device
 dispatches changes. ``tests/test_replay.py`` asserts streaming/resident
 agreement on rows + timestamps across plan shapes.
 
+Control-in-replay (docs/control_plane.md): a job constructed with
+control sources replays in EPOCHS. The control timeline partitions the
+bounded stream at exactly the micro-batch boundaries the streaming loop
+would apply each event at (the same watermark gate decides both), and
+each epoch applies its control events (query add / update / retire /
+enable / disable, admission-gated as in streaming) before staging and
+scanning that epoch's tapes under the resulting plan set —
+``tests/test_control_plane.py`` pins streaming/resident row parity
+under a mid-stream control timeline.
+
 Lazy projection note: resident mode stages the WHOLE stream before the
 first drain, so plans compiled with ``lazy_projection=True`` retain all
 projection-only columns in the host ring for the duration — size
@@ -72,22 +82,22 @@ class ResidentReplay:
     def __init__(
         self, job: Job, segment_cycles: Optional[int] = None
     ) -> None:
-        if job._control or job._control_pending:
-            raise ValueError(
-                "bounded replay does not support control streams: "
-                "control events are applied at micro-batch boundaries "
-                "the resident scan no longer observes. Use streaming "
-                "mode instead — construct the Job with control_sources "
-                "and drive it with Job.run() / Job.run_cycle(), which "
-                "applies control events at every micro-batch boundary "
-                "(see ROADMAP.md open items for control-in-replay)"
-            )
         self.job = job
         self.segment_cycles = segment_cycles
         self.total_events = 0
         # plan_id -> dict(scan=jitted fn, segments=[device pytrees])
         self._staged: Dict[str, Dict] = {}
         self.stage_seconds = 0.0
+        # CONTROL-IN-REPLAY (docs/control_plane.md): a job with control
+        # sources replays in EPOCHS — the control timeline partitions
+        # the bounded stream at exactly the micro-batch boundaries the
+        # streaming loop would apply each event at (same watermark
+        # gate), and each epoch stages + scans under that epoch's plan
+        # set. None = no control sources, the classic single-pass path.
+        self._epochs: Optional[List[Dict]] = None
+        # (plan_id, k, wire sig, state sig) -> AOT-compiled scan: a
+        # plan spanning many epochs compiles its segment scan once
+        self._scan_cache: Dict = {}
 
     # -- staging ----------------------------------------------------------
     def stage(self) -> None:
@@ -99,6 +109,13 @@ class ResidentReplay:
         off-clock number (round-5 verdict, weak #2)."""
         t0 = time.perf_counter()
         job = self.job
+        if job._control or job._control_pending:
+            # control-in-replay: pull + epoch-partition now; staging
+            # happens per epoch in run() (a retire at epoch k must not
+            # drain segments epoch k-1 has not scanned yet)
+            self._pull_epochs()
+            self.stage_seconds = time.perf_counter() - t0
+            return
         tel = job.telemetry
         ready_sets: List[List[EventBatch]] = []
         with tel.span("stage.source_pull"):
@@ -167,6 +184,29 @@ class ResidentReplay:
                 for i in range(0, len(wires), k)
             ]
         plan = rt.plan
+        # epoch replays re-stage the same plan once per epoch: the
+        # compiled scan is cached by (step wrapper, k, wire structure,
+        # state shapes), so only the FIRST epoch pays compile + warm.
+        # The key holds the jit wrapper ITSELF (identity hash), not the
+        # plan id: an update event re-minting plan_id with a new traced
+        # step (constants baked in) must not reuse the old executable,
+        # while an AOT-cache-hit runtime sharing the same wrapper still
+        # hits here
+        scan_key = (
+            rt.jitted_seg, k, _wire_sig(wires[0]),
+            Job._state_sig(rt.states),
+        )
+        # flush warming is per-RUNTIME, not per-executable: a cache-hit
+        # runtime (AOT-shared wrapper, or re-staged after a state-sig
+        # change) still needs its flush warmed off the replay clock
+        if plan.has_flush and (
+            rt.flush_warm is None
+            or rt.flush_warm[0] != job._state_sig(rt.states)
+        ):
+            job._warm_flush(rt)
+        cached = self._scan_cache.get(scan_key)
+        if cached is not None:
+            return {"scan": cached, "segments": segments}
         # the scan body IS the fused streaming dispatch's (ONE
         # definition: _PlanRuntime.jitted_seg, built in
         # Job._create_runtime) — AOT-compiled off the replay clock,
@@ -192,17 +232,152 @@ class ResidentReplay:
             )
             jax.block_until_ready(warm)
             del warm
-        if plan.has_flush and (
-            rt.flush_warm is None
-            or rt.flush_warm[0] != job._state_sig(rt.states)
-        ):
-            job._warm_flush(rt)
+        self._scan_cache[scan_key] = scan
         return {"scan": scan, "segments": segments}
+
+    # -- control-in-replay (epoch partitioning) ---------------------------
+    def _pop_ready_control(self) -> List:
+        """Control events the streaming loop would apply NOW —
+        ``Job._pop_ready_control`` is the ONE definition of the
+        epoch-boundary selection (application is deferred to the
+        epoch's run turn)."""
+        return self.job._pop_ready_control()
+
+    def _pull_epochs(self) -> None:
+        """Pull every source AND control stream dry, partitioned into
+        epochs at the exact boundaries streaming mode would apply each
+        control event (the same watermark gate decides both). Bounded
+        replay requires bounded control: a live ControlQueueSource must
+        be ``close()``d first or the pull cannot terminate — detected
+        and refused loudly instead of spinning."""
+        job = self.job
+        epochs: List[Dict] = []
+        current: Dict = {"control": [], "ready": []}
+        stalled = 0
+        with job.telemetry.span("stage.source_pull"):
+            while not (
+                all(job._source_done)
+                and not any(job._pending.values())
+            ):
+                before = (
+                    self.total_events,
+                    job._pending_total(),
+                    len(job._control_pending),
+                    sum(job._control_done),
+                    sum(job._source_done),
+                )
+                job._pull_sources()
+                job._pull_control()
+                ready_ctrl = self._pop_ready_control()
+                if ready_ctrl:
+                    # boundary: events released from here on step under
+                    # the post-control plan set
+                    if current["ready"] or current["control"]:
+                        epochs.append(current)
+                        current = {"control": [], "ready": []}
+                    current["control"].extend(ready_ctrl)
+                ready = job._release_ready()
+                if ready:
+                    if job._epoch_ms is None:
+                        job._epoch_ms = min(
+                            int(b.timestamps.min()) for b in ready
+                        )
+                    current["ready"].append(ready)
+                    self.total_events += sum(len(b) for b in ready)
+                # pulled-but-gated batches count as progress: an
+                # event-time stream can legitimately buffer thousands
+                # of micro-batches behind the watermark before the
+                # first release, and that must not trip the guard
+                after = (
+                    self.total_events,
+                    job._pending_total(),
+                    len(job._control_pending),
+                    sum(job._control_done),
+                    sum(job._source_done),
+                )
+                stalled = stalled + 1 if after == before else 0
+                if stalled > 10_000:
+                    raise RuntimeError(
+                        "bounded replay cannot drain its inputs: a "
+                        "control source that never finishes (e.g. an "
+                        "un-closed ControlQueueSource) is holding the "
+                        "watermark; close() it before stage(), or run "
+                        "streaming mode (docs/control_plane.md)"
+                    )
+            # trailing control (ts past the last data row): streaming
+            # would still apply it before finishing — e.g. a final
+            # retire whose drain semantics the flush must observe
+            job._pull_control()
+            tail = self._pop_ready_control()
+            if tail:
+                if current["ready"] or current["control"]:
+                    epochs.append(current)
+                    current = {"control": [], "ready": []}
+                current["control"].extend(tail)
+        if current["ready"] or current["control"]:
+            epochs.append(current)
+        job.processed_events += self.total_events
+        self._epochs = epochs
+
+    def _run_epochs(self) -> None:
+        """Epoch-sequential replay: apply the epoch's control events
+        (add/update/retire/enable/disable — the executor's own
+        epoch-boundary paths, so a mutation can never tear a compiled
+        segment), stage the epoch's tapes for every live plan (compiled
+        scans cached across epochs), scan, drain."""
+        job = self.job
+        tel = job.telemetry
+        for ep in self._epochs or []:
+            for ev in ep["control"]:
+                try:
+                    job._apply_control(ev)
+                except Exception:
+                    # same contract as the streaming loop: one bad
+                    # control event must not take down the replay
+                    _LOG.exception("control event rejected: %r", ev)
+            ready_sets = ep["ready"]
+            if not ready_sets:
+                continue
+            staged: Dict[str, Dict] = {}
+            for pid, rt in list(job._plans.items()):
+                if not rt.enabled:
+                    continue
+                wires = self._plan_wires(rt, ready_sets)
+                if wires is None:
+                    continue
+                staged[pid] = self._stage_plan(rt, wires)
+            if staged:
+                with tel.span("stage.prewarm"):
+                    job.prewarm_drains()
+            for ready in ready_sets:
+                for b in ready:
+                    job.tracer.mark(b.timestamps, "staged")
+            for pid, st in staged.items():
+                rt = job._plans.get(pid)
+                if rt is None:
+                    continue  # retired by a later... defensive only
+                for seg in st["segments"]:
+                    with tel.span("replay.dispatch"):
+                        rt.states, rt.acc = st["scan"](
+                            rt.states, rt.acc, seg
+                        )
+                        rt.acc_dirty = True
+                        if rt.dirty_since is None:
+                            rt.dirty_since = time.monotonic()
+                    with tel.span("replay.drain"):
+                        job._drain_request(rt)
+                        job._drain_poll(rt)
+                with tel.span("replay.drain"):
+                    job._drain_poll(rt, block=True)
 
     # -- execution --------------------------------------------------------
     def run(self) -> None:
         """The replay itself: one dispatch per segment; the accumulator
-        drain (swap + async fetch) overlaps the next segment's compute."""
+        drain (swap + async fetch) overlaps the next segment's compute.
+        With control sources, runs the epoch-sequential form instead
+        (stage() deferred per-epoch staging to here)."""
+        if self._epochs is not None:
+            return self._run_epochs()
         job = self.job
         tel = job.telemetry
         for pid, st in self._staged.items():
@@ -259,6 +434,12 @@ class ResidentReplay:
 
         Counts-only jobs only: collectors or sinks would observe every
         row once per run."""
+        if self._epochs is not None:
+            raise ValueError(
+                "rerun() does not support control-in-replay jobs: "
+                "epochs mutate the plan set mid-run, so a reset replay "
+                "would not traverse the same control timeline"
+            )
         job = self.job
         for pid in self._staged:
             if job._has_consumers(job._plans[pid]):
@@ -285,6 +466,21 @@ class ShardedResidentReplay(ResidentReplay):
     shard_map'd step — the mesh analog of Flink's bounded execution of
     an N-subtask pipeline. Drains stay synchronous (the ShardedJob
     contract)."""
+
+    def __init__(
+        self, job, segment_cycles: Optional[int] = None
+    ) -> None:
+        if job._control or job._control_pending:
+            raise ValueError(
+                "sharded bounded replay does not support control "
+                "streams yet: single-mesh ResidentReplay applies "
+                "control at replay-epoch boundaries (the control/ "
+                "plane's epoch contract, docs/control_plane.md), but "
+                "the sharded stager has no per-epoch routing — use "
+                "ResidentReplay on one device, or drive the sharded "
+                "job in streaming mode (Job.run / run_cycle)"
+            )
+        super().__init__(job, segment_cycles)
 
     def _plan_wires(self, rt, ready_sets):
         import jax.numpy as jnp

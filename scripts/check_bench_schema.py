@@ -74,6 +74,26 @@ Schema v6 (event-time robustness round, bench.py ``schema_version:
   must be true. Pre-v6 files are exempt; a ``disorder`` block present
   in any version is validated.
 
+Schema v7 (dynamic-control-plane round, bench.py ``schema_version:
+7``) adds the control contract:
+
+* the line carries a ``control`` block: a sustained-load run against
+  the live control plane (docs/control_plane.md) with
+  ``admit_rate_qps`` finite positive (queries/s actually admitted at
+  epoch boundaries), ``steady_state_events_per_sec`` finite positive
+  at ``concurrent_queries`` >= 1 live queries,
+  ``added_latency_p99_ms`` and ``baseline_p99_ms`` finite;
+* ``dropped_events`` must be 0 — an admit/retire/pause applied at a
+  micro-batch epoch boundary must never tear a segment or lose rows;
+* a hostile tenant query must have been refused:
+  ``admission_rejected`` >= 1 with ``hostile_refused_rule`` naming an
+  ADM/PLC rule id;
+* the ``cache`` block's hit/miss/eviction counters must be
+  non-negative ints (the shape-keyed AOT executable cache really
+  ran); ``stack_joins`` non-negative (admits folding into padded
+  multi-query stacks as data updates). Pre-v7 files are exempt; a
+  ``control`` block present in any version is validated.
+
 Optional ``recovery`` block (``bench.py --fault``, any version): when
 present it must carry a finite positive measured ``recovery_time_ms``,
 at least one injected crash, ``stale_tmp_swept: true``, and EXACT
@@ -559,6 +579,89 @@ def validate_v6(doc, errors: List[str], where: str) -> None:
         validate_disorder(dis, errors, where)
 
 
+def validate_control(ctrl, errors: List[str], where: str) -> None:
+    """The schema-v7 ``control`` block: the dynamic query control
+    plane's sustained-load claims. A control line whose admit rate is
+    unmeasured, whose load dropped rows at a mutation boundary, or
+    whose hostile tenant slipped through is a failed claim, not a
+    benchmark."""
+    where = f"{where}:control"
+    if not isinstance(ctrl, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    rate = ctrl.get("admit_rate_qps")
+    if not _finite(rate) or rate <= 0:
+        errors.append(
+            f"{where}: admit_rate_qps missing/non-finite ({rate!r}) — "
+            "the admit rate must be a measured number"
+        )
+    ev_s = ctrl.get("steady_state_events_per_sec")
+    if not _finite(ev_s) or ev_s <= 0:
+        errors.append(
+            f"{where}: steady_state_events_per_sec missing/non-finite "
+            f"({ev_s!r})"
+        )
+    cq = ctrl.get("concurrent_queries")
+    if not isinstance(cq, int) or isinstance(cq, bool) or cq < 1:
+        errors.append(
+            f"{where}: concurrent_queries missing/non-int/zero ({cq!r})"
+        )
+    for key in ("added_latency_p99_ms", "baseline_p99_ms"):
+        if not _finite(ctrl.get(key)):
+            errors.append(
+                f"{where}: {key} missing/non-finite "
+                f"({ctrl.get(key)!r})"
+            )
+    if ctrl.get("dropped_events") != 0:
+        errors.append(
+            f"{where}: dropped_events={ctrl.get('dropped_events')!r} "
+            "— a control-plane mutation lost rows (epoch-boundary "
+            "apply must never tear a segment)"
+        )
+    rej = ctrl.get("admission_rejected")
+    if not isinstance(rej, int) or isinstance(rej, bool) or rej < 1:
+        errors.append(
+            f"{where}: admission_rejected={rej!r} — the hostile "
+            "tenant query was not refused"
+        )
+    rule = ctrl.get("hostile_refused_rule")
+    if not (
+        isinstance(rule, str)
+        and (rule.startswith("ADM") or rule.startswith("PLC"))
+    ):
+        errors.append(
+            f"{where}: hostile_refused_rule={rule!r} — the refusal "
+            "must name an exact ADM/PLC rule id"
+        )
+    sj = ctrl.get("stack_joins")
+    if not isinstance(sj, int) or isinstance(sj, bool) or sj < 0:
+        errors.append(
+            f"{where}: stack_joins missing/non-int ({sj!r})"
+        )
+    cache = ctrl.get("cache")
+    if not isinstance(cache, dict):
+        errors.append(f"{where}: cache block missing")
+    else:
+        for key in ("hits", "misses", "evictions"):
+            v = cache.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(
+                    f"{where}: cache.{key} missing/non-int ({v!r})"
+                )
+
+
+def validate_v7(doc, errors: List[str], where: str) -> None:
+    """The dynamic-control-plane contract (on top of v3..v6)."""
+    ctrl = doc.get("control")
+    if ctrl is None:
+        errors.append(
+            f"{where}: control block missing (schema v7 requires the "
+            "sustained-load control-plane run)"
+        )
+    else:
+        validate_control(ctrl, errors, where)
+
+
 def validate_recovery(rec, errors: List[str], where: str) -> None:
     """The ``--fault`` recovery block (optional in every version; when
     present it must carry real measurements and the exactly-once
@@ -658,6 +761,12 @@ def validate_doc(
         # pre-v6 lines are exempt from requiring the block, but one
         # that IS present must hold to its contract
         validate_disorder(doc["disorder"], errors, where)
+    if version >= 7:
+        validate_v7(doc, errors, where)
+    elif "control" in doc:
+        # same exemption shape as disorder: v6-era lines need not
+        # carry the block, but a present one is held to its contract
+        validate_control(doc["control"], errors, where)
     if "recovery" in doc:
         validate_recovery(doc["recovery"], errors, where)
 

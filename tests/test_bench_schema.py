@@ -582,6 +582,114 @@ def test_v5_era_docs_unaffected_by_v6_gate():
     assert any("late account drifted" in e for e in errors)
 
 
+# -- schema v7: the dynamic-control-plane contract --------------------------
+
+
+def _control_blk(**over):
+    blk = {
+        "concurrent_queries": 23,
+        "queries_admitted": 24,
+        "queries_retired": 1,
+        "admission_rejected": 1,
+        "hostile_refused_rule": "ADM110",
+        "stack_joins": 21,
+        "admit_wall_ms": 940.0,
+        "admit_rate_qps": 25.5,
+        "steady_state_events_per_sec": 120_000,
+        "events": 104_448,
+        "dropped_events": 0,
+        "baseline_p99_ms": 7.0,
+        "added_latency_p99_ms": 940.0,
+        "cache": {"entries": 1, "hits": 2, "misses": 1,
+                  "evictions": 0},
+        "dryrun": True,
+    }
+    blk.update(over)
+    return blk
+
+
+def _v7_doc(**over):
+    doc = _v6_doc()
+    doc["schema_version"] = 7
+    doc["control"] = _control_blk()
+    doc.update(over)
+    return doc
+
+
+def test_valid_v7_doc_passes():
+    errors = []
+    CHECK.validate_doc(_v7_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v7_requires_control_block():
+    doc = _v7_doc()
+    del doc["control"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("control block missing" in e for e in errors)
+
+
+def test_v7_admit_rate_must_be_measured():
+    for bad in (None, 0, -1.0, float("inf")):
+        errors = []
+        CHECK.validate_doc(
+            _v7_doc(control=_control_blk(admit_rate_qps=bad)),
+            errors, "doc",
+        )
+        assert any("admit_rate_qps" in e for e in errors), bad
+
+
+def test_v7_dropped_events_gated_zero():
+    errors = []
+    CHECK.validate_doc(
+        _v7_doc(control=_control_blk(dropped_events=3)), errors, "doc"
+    )
+    assert any("dropped_events" in e for e in errors)
+
+
+def test_v7_hostile_must_be_refused_by_rule_id():
+    errors = []
+    CHECK.validate_doc(
+        _v7_doc(control=_control_blk(admission_rejected=0)),
+        errors, "doc",
+    )
+    assert any("not refused" in e for e in errors)
+    errors = []
+    CHECK.validate_doc(
+        _v7_doc(control=_control_blk(hostile_refused_rule="nope")),
+        errors, "doc",
+    )
+    assert any("rule id" in e for e in errors)
+
+
+def test_v7_cache_counters_required():
+    errors = []
+    blk = _control_blk()
+    del blk["cache"]
+    CHECK.validate_doc(_v7_doc(control=blk), errors, "doc")
+    assert any("cache block missing" in e for e in errors)
+    errors = []
+    CHECK.validate_doc(
+        _v7_doc(control=_control_blk(cache={"hits": -1, "misses": 0})),
+        errors, "doc",
+    )
+    assert any("cache." in e for e in errors)
+
+
+def test_v6_era_docs_unaffected_by_v7_gate():
+    """Pre-v7 lines need no control block, but one present is held to
+    its contract (same exemption shape as the disorder block)."""
+    errors = []
+    CHECK.validate_doc(_v6_doc(), errors, "doc")
+    assert errors == []
+    doc = _v6_doc()
+    doc["control"] = _control_blk(dropped_events=7)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("dropped_events" in e for e in errors)
+
+
 # -- optional recovery block (bench.py --fault) ----------------------------
 
 
@@ -691,14 +799,14 @@ def test_fault_block_live_and_gate_accepts():
     assert errors == []
 
 
-def test_dryrun_emits_schema_complete_v6(tmp_path):
+def test_dryrun_emits_schema_complete_v7(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
     replay, short paced phase) exercises resident + streaming + sink,
-    the out-of-process prober, AND the small-skew disorder sweep, and
-    its JSON line passes the v6 schema gate — in the tier-1 lane,
-    under its timeout. (The --fault recovery block has its own
-    in-process live test below, so this subprocess stays at its
-    historical cost.)"""
+    the out-of-process prober, the small-skew disorder sweep, AND the
+    control-plane sustained-load run, and its JSON line passes the v7
+    schema gate — in the tier-1 lane, under its timeout. (The --fault
+    recovery block has its own in-process live test below, so this
+    subprocess stays at its historical cost.)"""
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
@@ -747,7 +855,7 @@ def test_dryrun_emits_schema_complete_v6(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 6
+    assert doc["schema_version"] == 7
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]
@@ -797,6 +905,18 @@ def test_dryrun_emits_schema_complete_v6(tmp_path):
         assert run["idle_marked"] == run["injected"]["idle_gaps"] > 0
         assert run["events_per_sec"] > 0
         assert math.isfinite(run["p99_ms"])
+    # the v7 additions: the control plane really admitted a stack of
+    # tenant queries at epoch boundaries under load, refused the
+    # hostile one by rule id, dropped nothing, and the AOT executable
+    # cache served hosts 2..N without recompiling
+    ctrl = doc["control"]
+    assert ctrl["dropped_events"] == 0
+    assert ctrl["concurrent_queries"] >= 8
+    assert ctrl["stack_joins"] > 0
+    assert ctrl["hostile_refused_rule"].startswith("ADM")
+    assert ctrl["cache"]["hits"] >= 1
+    assert math.isfinite(ctrl["admit_rate_qps"])
+    assert ctrl["admit_rate_qps"] > 0
 
 
 def test_repo_bench_files_validate():

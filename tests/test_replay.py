@@ -171,27 +171,36 @@ def test_resident_multi_stream_event_time():
     assert a and a == b
 
 
-def test_resident_rejects_control_streams():
-    from flink_siddhi_tpu.runtime.sources import CallbackSource
+def test_resident_control_streams_contract():
+    """ResidentReplay ACCEPTS control sources (epoch-boundary apply —
+    the control/ plane; behavior pinned in tests/test_control_plane.py)
+    while the sharded variant still refuses, naming the contract and
+    the working alternatives — no stale pointers."""
+    from flink_siddhi_tpu.runtime.replay import ShardedResidentReplay
+    from flink_siddhi_tpu.runtime.sources import ControlListSource
 
     schema = _schema()
     plan = compile_plan(
         "from inputStream[id == 1] select id insert into out",
         {"inputStream": schema},
     )
-    ctrl = CallbackSource("ctrl", None)
     job = Job(
         [plan],
         [BatchSource("inputStream", schema, iter([]))],
-        control_sources=[ctrl],
+        control_sources=[ControlListSource([])],
     )
-    # the rejection must NAME the working alternative: streaming mode
-    # via Job.run()/run_cycle() applies control at batch boundaries
-    with pytest.raises(ValueError, match="control") as ei:
-        ResidentReplay(job)
+    rep = ResidentReplay(job)  # accepted: epoch-boundary control
+    rep.execute()
+    assert job.finished
+    job2 = Job(
+        [plan],
+        [BatchSource("inputStream", schema, iter([]))],
+        control_sources=[ControlListSource([])],
+    )
+    with pytest.raises(ValueError, match="epoch") as ei:
+        ShardedResidentReplay(job2)
     msg = str(ei.value)
-    assert "streaming" in msg
-    assert "Job.run()" in msg and "Job.run_cycle()" in msg
+    assert "streaming" in msg and "control_plane" in msg
 
 
 def test_rerun_is_deterministic_counts_only():
