@@ -64,4 +64,38 @@ RULES = {
         "watermarks had to be hand-added to checkpoints after the "
         "fact)"
     ),
+    # FST2xx: fstrace — thread ownership & lock discipline
+    # (analysis/threads.py; rooted at `# fst:thread-root name=...`
+    # annotations, docs/static_analysis.md has the reference)
+    "FST201": (
+        "off-thread-mutation: state the run-loop thread owns (written "
+        "by code reachable from a `# fst:thread-root name=run-loop` "
+        "entry point) is ALSO written from a differently-named thread "
+        "root without going through the control queue — the PR 12 "
+        "contract ('state mutates only via control events applied on "
+        "the run-loop thread'), now enforced"
+    ),
+    "FST202": (
+        "unsynchronized-shared-state: a mutable container attribute is "
+        "reached from >= 2 thread roots with at least one write, and "
+        "is neither lock-guarded at every access nor annotated "
+        "`# fst:threadsafe <reason>` (reason mandatory, like "
+        "fst:ephemeral) — racy iteration/mutation the GIL does not "
+        "save you from"
+    ),
+    "FST203": (
+        "blocking-under-lock: a blocking call (sleep, socket recv/"
+        "accept, queue.get, jitted dispatch, block_until_ready) runs "
+        "while a lock is held (a `with <lock>` block, a `*_locked` "
+        "method, or a helper only ever called under one) — the PR 7 "
+        "ApiVersions-backoff-under-the-client-lock class; annotate "
+        "`# fst:blocking-ok <reason>` only with a written reason"
+    ),
+    "FST204": (
+        "check-then-act-outside-lock: an attribute that is lock-"
+        "guarded elsewhere in its class is tested and then mutated in "
+        "a branch that does NOT hold the lock — the decision can be "
+        "stale by the time the mutation lands (TOCTOU against the "
+        "class's own lock discipline)"
+    ),
 }

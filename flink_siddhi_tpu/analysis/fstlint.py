@@ -1,19 +1,32 @@
-"""fstlint: the JAX-hazard linter CLI.
+"""fstlint: the JAX-hazard + thread-discipline linter CLI.
 
 Usage::
 
     fstlint [paths...] [--baseline FILE | --no-baseline]
-            [--rule FSTnnn[,FSTnnn...]]
+            [--rule FSTnnn[,FSTnnn...]] [--changed] [--no-cache]
             [--write-baseline FILE] [--list-rules] [--json]
 
 With no paths, lints the default surface: the ``flink_siddhi_tpu``
-package, ``bench.py``, and ``scripts/``. ``--rule`` restricts output
+package, ``bench.py``, and ``scripts/``. The default sweep runs the
+per-module FST1xx rules (rules.py) AND the cross-module FST2xx
+thread-ownership pass (threads.py). ``--rule`` restricts output
 to the named rule id(s) — iterate on ONE rule without wading through
 a full-repo sweep (staleness is not enforced on a filtered run, like
-a targeted-paths run). Exit codes: 0 clean; 1 unsuppressed findings;
-2 baseline problems (stale entries, missing or REVIEWME reasons,
-parse errors). ``scripts/run_static_analysis.py`` runs this (plus
-plancheck and admission over the query zoo) in the tier-1 lane.
+a targeted-paths run).
+
+The default sweep is cached (``.fstlint_cache.json`` at the repo
+root, keyed by per-file mtime+size plus a fingerprint of the analysis
+package itself), so the tier-1 repo-lints-clean gate does not
+re-parse ~100 unchanged files every run — the suite runs ~833s of an
+870s budget and every second counts. ``--no-cache`` bypasses it;
+``--changed`` additionally restricts REPORTING to files whose cache
+entry was stale (a quick pre-commit loop; staleness is not enforced,
+like a targeted run). Targeted-path runs never use the cache.
+
+Exit codes: 0 clean; 1 unsuppressed findings; 2 baseline problems
+(stale entries, missing or REVIEWME reasons, parse errors).
+``scripts/run_static_analysis.py`` runs this (plus plancheck and
+admission over the query zoo) in the tier-1 lane.
 """
 
 from __future__ import annotations
@@ -22,7 +35,7 @@ import argparse
 import json
 import os
 import sys
-from typing import Iterable, List, Optional, Sequence
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .baseline import (
     BaselineError,
@@ -32,6 +45,7 @@ from .baseline import (
 )
 from .findings import RULES, Finding
 from .rules import lint_module
+from .threads import analyze_sources
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REPO_ROOT = os.path.dirname(_PKG_DIR)
@@ -73,28 +87,129 @@ def _rel(path: str, root: str) -> str:
     return rel.replace(os.sep, "/")
 
 
+CACHE_PATH = os.path.join(REPO_ROOT, ".fstlint_cache.json")
+_CACHE_VERSION = 1
+
+
+def _rules_fingerprint() -> List:
+    """mtime+size of every analysis-package module: editing a rule (or
+    adding one) invalidates the whole cache — stale findings from an
+    old rule set must never satisfy the tier-1 gate."""
+    d = os.path.dirname(os.path.abspath(__file__))
+    out = []
+    for f in sorted(os.listdir(d)):
+        if f.endswith(".py"):
+            st = os.stat(os.path.join(d, f))
+            out.append([f, st.st_mtime_ns, st.st_size])
+    return out
+
+
+def _load_cache() -> Dict:
+    try:
+        with open(CACHE_PATH, "r", encoding="utf-8") as fh:
+            cache = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if (
+        cache.get("version") != _CACHE_VERSION
+        or cache.get("rules") != _rules_fingerprint()
+    ):
+        return {}
+    return cache
+
+
+def _store_cache(cache: Dict) -> None:
+    cache["version"] = _CACHE_VERSION
+    cache["rules"] = _rules_fingerprint()
+    tmp = CACHE_PATH + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(cache, fh)
+        os.replace(tmp, CACHE_PATH)
+    except OSError:
+        pass  # a read-only checkout just pays the full sweep
+
+
+def _decode_findings(raw) -> List[Finding]:
+    return [Finding(p, int(ln), r, m) for p, ln, r, m in raw]
+
+
+def _encode_findings(findings: Iterable[Finding]) -> List:
+    return [[f.path, f.line, f.rule, f.message] for f in findings]
+
+
 def lint_paths(
-    paths: Optional[Sequence[str]] = None, root: Optional[str] = None
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    cache: bool = False,
+    changed_out: Optional[Set[str]] = None,
 ) -> List[Finding]:
-    """Lint files/directories; findings carry root-relative paths."""
+    """Lint files/directories; findings carry root-relative paths.
+
+    Runs the per-module FST1xx rules over every file plus the
+    cross-module FST2xx thread pass over the whole set. ``cache=True``
+    (the default sweep) reuses per-file results keyed by mtime+size
+    and the whole-set thread-pass result keyed by every file's stamp;
+    ``changed_out`` (a set) receives the rel-paths that were actually
+    re-linted."""
     root = root or REPO_ROOT
     targets = list(paths) if paths else _default_targets()
+    stored = _load_cache() if cache else {}
+    file_cache: Dict = stored.get("files", {}) if cache else {}
+    new_files: Dict = {}
     findings: List[Finding] = []
+    sources: Dict[str, str] = {}
+    stamps: List = []
     for fp in _iter_py_files(targets):
+        rel = _rel(fp, root)
+        st = os.stat(fp)
+        key = [st.st_mtime_ns, st.st_size]
+        stamps.append([rel, key])
         with open(fp, "r", encoding="utf-8") as fh:
             source = fh.read()
-        try:
-            findings.extend(lint_module(source, _rel(fp, root)))
-        except SyntaxError as e:
-            findings.append(
-                Finding(
-                    _rel(fp, root),
-                    e.lineno or 0,
-                    "FST000",
-                    f"file does not parse: {e.msg}",
-                )
-            )
-    return sorted(findings)
+        sources[rel] = source
+        entry = file_cache.get(rel)
+        if cache and entry is not None and entry.get("key") == key:
+            per_file = _decode_findings(entry["findings"])
+        else:
+            if changed_out is not None:
+                changed_out.add(rel)
+            try:
+                per_file = lint_module(source, rel)
+            except SyntaxError as e:
+                per_file = [
+                    Finding(
+                        rel,
+                        e.lineno or 0,
+                        "FST000",
+                        f"file does not parse: {e.msg}",
+                    )
+                ]
+        new_files[rel] = {
+            "key": key, "findings": _encode_findings(per_file)
+        }
+        findings.extend(per_file)
+    # cross-module thread pass (FST2xx): cached on the WHOLE file-set
+    # stamp — one changed file re-runs it (ownership is a cross-module
+    # property), an unchanged set reuses the stored result
+    sweep_key = sorted(stamps)
+    threads_entry = stored.get("threads", {}) if cache else {}
+    if cache and threads_entry.get("key") == sweep_key:
+        thread_findings = _decode_findings(threads_entry["findings"])
+    else:
+        thread_findings = analyze_sources(sources)
+    findings.extend(thread_findings)
+    if cache:
+        _store_cache(
+            {
+                "files": new_files,
+                "threads": {
+                    "key": sweep_key,
+                    "findings": _encode_findings(thread_findings),
+                },
+            }
+        )
+    return sorted(set(findings))
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -120,7 +235,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="report only findings in files whose sweep-cache entry "
+        "was stale (quick pre-commit loop; staleness not enforced, "
+        "like a targeted run)",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="bypass the mtime-keyed sweep cache (.fstlint_cache.json)",
+    )
     args = ap.parse_args(argv)
+    if args.changed and args.paths:
+        ap.error("--changed applies to the default sweep only")
+    if args.changed and args.no_cache:
+        ap.error("--changed needs the cache to know what changed")
+    if args.changed and args.write_baseline:
+        # same hole as --rule below: a baseline regenerated from the
+        # stale-files subset would silently DROP every unchanged
+        # file's suppressions (and their human-written reasons)
+        ap.error(
+            "--changed cannot be combined with --write-baseline (the "
+            "regenerated baseline would drop unchanged files' entries)"
+        )
 
     rule_filter = {
         r.strip().upper()
@@ -150,9 +289,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             print(f"{rid}  {desc}")
         return 0
 
-    findings = lint_paths(args.paths or None)
+    changed: Set[str] = set()
+    findings = lint_paths(
+        args.paths or None,
+        # cache the default sweep only: targeted paths (tests, tmp
+        # files) are cheap and their churn would thrash the cache
+        cache=not args.paths and not args.no_cache,
+        changed_out=changed,
+    )
     if rule_filter:
         findings = [f for f in findings if f.rule in rule_filter]
+    if args.changed:
+        findings = [f for f in findings if f.path in changed]
 
     if args.write_baseline:
         # regenerating a live baseline must PRESERVE human-written
@@ -197,7 +345,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "REVIEWME reason — explain it or fix the finding"
                 )
         findings, stale = apply_baseline(findings, sups)
-        if args.paths or rule_filter:
+        if args.paths or rule_filter or args.changed:
             # a targeted run lints a SUBSET of the surface (by path or
             # by rule), so a suppression for an out-of-scope finding
             # matching nothing is expected, not stale — staleness is

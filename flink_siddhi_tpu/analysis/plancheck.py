@@ -286,6 +286,41 @@ def _check_one_nfa(plan_id: str, info: Dict, issues: List[PlanIssue]) -> None:
                     f"positive window ({lo}, {hi}) — the next-match "
                     "scan would consult the wrong table row",
                 )
+    # first-occurrence entry guards (sequence absence folded before a
+    # QUANTIFIED element): the compiler may place one only on a
+    # non-negated, non-first element whose quantifier is real and whose
+    # min count is >= 1 — any other placement means the fold took the
+    # wrong path ((1,1) absences fold into the plain filter; a min-0
+    # element can be skipped, which would silently bypass the guard)
+    quant = info.get("quantifiers")
+    for g in tuple(info.get("entry_guards", ())):
+        if not (0 <= g < n):
+            bad("PLC203", f"entry guard index {g} out of range({n})")
+        elif negated[g]:
+            bad(
+                "PLC203",
+                f"first-occurrence entry guard on element {g}, which "
+                "is itself a 'not' element",
+            )
+        elif g == 0:
+            bad(
+                "PLC203",
+                "first-occurrence entry guard on element 0 — nothing "
+                "precedes it, so no absence can have produced the guard",
+            )
+        elif quant is not None and tuple(quant[g]) == (1, 1):
+            bad(
+                "PLC203",
+                f"first-occurrence entry guard on unquantified element "
+                f"{g} — (1,1) absences fold into the element filter, "
+                "not the count-conditional entry path",
+            )
+        elif quant is not None and quant[g][0] < 1:
+            bad(
+                "PLC203",
+                f"first-occurrence entry guard on optional element {g} "
+                "(min count 0) — a skip would bypass the guard entirely",
+            )
     if t_guard is not None:
         if not (0 <= t_guard < n) or not negated[t_guard]:
             bad(

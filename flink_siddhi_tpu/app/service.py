@@ -161,6 +161,7 @@ class QueryControlService:
                     return None
                 return parts[3:]
 
+            # fst:thread-root name=service
             def do_GET(self):
                 parts = [p for p in self.path.split("/") if p]
                 if parts == ["api", "v1", "health"]:
@@ -243,6 +244,7 @@ class QueryControlService:
                 )
                 self._reply(200, {"queries": ids})
 
+            # fst:thread-root name=service
             def do_POST(self):
                 tail = self._route()
                 if tail is None:
@@ -281,6 +283,7 @@ class QueryControlService:
                     return self._reply(200, {"id": tail[0]})
                 self._reply(404, {"error": "not found"})
 
+            # fst:thread-root name=service
             def do_PUT(self):
                 tail = self._route()
                 if tail is None or len(tail) != 1:
@@ -306,6 +309,7 @@ class QueryControlService:
                 service.control.push(ev)
                 self._reply(200, {"id": tail[0], "admission": summary})
 
+            # fst:thread-root name=service
             def do_DELETE(self):
                 tail = self._route()
                 if tail is None or len(tail) != 1:

@@ -49,6 +49,13 @@ def _compile_scalar(expr: ast.Expr) -> Callable[[Dict[str, Any]], Any]:
         return lambda ev: v
     if isinstance(expr, ast.Attr):
         name = expr.name
+        if getattr(expr, "index", None) not in (None, 0):
+            # sequence captures store FIRST-occurrence fields only;
+            # silently serving s[k]/s[last] from them would corrupt
+            # the oracle
+            raise SiddhiQLError(
+                "baseline interpreter: only s.x / s[0].x references"
+            )
         if expr.qualifier is not None:
             key = f"{expr.qualifier}.{name}"
             return lambda ev: ev[key] if key in ev else ev[name]
@@ -141,6 +148,122 @@ class _Chain:
                 self.partials.append((1, ts, caps))
 
 
+class _Sequence:
+    """Strict sequence (``,``) interpreter: quantifiers with greedy
+    absorb-before-advance, optional-skip, break-kill (emitting when
+    every remaining element is optional), and absence (``not B``)
+    applied as a veto on the NEXT positive element's ENTRY event only —
+    the per-event twin of the slot engine's count-conditional entry
+    guard (compiler/nfa.py `_rewrite_sequence_absence`), kept obviously
+    correct so randomized oracle tests can cross-check the device
+    engine against it."""
+
+    def __init__(self, q: ast.Query):
+        inp = q.input
+        self.every = inp.every_
+        # positive steps: (alias, stream, filter, min, max, guards);
+        # guards are the same-stream absent elements immediately before
+        # this step — each vetoes the step's first (entering) event
+        self.steps: List[Tuple] = []
+        pending: List[Tuple[str, Optional[Callable]]] = []
+        for el in inp.elements:
+            flt = (
+                _compile_scalar(el.filter)
+                if el.filter is not None
+                else None
+            )
+            if el.negated:
+                pending.append((el.stream_id, flt))
+                continue
+            guards = [
+                gf for gs, gf in pending if gs == el.stream_id
+            ]  # different-stream absences are vacuous under strictness
+            self.steps.append(
+                (
+                    el.alias,
+                    el.stream_id,
+                    flt,
+                    el.min_count,
+                    el.max_count,
+                    guards,
+                )
+            )
+            pending = []
+        self.projs = [
+            _compile_scalar(it.expr) for it in q.selector.items
+        ]
+        self.out = q.output_stream
+        # (step_idx, count, caps); caps holds FIRST-occurrence fields
+        # (bare ``s.x`` means ``s[0].x``)
+        self.partials: List[Tuple[int, int, Dict[str, Any]]] = []
+        self.done = False
+
+    def _min_sum(self, a: int, b: int) -> int:
+        return sum(self.steps[i][3] for i in range(a + 1, b))
+
+    def _matches(self, step: int, ev) -> bool:
+        # single-input-stream interpreter (like _Chain): stream routing
+        # is the caller's concern, filters decide here
+        flt = self.steps[step][2]
+        return flt is None or bool(flt(ev))
+
+    def _blocked(self, step: int, ev) -> bool:
+        return any(g is None or bool(g(ev)) for g in self.steps[step][5])
+
+    def _capture(self, caps, step, ev, first: bool) -> None:
+        alias = self.steps[step][0]
+        if first:
+            for k, v in ev.items():
+                caps[f"{alias}.{k}"] = v
+
+    def _close(self, caps, ts, emit) -> None:
+        emit(self.out, ts, tuple(p(caps) for p in self.projs))
+        self.done = True
+
+    def on_event(self, ev, ts, emit):
+        K = len(self.steps)
+        survivors = []
+        for step, count, caps in self.partials:
+            _a, _s, _f, mn, mx, _g = self.steps[step]
+            if self._matches(step, ev) and (mx < 0 or count < mx):
+                # absorb: count >= 1 here, so entry guards don't apply
+                if step == K - 1 and count + 1 == mx:
+                    self._close(caps, ts, emit)
+                else:
+                    survivors.append((step, count + 1, caps))
+                continue
+            advanced = False
+            if count >= mn:
+                for tgt in range(step + 1, K):
+                    if (
+                        self._min_sum(step, tgt) == 0
+                        and self._matches(tgt, ev)
+                        and not self._blocked(tgt, ev)
+                    ):
+                        caps2 = dict(caps)
+                        self._capture(caps2, tgt, ev, first=True)
+                        if tgt == K - 1 and self.steps[tgt][4] == 1:
+                            self._close(caps2, ts, emit)
+                        else:
+                            survivors.append((tgt, 1, caps2))
+                        advanced = True
+                        break
+            if advanced:
+                continue
+            # break: emit iff every remaining element is optional
+            if count >= mn and self._min_sum(step, K) == 0:
+                self._close(caps, ts, emit)
+        self.partials = survivors
+        can_arm = self.every or (not self.done and not self.partials)
+        if can_arm and self._matches(0, ev):
+            caps = {}
+            self._capture(caps, 0, ev, first=True)
+            if K == 1 and self.steps[0][4] == 1:
+                self._close(caps, ts, emit)
+            else:
+                self.partials.append((0, 1, caps))
+
+
 class _LengthWindowGroupBy:
     """``#window.length(C) select ... group by k``: ring of the last C
     events + per-group running aggregates (add on arrival, subtract on
@@ -198,9 +321,10 @@ class _LengthWindowGroupBy:
 
 class BaselineEngine:
     """Per-event interpreter for the benchmark CQL surface: stateless
-    filters, every-chains with within, and sliding length-window
-    group-by aggregation. Multi-query plans fan each event through every
-    query, one runtime per query (the reference's operator design)."""
+    filters, every-chains with within, strict sequences (quantifiers +
+    absence), and sliding length-window group-by aggregation.
+    Multi-query plans fan each event through every query, one runtime
+    per query (the reference's operator design)."""
 
     def __init__(self, cql: str, field_names: List[str]):
         plan = parse_plan(cql)
@@ -209,7 +333,10 @@ class BaselineEngine:
         for q in plan.queries:
             inp = q.input
             if isinstance(inp, ast.PatternInput):
-                self.handlers.append(_Chain(q))
+                if inp.kind == "sequence":
+                    self.handlers.append(_Sequence(q))
+                else:
+                    self.handlers.append(_Chain(q))
             elif isinstance(inp, ast.StreamInput):
                 if inp.windows:
                     win = inp.windows[0]

@@ -300,6 +300,12 @@ class _PatternSpec:
     # mid-chain `-> every X`: elements where every matching event FORKS a
     # continuing instance while the matched prefix stays armed
     every_marks: Tuple[bool, ...] = ()
+    # first-occurrence-only guards (sequence absence before a quantified
+    # element, `A, not B, C+`): per element, the event-only predicate the
+    # slot engine additionally requires on the ADVANCE-INTO-element path
+    # — count-conditional by construction, since absorbs (count >= 1)
+    # never consult it. None = unguarded.
+    entry_guard_fns: Tuple[Optional[Callable], ...] = ()
     # wire predicate pushdown: per element, the numpy twin of its
     # event-only filter (None when absent or not host-evaluable)
     host_pred_fns: Tuple = ()
@@ -320,7 +326,14 @@ def _rewrite_sequence_absence(inp: ast.PatternInput) -> ast.PatternInput:
     (when B and C read the same stream; a different-stream B could never
     be that event, so the guard is vacuous). Siddhi sequence absence
     semantics via pure AST rewrite (README.md:77-96 "Sequence
-    Processing")."""
+    Processing").
+
+    A QUANTIFIED next element (``A, not B, C+`` / ``C<m:n>`` with
+    ``m >= 1``) folds the guard into ``entry_filter`` instead: the
+    guard constrains only the first occurrence (the event entering C),
+    and the slot engine applies it count-conditionally on the
+    advance-into-element path, never on absorbs — later repeats'
+    predecessor is the previous repeat, not B's window."""
     import dataclasses
 
     els = list(inp.elements)
@@ -342,25 +355,19 @@ def _rewrite_sequence_absence(inp: ast.PatternInput) -> ast.PatternInput:
             # every guard of the run applies to THIS (the next
             # non-absent) element's event — folding one absent filter
             # into another absent element would negate it twice
-            if (el.min_count, el.max_count) != (1, 1):
-                # The fold rewrites `not B` into the next element's
-                # filter; for a quantified next element the guard
-                # belongs to its FIRST occurrence only — folding it
-                # into the shared per-occurrence filter would also
-                # veto later repeats whose predecessor is a repeat,
-                # not B's window. Expressing "first occurrence only"
-                # needs a count-conditional predicate in the slot-NFA
-                # absorb path; until then this rejects rather than
-                # silently matching fewer sequences. Rewrite as
-                # `A, (C and not B-guard), C*`-style splits only when
-                # the quantified element is not capture-referenced.
+            quantified = (el.min_count, el.max_count) != (1, 1)
+            if quantified and el.min_count < 1:
+                # a skipped optional consumes no event, so the guard
+                # would have to transfer to whichever LATER element
+                # takes the next event — a placement the per-element
+                # entry-guard fold below cannot express
                 raise SiddhiQLError(
-                    "absence before a QUANTIFIED sequence element is "
-                    "not supported (the guard applies to the first "
-                    "occurrence only, which the folded form cannot "
-                    "express); split the first occurrence into its "
-                    "own element: `A, not B, C, C*` -> "
-                    "`A, not B, c1=C, crest=C*`"
+                    "absence before an OPTIONAL sequence element "
+                    "(min count 0) is not supported: when the element "
+                    "is skipped the guard has no event to constrain; "
+                    "make the first occurrence mandatory "
+                    "(`C*` -> `C+`, `C<0:n>` -> `C<1:n>`) or split it "
+                    "out: `A, not B, c1=C, crest=C*`"
                 )
             nxt = el
             for ab in pending:
@@ -378,14 +385,33 @@ def _rewrite_sequence_absence(inp: ast.PatternInput) -> ast.PatternInput:
                 guard = ast.Unary(
                     "not", _rebind_alias(ab.filter, ab.alias, nxt.alias)
                 )
-                nxt = dataclasses.replace(
-                    nxt,
-                    filter=(
-                        guard
-                        if nxt.filter is None
-                        else ast.Binary("and", nxt.filter, guard)
-                    ),
-                )
+                if quantified:
+                    # the guard belongs to the FIRST occurrence only —
+                    # folding it into the shared per-occurrence filter
+                    # would also veto later repeats whose predecessor
+                    # is a repeat, not B's window. It lands in
+                    # ``entry_filter`` (count-conditional: the slot
+                    # engine applies it on the advance-into-element
+                    # path and not on absorbs).
+                    nxt = dataclasses.replace(
+                        nxt,
+                        entry_filter=(
+                            guard
+                            if nxt.entry_filter is None
+                            else ast.Binary(
+                                "and", nxt.entry_filter, guard
+                            )
+                        ),
+                    )
+                else:
+                    nxt = dataclasses.replace(
+                        nxt,
+                        filter=(
+                            guard
+                            if nxt.filter is None
+                            else ast.Binary("and", nxt.filter, guard)
+                        ),
+                    )
             pending = []
             out.append(nxt)
         else:
@@ -558,6 +584,41 @@ def _build_spec(
         cross_refs.append(tuple(sorted(alias_idx[a] for a in foreign)))
         cross_idx_refs.append(_indexed_refs(el.filter))
         host_pred_fns.append(None)
+
+    # first-occurrence entry guards (sequence absence rewrite): compile
+    # each against the guarded element's OWN event only — the guard is a
+    # rebound `not B` over the entering event, and the absent element's
+    # filter was barred from cross references above
+    entry_guard_fns: List[Optional[Callable]] = []
+    for i, el in enumerate(inp.elements):
+        ef = el.entry_filter
+        if ef is None:
+            entry_guard_fns.append(None)
+            continue
+        if any(
+            a.qualifier is not None
+            and a.qualifier in alias_idx
+            and a.qualifier != el.alias
+            for a in ast.iter_attrs(ef)
+        ):
+            raise SiddhiQLError(
+                "cross-element references are not supported in absent "
+                "('not') element filters"
+            )
+        schema = schemas[el.stream_id]
+        resolver = ExprResolver(
+            {
+                el.alias: (el.stream_id, schema),
+                el.stream_id: (el.stream_id, schema),
+            },
+            default_scope=el.alias,
+        )
+        ce = compile_expr(ef, resolver, extensions)
+        if ce.atype != AttributeType.BOOL:
+            raise SiddhiQLError(
+                "sequence absence guard must be boolean"
+            )
+        entry_guard_fns.append(ce.fn)
     if q.selector.is_star:
         raise SiddhiQLError(
             "select * is not valid for pattern queries; name the captures"
@@ -665,6 +726,7 @@ def _build_spec(
             getattr(el, "every_marked", False) for el in inp.elements
         ),
         host_pred_fns=tuple(host_pred_fns),
+        entry_guard_fns=tuple(entry_guard_fns),
     )
 
 
@@ -797,6 +859,14 @@ def _spec_check_info(name: str, spec: "_PatternSpec", **extra) -> Dict:
         negated=tuple(el.negated for el in spec.elements),
         quantifiers=tuple(
             (el.min_count, el.max_count) for el in spec.elements
+        ),
+        # first-occurrence guards (sequence absence before a quantified
+        # element): PLC203 pins their placement — quantified, non-first,
+        # mandatory-min elements only
+        entry_guards=tuple(
+            k
+            for k, f in enumerate(spec.entry_guard_fns or ())
+            if f is not None
         ),
     )
     info.update(extra)
@@ -2998,6 +3068,22 @@ class SlotNFAArtifact:
 
         preds = _element_preds(spec, tape, state["enabled"])
         pred_mat = jnp.stack(preds, axis=1)  # [E, K]
+        # first-occurrence entry guards (sequence absence before a
+        # quantified element): a stricter per-event mask consulted only
+        # on the advance-into-element path below — absorbs keep the
+        # plain mask, which is what makes the guard count-conditional
+        egf = spec.entry_guard_fns or ()
+        if any(f is not None for f in egf):
+            genv: ColumnEnv = dict(tape.cols)
+            entry_mat = jnp.stack(
+                [
+                    preds[k] if f is None else preds[k] & f(genv)
+                    for k, f in enumerate(egf)
+                ],
+                axis=1,
+            )
+        else:
+            entry_mat = pred_mat
         cap_srcs = {
             pair: tape.cols[spec.cap_src_key[pair]] for pair in pairs
         }
@@ -3020,7 +3106,7 @@ class SlotNFAArtifact:
 
         def body(carry, x):
             st, buf = carry
-            ts_e, valid_e, m, caps_e = x  # m: bool[K]
+            ts_e, valid_e, m, m_entry, caps_e = x  # m, m_entry: bool[K]
 
             active = st["active"]
             step = st["step"]
@@ -3064,22 +3150,29 @@ class SlotNFAArtifact:
                 return (st["matched"] & jnp.int32(1 << e)) != 0
 
             eff = []
+            eff_entry = []  # entry-guarded variant (advance path only)
             for e in range(K):
                 v = jnp.broadcast_to(m[e], (S,))
+                ve = jnp.broadcast_to(m_entry[e], (S,))
                 if e in cross_ok:
                     v = v & cross_ok[e]
+                    ve = ve & cross_ok[e]
                 eff.append(v)
+                eff_entry.append(ve)
             entry_g, need_g = [], []
             for g, (mem, op) in enumerate(zip(GM, gops)):
-                ent = eff[mem[0]]
+                # entry (advance INTO the group) consults the
+                # first-occurrence guard; need (absorb AT the group,
+                # count >= 1) deliberately does not
+                ent = eff_entry[mem[0]]
+                nee = eff[mem[0]]
                 for e in mem[1:]:
-                    ent = ent | eff[e]
+                    ent = ent | eff_entry[e]
+                    nee = nee | eff[e]
                 if len(mem) > 1 and op == "and":
                     nee = eff[mem[0]] & ~has_bit(mem[0])
                     for e in mem[1:]:
                         nee = nee | (eff[e] & ~has_bit(e))
-                else:
-                    nee = ent
                 entry_g.append(ent)
                 need_g.append(nee)
 
@@ -3404,7 +3497,7 @@ class SlotNFAArtifact:
         xcols = {_skey("src", *pair): cap_srcs[pair] for pair in pairs}
         for key in spec.evt_keys:
             xcols[f"evt:{key}"] = tape.cols[key]
-        xs = (tape.ts, tape.valid, pred_mat, xcols)
+        xs = (tape.ts, tape.valid, pred_mat, entry_mat, xcols)
         # Relevance compaction (pattern kind only): '->' ignores events
         # matching no element, so the sequential scan — the expensive part,
         # ~E dependent steps — only needs the events whose predicate row is
@@ -3429,6 +3522,7 @@ class SlotNFAArtifact:
                 tape.ts[idx],
                 cvalid,
                 pred_mat[idx] & cvalid[:, None],
+                entry_mat[idx] & cvalid[:, None],
                 {k: v[idx] for k, v in xcols.items()},
             )
             (new_state, buf), _ = jax.lax.cond(

@@ -234,6 +234,12 @@ class PatternElement:
     # matched, EVERY event matching this element spawns a fresh instance
     # continuing from here, while the prefix stays armed
     every_marked: bool = False
+    # first-occurrence-only guard (set by the sequence-absence rewrite,
+    # never by the parser): `A, not B, C+` folds `not B` here rather
+    # than into ``filter`` — the guard constrains only the event that
+    # ENTERS this quantified element, not its later absorbed repeats
+    # (whose predecessor is the previous repeat, not B's window)
+    entry_filter: Optional[Expr] = None
 
 
 @dataclass(frozen=True)

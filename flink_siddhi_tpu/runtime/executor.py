@@ -59,6 +59,24 @@ def _staging_allow():
         return jax.transfer_guard("allow")
     return contextlib.nullcontext()
 
+
+# Run-loop ownership contract (tests/conftest.py flips this for the
+# control-plane/service/fault suites, like HOTLOOP_TRANSFER_GUARD):
+# with the guard on, the first run()/run_cycle() stamps its thread id
+# as the run-loop owner, and every state-mutating control entry point
+# (add_plan / remove_plan / set_plan_enabled / _apply_control /
+# reset_engine_state) asserts it executes on that thread. This is the
+# DYNAMIC half of the fstrace FST201 invariant ("state mutates only
+# via control events applied on the run-loop thread",
+# docs/control_plane.md): the linter proves the call graph, the guard
+# executes it under the service/control/fault tests.
+RUNLOOP_OWNERSHIP_GUARD = False
+
+
+class OwnershipViolation(RuntimeError):
+    """A run-loop-owned mutation entry point ran on a thread other
+    than the stamped run-loop owner — the FST201 hazard, caught live."""
+
 MAX_WM = np.iinfo(np.int64).max
 MIN_WM = -(2 ** 62)  # pre-first-event watermark sentinel
 # side-output channel naming: a stream's late rows surface on
@@ -515,17 +533,22 @@ class Job:
         self._control = list(control_sources)
         self._control_wm: List[int] = [MIN_WM] * len(self._control)
         self._control_done: List[bool] = [False] * len(self._control)
+        # fst:threadsafe single-writer (run loop); the finished property only bool-tests it off-thread
         self._control_pending: List[Tuple[int, object]] = []
         self._plan_compiler = plan_compiler
         # reorder buffer: stream_id -> pending EventBatches (event time)
+        # fst:threadsafe single-writer (run loop); off-thread metrics() readers take list() snapshots only
         self._pending: Dict[str, List[EventBatch]] = {}
         self._epoch_ms: Optional[int] = None
+        # fst:threadsafe single-writer (run loop); off-thread status/metrics readers use GIL-atomic get()/list() snapshots, never Python-level iteration
         self._plans: Dict[str, _PlanRuntime] = {}
         # dynamic chain groups: user plan_id -> (host runtime id, slot).
         # A structurally-identical chain query folds into a pre-padded
         # group slot as a DATA update — no XLA recompile (SURVEY.md §7
         # hard part 4)
+        # fst:threadsafe single-writer (run loop); service reads are GIL-atomic get()/list() snapshots
         self._folded: Dict[str, Tuple[str, int]] = {}
+        # fst:threadsafe single-writer (run loop); service reads are GIL-atomic get()/list() snapshots
         self._folded_enabled: Dict[str, bool] = {}  # host-side mirror
         self._dynamic_cql: Dict[str, str] = {}  # for checkpoint replay
         # shape-keyed AOT executable cache (control/aotcache.py): a
@@ -536,6 +559,13 @@ class Job:
         from ..control.aotcache import AOTExecutableCache
 
         self.aot_cache = AOTExecutableCache()
+        # run-loop ownership stamp (RUNLOOP_OWNERSHIP_GUARD): thread id
+        # of whoever drives run()/run_cycle(), stamped at the first
+        # cycle; the control-path mutators assert against it when the
+        # guard is on. A restored/rebuilt job re-stamps at its next
+        # cycle, so supervisor restarts hand ownership over cleanly.
+        # fst:ephemeral thread ids are process-local; the restored job's run loop re-stamps at its first cycle
+        self._runloop_thread: Optional[int] = None
         # admission at APPLY time (docs/control_plane.md): the tenant
         # resource envelope every control-path add/update is judged
         # against (analysis/admit.AdmissionBudgets). None = structural
@@ -545,7 +575,15 @@ class Job:
         # rendered findings + tenant — what GET /api/v1/health and
         # metrics() surface so a refused add is observable without
         # log-diving. Bounded ring (oldest evicted past the cap).
+        # GENUINELY multi-writer: the run loop records apply-time
+        # refusals AND the REST service thread records boundary
+        # refusals (_admit, source="service") — so unlike the rest of
+        # Job state the ring is lock-guarded, not run-loop-owned
+        # (fstrace FST201/FST202, docs/static_analysis.md).
+        import threading
+
         self.control_rejections: Dict[str, dict] = {}
+        self._rejections_lock = threading.Lock()
         self.MAX_REJECTIONS_KEPT = 64
         # output rate limiting: stream_id -> limiter (from plan
         # ``output ... every ...`` clauses, applied at emission)
@@ -555,6 +593,7 @@ class Job:
         # output_stream -> list[(ts, row_tuple)] and field names
         self.collected: Dict[str, List[Tuple[int, Tuple]]] = {}
         self.output_fields: Dict[str, List[str]] = {}
+        # fst:threadsafe single-writer (run loop emit path); metrics() reads a dict() snapshot
         self.emitted_counts: Dict[str, int] = {}  # total rows ever emitted
         self._sinks: Dict[str, List[Callable]] = {}
         self.processed_events = 0  # observability (reference logs per runtime)
@@ -711,6 +750,32 @@ class Job:
                 bind(self.telemetry)
 
 
+    # -- run-loop ownership guard (the FST201 invariant, executed) ----------
+    def _stamp_runloop_owner(self) -> None:
+        import threading
+
+        if self._runloop_thread is None:
+            self._runloop_thread = threading.get_ident()
+
+    def _assert_runloop_owner(self, what: str) -> None:
+        """Debug-mode ownership assert at a state-mutating entry point:
+        no-op unless RUNLOOP_OWNERSHIP_GUARD is on AND a run loop has
+        stamped ownership (pre-run setup from the constructing thread
+        is always legitimate)."""
+        if not RUNLOOP_OWNERSHIP_GUARD or self._runloop_thread is None:
+            return
+        import threading
+
+        me = threading.get_ident()
+        if me != self._runloop_thread:
+            raise OwnershipViolation(
+                f"{what} executed on thread {me}, but the run loop "
+                f"(thread {self._runloop_thread}) owns Job state — "
+                "state mutates only via control events applied at "
+                "micro-batch boundaries (push a control event instead "
+                "of mutating directly; docs/control_plane.md)"
+            )
+
     # -- plan management (dynamic control plane hooks) ----------------------
     # Parity: AbstractSiddhiOperator.onEventReceived (:399-467) — add/update/
     # remove QueryRuntimeHandlers, enable/disable gating — applied here at
@@ -727,6 +792,7 @@ class Job:
         path (pallas chain core, no query axis). Pass ``cql`` so the add
         is checkpointable (snapshot replays dynamic queries from their
         CQL; the control-event path records it automatically)."""
+        self._assert_runloop_owner("add_plan")
         admit0 = None
         if dynamic:
             if plan.plan_id in self._folded or plan.plan_id in self._plans:
@@ -1042,6 +1108,7 @@ class Job:
         self._dynamic_cql.update(dynamic_cql)
 
     def remove_plan(self, plan_id: str) -> None:
+        self._assert_runloop_owner("remove_plan")
         folded = self._folded.pop(plan_id, None)
         self._folded_enabled.pop(plan_id, None)
         self._dynamic_cql.pop(plan_id, None)
@@ -1076,6 +1143,7 @@ class Job:
         self._drain_hints.pop(plan_id, None)
 
     def set_plan_enabled(self, plan_id: str, enabled: bool) -> None:
+        self._assert_runloop_owner("set_plan_enabled")
         folded = self._folded.get(plan_id)
         if folded is not None:
             self._folded_enabled[plan_id] = enabled
@@ -1105,11 +1173,19 @@ class Job:
 
     @property
     def plan_ids(self) -> List[str]:
+        """Live plan ids. Safe off-thread (GET /api/v1/queries runs on
+        the service thread): ``list(dict)`` snapshots atomically under
+        the GIL, where the previous Python-level comprehension over the
+        live dict could raise mid-iteration when the run loop admits or
+        retires a plan concurrently."""
         return [
-            pid for pid in self._plans if not pid.startswith("@dyn:")
+            pid
+            for pid in list(self._plans)
+            if not pid.startswith("@dyn:")
         ] + list(self._folded)
 
     def _apply_control(self, ev) -> None:
+        self._assert_runloop_owner("_apply_control")
         from ..control.events import (
             MetadataControlEvent,
             OperationControlEvent,
@@ -1240,21 +1316,28 @@ class Job:
         source: str = "apply-time",
     ) -> None:
         self._inc_control("control.admission_rejected")
-        # re-insert at the ring's tail: a repeated refusal of the same
-        # plan id must refresh its eviction position, or the freshest
-        # rejection could be the first one evicted
-        self.control_rejections.pop(plan_id, None)
-        self.control_rejections[plan_id] = {
-            "rules": [r for r in rules if r],
-            "findings": list(findings),
-            "tenant": tenant,
-            "source": source,
-        }
-        while len(self.control_rejections) > self.MAX_REJECTIONS_KEPT:
-            self.control_rejections.pop(
-                next(iter(self.control_rejections))
-            )
+        # under the lock: the REST service thread records boundary
+        # refusals concurrently with the run loop's apply-time ones,
+        # and the eviction walk below iterates the dict
+        with self._rejections_lock:
+            # re-insert at the ring's tail: a repeated refusal of the
+            # same plan id must refresh its eviction position, or the
+            # freshest rejection could be the first one evicted
+            self.control_rejections.pop(plan_id, None)
+            self.control_rejections[plan_id] = {
+                "rules": [r for r in rules if r],
+                "findings": list(findings),
+                "tenant": tenant,
+                "source": source,
+            }
+            while (
+                len(self.control_rejections) > self.MAX_REJECTIONS_KEPT
+            ):
+                self.control_rejections.pop(
+                    next(iter(self.control_rejections))
+                )
 
+    # fst:runloop-only (completes in-flight drains synchronously)
     def add_sink(self, output_stream: str, fn: Callable) -> None:
         """Attach a sink. Drains already in flight are completed first:
         with no prior consumers they were swapped counts-only, so the
@@ -1275,6 +1358,10 @@ class Job:
         in one of the copies). States re-grow to the interned encoder
         sizes: compiled programs were lowered against the GROWN
         shapes."""
+        self._assert_runloop_owner("reset_engine_state")
+        # a rerun is a fresh drive: the next run()/run_cycle() thread
+        # (bench reruns sometimes move threads) re-stamps ownership
+        self._runloop_thread = None
         for rt in self._plans.values():
             rt.states = jax.device_put(
                 rt.plan.grow_state(rt.plan.init_state())
@@ -1312,7 +1399,9 @@ class Job:
         self._source_last_t = [None] * len(self._sources)
 
     # -- run loop ------------------------------------------------------------
+    # fst:thread-root name=run-loop
     def run(self, max_cycles: Optional[int] = None) -> None:
+        self._stamp_runloop_owner()
         cycles = 0
         while not self.finished:
             self.run_cycle()
@@ -1322,6 +1411,7 @@ class Job:
         if self.finished:
             self.flush()
 
+    # fst:runloop-only (end-of-stream drain + timer emissions)
     def flush(self) -> None:
         """End-of-stream: drain accumulated matches, then fire final
         timer-driven emissions (timeBatch windows carry their last
@@ -1386,6 +1476,7 @@ class Job:
             lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), rt.states
         )
 
+        # fst:thread-root name=warm-compile
         def compile_it():
             return rt.jitted_flush.lower(abstract).compile()
 
@@ -1419,6 +1510,7 @@ class Job:
     # run loop.
     MAX_PENDING_DRAINS = 6
 
+    # fst:runloop-only (run-loop-private: swaps device accumulators and emits to sinks)
     def drain_outputs(self, wait: bool = True) -> None:
         """Surface all on-device accumulated emissions to collectors and
         sinks. ``wait=True`` (default, and the contract of results() /
@@ -1528,6 +1620,7 @@ class Job:
         drain and paid an extra round trip on misprediction)."""
         jits = getattr(rt, "pack_jits", None)
         if jits is None:
+            # fst:threadsafe lazy idempotent init, GIL-atomic dict ops: prewarm (run loop) and the fetch thread may race the first width; the loser's entry is identical and a lost insert just recompiles once
             jits = rt.pack_jits = {}
         fn = jits.get(width)
         if fn is None:
@@ -1655,6 +1748,7 @@ class Job:
         return pool
 
     @staticmethod
+    # fst:thread-root name=drain-fetch
     def _fetch_acc(rt: _PlanRuntime, acc: Dict, want: bool,
                    columnar: frozenset,
                    stages: Optional[Dict] = None):
@@ -1948,10 +2042,12 @@ class Job:
             if idle
         ]
 
+    # fst:thread-root name=run-loop
     def run_cycle(self) -> int:
         """Pull, apply control, reorder, step, decode. Returns events
         processed. Control events take effect at micro-batch boundaries
         (the reference applies them per event; §3.4)."""
+        self._stamp_runloop_owner()
         with _hotloop_guard():
             return self._run_cycle_guarded()
 
@@ -2935,6 +3031,7 @@ class Job:
 
     # -- checkpoint / restore (exceeds the reference: restore of engine
     # state was an abandoned TODO there, AbstractSiddhiOperator.java:341) --
+    # fst:runloop-only (drains + reads device state)
     def snapshot(self) -> Dict:
         from .checkpoint import snapshot_job
 
@@ -2943,6 +3040,7 @@ class Job:
         self.drain_outputs()
         return snapshot_job(self)
 
+    # fst:runloop-only (drains + captures device state)
     def save_checkpoint(self, path: str, keep: int = 1) -> None:
         """``keep > 1`` retains the K latest checkpoint generations
         (path, path.1, ..; checkpoint.save rotation) so a restore can
@@ -2953,6 +3051,7 @@ class Job:
         self.drain_outputs()
         save(self, path, keep=keep)
 
+    # fst:runloop-only (replaces device state wholesale)
     def restore(self, snapshot_or_path) -> None:
         import os
 
@@ -3047,6 +3146,8 @@ class Job:
                 if tel is not None
                 else {}
             )
+        with self._rejections_lock:
+            rejections = dict(self.control_rejections)
         return {
             "counters": {
                 k.split("control.", 1)[1]: v
@@ -3054,14 +3155,16 @@ class Job:
                 if k.startswith("control.")
             },
             "aot_cache": self.aot_cache.stats(),
-            "rejections": dict(self.control_rejections),
+            "rejections": rejections,
         }
 
     # -- results -------------------------------------------------------------
+    # fst:runloop-only (drains first)
     def results(self, output_stream: str) -> List[Tuple]:
         self.drain_outputs()
         return [row for _, row in self.collected.get(output_stream, [])]
 
+    # fst:runloop-only (drains first)
     def results_with_ts(self, output_stream: str) -> List[Tuple[int, Tuple]]:
         self.drain_outputs()
         return list(self.collected.get(output_stream, []))

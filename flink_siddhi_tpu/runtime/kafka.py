@@ -243,6 +243,7 @@ class KafkaClient:
             return self._call_locked(api, version, body)
 
     @staticmethod
+    # fst:blocking-ok thread-safe-per-call by design: the client lock IS the request slot, held across the whole round trip so concurrent callers cannot interleave frames on one socket
     def _read_frame(s: socket.socket) -> bytes:
         head = b""
         while len(head) < 4:
@@ -307,6 +308,7 @@ class KafkaClient:
                         self._note_fault(
                             "faults.kafka.negotiation.retries"
                         )
+                        # fst:blocking-ok constant <=50ms delay, never the exponential sequence (see comment above): every other call on this client gates on negotiation anyway, so waiting on the lock == waiting on negotiation — the PR 7 bug was the EXPONENTIAL backoff here
                         time.sleep(delay_s)
             self._versions = negotiate(broker)
         return self._versions

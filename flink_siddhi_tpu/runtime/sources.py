@@ -720,6 +720,7 @@ class SocketLineSource(_DecodedLinesSource):
         self.host, self.port = self._server.getsockname()[:2]
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
+    # fst:thread-root name=ingest
     def _accept_loop(self) -> None:
         import socket
         import threading
@@ -735,6 +736,7 @@ class SocketLineSource(_DecodedLinesSource):
                 target=self._reader, args=(conn,), daemon=True
             ).start()
 
+    # fst:thread-root name=ingest
     def _reader(self, conn) -> None:
         carry = b""
         try:

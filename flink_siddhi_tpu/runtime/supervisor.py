@@ -98,6 +98,7 @@ class Supervisor:
         self.restart_count = 0
         self.last_recovery_ms: Optional[float] = None
         self.last_error: Optional[BaseException] = None
+        # fst:threadsafe single-writer (the supervisor thread rebinds a fresh list per crash); health() reads a list() snapshot from the service thread
         self._crash_times: List[float] = []
         self._job = None
         self._finished = False
@@ -264,11 +265,14 @@ class Supervisor:
         rep.run()
         job.flush()
 
+    # fst:thread-root name=run-loop
     def run(self):
         """Drive the supervised job to completion; returns the final
         job. Raises :class:`RestartBudgetExceeded` when crashes exceed
         the budget (uncommitted output stays discarded — committed
-        rows remain exactly-once)."""
+        rows remain exactly-once). The supervisor thread IS the
+        run-loop thread of every job it drives (fstrace ownership:
+        docs/static_analysis.md)."""
         while True:
             try:
                 t0 = time.perf_counter()
@@ -309,8 +313,12 @@ class Supervisor:
         (the GET /api/v1/health payload)."""
         now = time.monotonic()
         job = self._job
+        # list() snapshot first: health() runs on the REST service
+        # thread while the supervisor may be appending a crash — the
+        # C-level copy is atomic under the GIL, a Python-level
+        # comprehension over the live list is not
         recent = [
-            t for t in self._crash_times
+            t for t in list(self._crash_times)
             if now - t <= self.restart_window_s
         ]
         return {

@@ -150,6 +150,7 @@ class SideChannelProber:
         threading.Thread(target=self._read_stdout, daemon=True).start()
         return self
 
+    # fst:thread-root name=prober
     def _read_stdout(self) -> None:
         try:
             for line in self._proc.stdout:
@@ -263,6 +264,7 @@ def _child_main() -> int:
     t_recv: Dict[int, float] = {}
     recv_lock = threading.Lock()
 
+    # fst:thread-root name=prober-ack
     def ack_loop() -> None:
         try:
             conn, _ = ack_srv.accept()

@@ -1,0 +1,32 @@
+"""Known-bad FST201: the PR 12 control-plane contract violated — a
+REST handler mutates run-loop-owned Job state directly instead of
+pushing a control event for the run loop to apply at a micro-batch
+boundary (the shipped-bug class the fstrace ownership pass exists
+for)."""
+
+
+class Job:
+    def __init__(self):
+        self._routes = {}
+        self._queue = []
+
+    # fst:thread-root name=run-loop
+    def run_cycle(self):
+        for ev in self._queue:
+            self._routes[ev] = True
+        self._queue = []
+
+
+class Service:
+    def __init__(self, job):
+        self.job = job
+
+    # fst:thread-root name=service
+    def do_POST(self, plan_id):
+        # BAD: direct off-thread write to run-loop-owned state
+        self.job._routes[plan_id] = True
+
+    # fst:thread-root name=service
+    def do_DELETE(self, plan_id):
+        # BAD: off-thread structural mutation, same class
+        self.job._routes.pop(plan_id, None)

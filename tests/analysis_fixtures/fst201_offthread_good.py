@@ -1,0 +1,48 @@
+"""Corrected twin of fst201_offthread_bad: the service thread pushes
+control events onto a locked queue; ONLY the run loop mutates Job
+state, applying drained events at the micro-batch boundary."""
+
+
+class ControlQueue:
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._pending = []
+
+    def push(self, ev):
+        with self._lock:
+            self._pending.append(ev)
+
+    def drain(self):
+        with self._lock:
+            out = list(self._pending)
+            self._pending = []
+        return out
+
+
+class Job:
+    def __init__(self, control):
+        self.control = control
+        self._routes = {}
+
+    # fst:thread-root name=run-loop
+    def run_cycle(self):
+        for ev in self.control.drain():
+            if ev[0] == "add":
+                self._routes[ev[1]] = True
+            else:
+                self._routes.pop(ev[1], None)
+
+
+class Service:
+    def __init__(self, job):
+        self.job = job
+
+    # fst:thread-root name=service
+    def do_POST(self, plan_id):
+        self.job.control.push(("add", plan_id))
+
+    # fst:thread-root name=service
+    def do_DELETE(self, plan_id):
+        self.job.control.push(("remove", plan_id))

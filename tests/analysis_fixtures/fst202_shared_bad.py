@@ -1,0 +1,28 @@
+"""Known-bad FST202: two worker threads mutate shared container
+attributes with the class's own lock sitting unused — racy dict/list
+mutation the GIL does not make safe (concurrent iteration raises,
+interleaved read-modify-write drops counts)."""
+
+
+class Collector:
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self.stats = {}
+        self.errors = []
+
+    # fst:thread-root name=decode-worker
+    def decode_loop(self):
+        # BAD: unlocked read-modify-write on a shared dict
+        self.stats["decoded"] = self.stats.get("decoded", 0) + 1
+
+    # fst:thread-root name=upload-worker
+    def upload_loop(self):
+        self.stats["uploaded"] = self.stats.get("uploaded", 0) + 1
+        # BAD: unlocked append on a shared list read by the other root
+        self.errors.append("late")
+
+    # fst:thread-root name=decode-worker
+    def report(self):
+        return list(self.errors)

@@ -1,0 +1,27 @@
+"""Corrected twin of fst202_shared_bad: every access to the shared
+containers holds the one lock that guards them."""
+
+
+class Collector:
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self.stats = {}
+        self.errors = []
+
+    # fst:thread-root name=decode-worker
+    def decode_loop(self):
+        with self._lock:
+            self.stats["decoded"] = self.stats.get("decoded", 0) + 1
+
+    # fst:thread-root name=upload-worker
+    def upload_loop(self):
+        with self._lock:
+            self.stats["uploaded"] = self.stats.get("uploaded", 0) + 1
+            self.errors.append("late")
+
+    # fst:thread-root name=decode-worker
+    def report(self):
+        with self._lock:
+            return list(self.errors)

@@ -1,0 +1,32 @@
+"""Known-bad FST203: the PR 7 ApiVersions bug reconstructed — the
+version-negotiation retry loop sleeps its (exponential!) backoff while
+the client lock is held, so every other thread queuing on the client
+waits out the whole backoff sequence; and the probe helper, reachable
+only from under the lock, blocks in recv."""
+
+import time
+
+
+class Client:
+    def __init__(self, sock):
+        import threading
+
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._versions = None
+
+    def negotiate(self):
+        with self._lock:
+            for attempt in range(5):
+                try:
+                    self._versions = self._probe_locked()
+                    return self._versions
+                except OSError:
+                    # BAD: exponential backoff under the client lock
+                    time.sleep(0.02 * (2 ** attempt))
+        return None
+
+    def _probe_locked(self):
+        # BAD: blocking recv; *_locked names run under the lock by
+        # convention (and every call site above holds it)
+        return self._sock.recv(4)

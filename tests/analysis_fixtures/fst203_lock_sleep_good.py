@@ -1,0 +1,37 @@
+"""Corrected twin of fst203_lock_sleep_bad: the blocking probe and the
+backoff sleep both run with the lock RELEASED — only the state update
+holds it — and the one deliberate wait-under-lock carries a reasoned
+`# fst:blocking-ok` annotation."""
+
+import time
+
+
+class Client:
+    def __init__(self, sock):
+        import threading
+
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._versions = None
+
+    def negotiate(self):
+        for _attempt in range(5):
+            try:
+                versions = self._probe()
+            except OSError:
+                time.sleep(0.02)  # lock not held: others proceed
+                continue
+            with self._lock:
+                self._versions = versions
+            return versions
+        return None
+
+    def _probe(self):
+        # called with the lock released; only the result is stored
+        # under it
+        return self._sock.recv(4)
+
+    def close_grace(self):
+        with self._lock:
+            # fst:blocking-ok constant 10ms teardown grace so in-flight frames flush; close() callers already serialize on this lock by design
+            time.sleep(0.01)

@@ -1,0 +1,22 @@
+"""Known-bad FST204: check-then-act on a lock-guarded attribute from
+outside the lock — the emptiness check can be stale by the time the
+pop lands (classic TOCTOU against the class's own lock discipline)."""
+
+
+class Ring:
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def pop_if_any(self):
+        # BAD: `_items` is guarded by _lock in push(), but this test
+        # and the mutation it gates hold no lock
+        if self._items:
+            return self._items.pop()
+        return None

@@ -1,0 +1,20 @@
+"""Corrected twin of fst204_checkact_bad: the lock is held across the
+test AND the act, so the decision cannot go stale."""
+
+
+class Ring:
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def pop_if_any(self):
+        with self._lock:
+            if self._items:
+                return self._items.pop()
+        return None

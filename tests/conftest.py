@@ -131,6 +131,36 @@ def _hotloop_transfer_guard(request, monkeypatch):
     yield
 
 
+# Run-loop ownership guard (runtime/executor.py
+# RUNLOOP_OWNERSHIP_GUARD): the dynamic half of the fstrace FST201
+# invariant. In the control-plane / service / fault lanes — exactly
+# the suites where the REST thread, supervisor restarts, and control
+# events interleave with the run loop — every state-mutating control
+# entry point asserts it runs on the stamped run-loop thread, so the
+# invariant the linter proves statically is also EXECUTED by the
+# tests (tests/test_control_plane.py injects a deliberate off-thread
+# mutation and expects OwnershipViolation).
+_OWNERSHIP_GUARD_FILES = {
+    "test_control_plane.py",
+    "test_control_e2e.py",
+    "test_app.py",
+    "test_faults.py",
+    "test_prober.py",
+}
+
+
+@pytest.fixture(autouse=True)
+def _runloop_ownership_guard(request, monkeypatch):
+    fname = os.path.basename(str(request.node.fspath))
+    if _TPU_SMOKE or fname not in _OWNERSHIP_GUARD_FILES:
+        yield
+        return
+    from flink_siddhi_tpu.runtime import executor as _executor
+
+    monkeypatch.setattr(_executor, "RUNLOOP_OWNERSHIP_GUARD", True)
+    yield
+
+
 def pytest_collection_modifyitems(config, items):
     import pytest as _pytest
 

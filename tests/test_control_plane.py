@@ -126,6 +126,64 @@ def test_admit_stack_join_retire_reclaim_slot():
     assert st["counters"]["stack_join"] == 2
 
 
+def test_ownership_guard_catches_off_thread_mutation():
+    """The dynamic half of fstrace FST201 (docs/static_analysis.md):
+    conftest flips RUNLOOP_OWNERSHIP_GUARD for this file, the first
+    run_cycle stamps this thread as the run-loop owner, and a DIRECT
+    Job mutation from another thread must raise OwnershipViolation —
+    while the same intent routed through the control queue (the
+    documented contract) applies cleanly at the next boundary."""
+    import threading
+
+    from flink_siddhi_tpu.runtime import executor as executor_mod
+    from flink_siddhi_tpu.runtime.executor import OwnershipViolation
+
+    assert executor_mod.RUNLOOP_OWNERSHIP_GUARD  # conftest lane flip
+    src = CallbackSource("S", SCHEMA)
+    ctrl = ControlQueueSource()
+    job = make_job(src, ctrl)
+    plane = ControlPlane(job, ctrl, gate=AdmissionGate(compiler))
+    plane.admit(chain_cql(1, 2), plan_id="q1")
+    feed(src, 0, 8)
+    job.run_cycle()  # stamps the run-loop owner = this thread
+    assert job.results("out") == [(1001, 1002), (1005, 1006)]
+
+    caught: list = []
+
+    def rogue():
+        try:
+            job.set_plan_enabled("q1", False)  # bypasses the queue
+        except OwnershipViolation as e:
+            caught.append(e)
+
+    t = threading.Thread(target=rogue)
+    t.start()
+    t.join()
+    assert len(caught) == 1
+    msg = str(caught[0])
+    assert "owns Job state" in msg and "control event" in msg
+    # the rogue write never landed: q1 still emits
+    feed(src, 8, 12)
+    job.run_cycle()
+    assert job.results("out")[-1] == (1009, 1010)
+
+    # the sanctioned route from the same foreign thread: push a
+    # disable CONTROL EVENT (plane.set_enabled), applied by the run
+    # loop at the next micro-batch boundary
+    t2 = threading.Thread(
+        target=plane.set_enabled, args=("q1", False)
+    )
+    t2.start()
+    t2.join()
+    feed(src, 12, 20)
+    n_before = len(job.results("out"))
+    job.run_cycle()
+    assert len(job.results("out")) == n_before  # disabled, no new rows
+
+    # and the owner itself keeps full mutation rights
+    job.set_plan_enabled("q1", True)
+
+
 def test_aot_cache_hit_on_constants_variant_readmit():
     """The acceptance criterion: after full retire drops the group
     host, re-admitting a constants-only variant re-forms it from the

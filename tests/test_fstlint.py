@@ -16,14 +16,22 @@ from flink_siddhi_tpu.analysis.baseline import (
 from flink_siddhi_tpu.analysis.findings import RULES, Finding
 from flink_siddhi_tpu.analysis.fstlint import REPO_ROOT, lint_paths, main
 from flink_siddhi_tpu.analysis.rules import lint_module
+from flink_siddhi_tpu.analysis.threads import analyze_sources
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "analysis_fixtures")
 
 
 def _lint_fixture(name):
+    """BOTH passes — the per-module FST1xx rules and the fstrace
+    FST2xx thread pass — over one fixture, so every bad fixture is
+    checked quiet against EVERY other rule, not just its own
+    family's."""
     path = os.path.join(FIXTURES, name)
     with open(path) as fh:
-        return lint_module(fh.read(), name)
+        src = fh.read()
+    return sorted(
+        set(lint_module(src, name) + analyze_sources({name: src}))
+    )
 
 
 # rule -> (bad fixture, expected finding count on it)
@@ -34,6 +42,11 @@ CASES = {
     "FST104": ("fst104_tracer_leak", 2),
     "FST105": ("fst105_retrace", 2),
     "FST106": ("fst106_checkpoint", 2),  # PR 10 reconstruction
+    # fstrace (analysis/threads.py): thread ownership & lock discipline
+    "FST201": ("fst201_offthread", 2),  # PR 12 contract, enforced
+    "FST202": ("fst202_shared", 2),
+    "FST203": ("fst203_lock_sleep", 2),  # PR 7 backoff-under-lock
+    "FST204": ("fst204_checkact", 1),
 }
 
 

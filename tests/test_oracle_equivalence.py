@@ -504,3 +504,76 @@ def test_cross_ref_to_skipped_optional_never_matches():
     )
     job2.run()
     assert job2.results("o") == [(9.0, 5.0)]
+
+
+# --------------------------------------------------------------------------
+# Sequence absence before a QUANTIFIED element (`A, not B, C+` /
+# `A, not B, C<m:n>`): the count-conditional entry guard vs the
+# measured-baseline per-event interpreter (baseline/interp.py
+# _Sequence) — the ROADMAP carried item's done-condition.
+# --------------------------------------------------------------------------
+
+def _run_vs_baseline_interp(cql, ids, prices, batch):
+    """Engine rows vs BaselineEngine rows on the identical stream."""
+    from flink_siddhi_tpu.baseline import BaselineEngine
+
+    n = len(ids)
+    ts = (1000 + np.arange(n) * 3).tolist()
+    schema = PRICE_SCHEMA
+    batches = make_batches(
+        schema,
+        {
+            "id": (ids, np.int32),
+            "price": (prices, np.float64),
+            "timestamp": (ts, np.int64),
+        },
+        ts, batch,
+    )
+    plan = compile_plan(cql, {"S": schema})
+    job = Job(
+        [plan], [BatchSource("S", schema, iter(batches))],
+        batch_size=batch, time_mode="processing",
+    )
+    job.run()
+    base = BaselineEngine(cql, ["id", "price", "timestamp"])
+    base_rows = []
+    base._emit = lambda out, t, row: base_rows.append(row)
+    base.run_columns(
+        {"id": ids, "price": prices, "timestamp": ts}, ts
+    )
+    assert sorted(job.results("m")) == sorted(
+        base_rows
+    )
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_sequence_absence_plus_vs_baseline_interp(seed):
+    """`A, not B, C+, D`: the guard vetoes only C's ENTRY event; later
+    absorbed C's may match B freely (count-conditional placement)."""
+    rng = np.random.default_rng(seed)
+    n = 500
+    ids = rng.integers(0, 5, n).tolist()
+    prices = rng.uniform(0.0, 100.0, n).round(1).tolist()
+    cql = (
+        "from every s1 = S[id == 1], not S[price > 50.0], "
+        "s3 = S[id == 3]+ , s4 = S[id == 4] "
+        "select s1.timestamp as t1, s3.timestamp as t3, "
+        "s4.timestamp as t4 insert into m"
+    )
+    _run_vs_baseline_interp(cql, ids, prices, batch=64)
+
+
+def test_sequence_absence_bounded_vs_baseline_interp():
+    """`A, not B, C<2:4>`: entry guard + bounded greedy absorb, with
+    completion on both the count-4 absorb and the break paths."""
+    rng = np.random.default_rng(13)
+    n = 500
+    # denser C's so <2:4> runs of every length actually occur
+    ids = rng.choice([0, 1, 3, 3], size=n).tolist()
+    prices = rng.uniform(0.0, 100.0, n).round(1).tolist()
+    cql = (
+        "from every s1 = S[id == 1], not S[price > 50.0], "
+        "s3 = S[id == 3]<2:4> "
+        "select s1.timestamp as t1, s3.timestamp as t3 insert into m"
+    )
+    _run_vs_baseline_interp(cql, ids, prices, batch=64)

@@ -134,6 +134,52 @@ def test_guard_on_undeclared_element_rejected():
     assert any(i.rule == "PLC203" for i in issues)
 
 
+def test_entry_guard_placement_pinned():
+    """PLC203 extension: a first-occurrence entry guard (sequence
+    absence folded before a QUANTIFIED element, `A, not B, C+`) may sit
+    only on a quantified, non-negated, non-first element with a
+    mandatory first occurrence (min >= 1)."""
+    base = dict(
+        name="q",
+        n_elements=2,
+        positive=(0, 1),
+        guards=((), ()),
+        t_guard=None,
+        negated=(False, False),
+        quantifiers=((1, 1), (1, -1)),
+        entry_guards=(1,),
+    )
+    issues = []
+    _check_one_nfa("p", base, issues)
+    assert issues == []  # the compiled `A, not B, C+` shape
+    for patch in (
+        {"entry_guards": (0,)},  # nothing precedes element 0
+        {"entry_guards": (5,)},  # out of range
+        {"quantifiers": ((1, 1), (1, 1))},  # unquantified: wrong fold
+        {"quantifiers": ((1, 1), (0, -1))},  # min-0: skip bypasses it
+    ):
+        issues = []
+        _check_one_nfa("p", {**base, **patch}, issues)
+        assert any(i.rule == "PLC203" for i in issues), patch
+
+
+def test_sequence_entry_guard_compiles_and_verifies():
+    """The real compiled `A, not B, C+` plan carries its entry guard in
+    check info (on the quantified element) and verifies clean."""
+    plan = compile_plan(
+        "from every s1 = S[id == 1], not S[price > 50.0], "
+        "s3 = S[id == 3]+ , s4 = S[id == 4] "
+        "select s1.timestamp as t1, s4.timestamp as t4 insert into m",
+        zoo_schemas(),
+        plan_id="seq-entry-guard",
+    )
+    (info,) = plan.artifacts[0].nfa_check_info()
+    # rewrite drops the 'not' element: A, C+(guarded), D
+    assert tuple(info["entry_guards"]) == (1,)
+    assert tuple(info["quantifiers"])[1] == (1, -1)
+    assert verify_plan(plan, trace=True) == []
+
+
 def test_unreachable_element_rejected():
     issues = []
     _check_one_nfa(

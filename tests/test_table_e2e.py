@@ -128,6 +128,48 @@ def test_select_from_table_rejected():
         )
 
 
+def test_table_table_join_permanently_rejected_with_citation():
+    """The ROADMAP carried item is CLOSED as a permanent rejection
+    (docs/static_analysis.md "Decided non-features"): a join needs a
+    stream side to trigger on, and siddhi-core itself rejects
+    static-static joins. Pin the citation so the rejection stays loud
+    and sourced — siddhi-core 4.2.40 JoinInputStreamParser by
+    class+method."""
+    from flink_siddhi_tpu.query.lexer import SiddhiQLError
+
+    with pytest.raises(SiddhiQLError) as ei:
+        run(
+            [Event(1, 0, 1.0, 1000)],
+            "define table T (tid int); define table U (uid int);"
+            "from T join U on T.tid == U.uid "
+            "select T.tid insert into out",
+        )
+    msg = str(ei.value)
+    assert "table-table joins are not supported" in msg
+    assert "siddhi-core 4.2.40" in msg
+    assert "JoinInputStreamParser.parseInputStream" in msg
+
+
+def test_table_preserving_outer_join_permanently_rejected():
+    """Same decision for the outer-join twin: a table has no arrival
+    events to emit unmatched rows on, and only STREAM/WINDOW sides can
+    trigger in siddhi-core (JoinInputStreamParser
+    .populateJoinProcessors)."""
+    from flink_siddhi_tpu.query.lexer import SiddhiQLError
+
+    with pytest.raises(SiddhiQLError) as ei:
+        run(
+            [Event(1, 0, 1.0, 1000)],
+            "define table T (tid int, tprice double);"
+            "from S[kind == 1] right outer join T on S.id == T.tid "
+            "select S.id insert into out",
+        )
+    msg = str(ei.value)
+    assert "outer join preserving the table side is not supported" in msg
+    assert "siddhi-core 4.2.40" in msg
+    assert "JoinInputStreamParser" in msg
+
+
 def test_aggregated_table_insert_and_windowed_insert():
     """VERDICT #10: windows/aggregations in table writes."""
     import numpy as np
