@@ -5,7 +5,7 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line (``schema_version: 7``). One invocation measures
+Prints ONE JSON line (``schema_version: 8``). One invocation measures
 THREE execution modes and emits all of them in the same document, so a
 regression in any path stays a tracked number:
 
@@ -105,6 +105,15 @@ gated == 0, and the stack-join / AOT-executable-cache counters
 showing admits are data updates and the first-compile cost is paid
 once per shape class (docs/control_plane.md). ``--control`` scales
 to O(100s) of concurrent queries (BENCH_CONTROL_QUERIES overrides).
+
+Schema v8 (per-tenant observability round) adds the ``attribution``
+block inside ``control``: per-plan row counts from the scoped metric
+groups (gated: they must CONSERVE — sum exactly to the job-level
+emitted total), each plan's tenant, and the admitted-vs-measured
+footprint meter per runtime (gated: measured bytes positive, and at
+least one runtime carrying a finite utilization against its
+admission-time ADM101/102 prediction). docs/observability.md has the
+model.
 
 ``--fault`` (composable with ``--dryrun``): appends a ``recovery``
 block — a supervised run (runtime/supervisor.py) under a seeded crash
@@ -1268,6 +1277,11 @@ def _control_block(dryrun, full=False):
     # are refused at apply time by rule id
     job.admission_budgets = STRICT_BUDGETS
     plane = ControlPlane(job, ctrl)
+    # a consumer on the shared output stream: drains then DECODE (the
+    # dynamic group's per-slot split), so the v8 attribution block's
+    # per-plan row counts are exact per member, not representative-only
+    sink = _CountingColumnarSink()
+    job.add_sink("out", sink)
 
     def cycles(n, hist=None):
         for _ in range(n):
@@ -1278,7 +1292,7 @@ def _control_block(dryrun, full=False):
 
     # warmup: first admit compiles the shape class's executables (the
     # one first-compile the whole block exists to amortize)
-    plane.admit(tenant_cql(0), plan_id="q0")
+    plane.admit(tenant_cql(0), plan_id="q0", tenant="tenant0")
     cycles(4)
 
     base_hist = LatencyHistogram()
@@ -1290,11 +1304,22 @@ def _control_block(dryrun, full=False):
     want = {f"q{q}" for q in range(n_queries)}
     t_admit0 = time.perf_counter()
     for q in range(1, n_queries):
-        plane.admit(tenant_cql(q), plan_id=f"q{q}")
+        plane.admit(
+            tenant_cql(q), plan_id=f"q{q}", tenant=f"tenant{q % 4}"
+        )
+    # one standalone (non-foldable) tenant query: its runtime carries
+    # its OWN admission-predicted footprint, so the v8 attribution
+    # block has an admitted-vs-measured utilization to gate on (group
+    # hosts publish measured bytes only — shared padded state)
+    plane.admit(
+        f"from S[id == {n_ids - 1}] select id, price "
+        "insert into flatout",
+        plan_id="flat", tenant="tenant0",
+    )
     hostile_id = plane.admit(
         "from every s1 = S[id == 1] -> s2 = S[id == 2] "
         "select s1.price as p1, s2.price as p2 insert into out",
-        plan_id="hostile",
+        plan_id="hostile", tenant="mallory",
     )
     admit_wall = None
     for _ in range(200):
@@ -1360,8 +1385,16 @@ def _control_block(dryrun, full=False):
             for k, v in job.aot_cache.stats().items()
             if k in ("hits", "misses", "evictions", "entries")
         },
+        "attribution": _attribution_block(job),
         "dryrun": bool(dryrun and not full),
     }
+    if not block["attribution"]["conserved"]:
+        print(
+            "ATTRIBUTION NOT CONSERVED: per-plan scoped rows "
+            f"{block['attribution']['plans']} do not sum to the "
+            f"job total {block['attribution']['rows_emitted_total']}",
+            file=sys.stderr,
+        )
     if dropped != 0:
         print(
             f"CONTROL BLOCK DROPPED EVENTS: served {src.served}, "
@@ -1371,6 +1404,39 @@ def _control_block(dryrun, full=False):
             file=sys.stderr,
         )
     return block
+
+
+def _attribution_block(job):
+    """Schema v8: the per-plan/per-tenant attribution claims of one
+    live job (runtime/executor.py scoped metric groups). Two gated
+    invariants ride here: per-plan ``rows_emitted`` scopes must sum
+    EXACTLY to the job-level emitted total (late side-channels
+    excluded — they attribute to input streams, not plans), and the
+    footprint meter must carry at least one finite admitted-vs-
+    measured utilization (docs/observability.md)."""
+    from flink_siddhi_tpu.runtime.executor import LATE_STREAM_SUFFIX
+
+    plans = {}
+    for pid, reg in job.telemetry.scope_map("plan").items():
+        if pid.startswith("@dyn:"):
+            continue  # shared host scopes carry no emitted rows
+        plans[pid] = {
+            "tenant": job.tenant_of(pid),
+            "rows_emitted": int(reg.counter_value("rows_emitted")),
+            "matches": int(reg.counter_value("matches")),
+        }
+    total = sum(
+        int(n)
+        for sid, n in job.emitted_counts.items()
+        if not sid.endswith(LATE_STREAM_SUFFIX)
+    )
+    attributed = sum(p["rows_emitted"] for p in plans.values())
+    return {
+        "plans": plans,
+        "rows_emitted_total": int(total),
+        "conserved": attributed == total,
+        "footprint": job.footprint_status(),
+    }
 
 
 def main():
@@ -1458,7 +1524,7 @@ def main():
         # provenance: which denominator vs_baseline divides by (ADVICE
         # r4: the JSON line should be self-describing off this machine)
         "baseline_source": "pinned-measurement (BASELINE.md)",
-        "schema_version": 7,
+        "schema_version": 8,
         "modes": modes,
     }
     if set(want_modes) != {"resident", "streaming", "sink"}:

@@ -10,6 +10,11 @@ reference: MetadataControlEvent / OperationControlEvent).
 
 Routes (JSON in/out):
     GET    /api/v1/metrics               -> Job.metrics() snapshot
+    GET    /api/v1/metrics/prometheus    -> the same snapshot rendered
+                                           as Prometheus text format
+                                           (plan/tenant labels on the
+                                           scoped series; telemetry/
+                                           openmetrics.py)
     GET    /api/v1/traces                -> per-event trace sampling view
     GET    /api/v1/health                -> supervisor liveness: alive +
                                            last-checkpoint age + restart
@@ -18,9 +23,12 @@ Routes (JSON in/out):
                                            is exhausted) + the control-
                                            plane counters/cache/refusal
                                            block (job.control_status())
-    GET    /api/v1/queries               -> {"queries": [plan ids]}
+    GET    /api/v1/queries               -> {"queries": [{id, tenant,
+                                           enabled, folded}]} — the
+                                           whole fleet in ONE poll
     GET    /api/v1/queries/<id>          -> per-query status: enabled,
-                                           fold host/slot, or the
+                                           tenant, fold host/slot and
+                                           live scoped metrics, or the
                                            recorded refusal (rule ids)
     POST   /api/v1/queries   {"cql": s,
                               "tenant"?} -> {"id": plan_id,
@@ -145,6 +153,16 @@ class QueryControlService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(
+                self, code: int, text: str, content_type: str
+            ) -> None:
+                body = text.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def _body(self) -> dict:
                 n = int(self.headers.get("Content-Length") or 0)
                 if not n:
@@ -204,6 +222,19 @@ class QueryControlService:
                     return self._reply(
                         200, {"alive": True, "supervised": False}
                     )
+                if parts == ["api", "v1", "metrics", "prometheus"]:
+                    # OpenMetrics exposition (docs/observability.md):
+                    # the scraping story without a bespoke JSON client.
+                    # Same host-side snapshot as /metrics below.
+                    from ..telemetry.openmetrics import CONTENT_TYPE
+
+                    if service.job is None:
+                        return self._reply_text(
+                            200, "# no job attached\n", CONTENT_TYPE
+                        )
+                    return self._reply_text(
+                        200, service.job.openmetrics(), CONTENT_TYPE
+                    )
                 if parts == ["api", "v1", "metrics"]:
                     if service.job is None:
                         return self._reply(200, {})
@@ -237,12 +268,15 @@ class QueryControlService:
                     )
                 if tail:
                     return self._reply(404, {"error": "not found"})
-                ids = (
-                    service.job.plan_ids
+                # one poll shows the whole fleet: id + tenant + enabled
+                # + fold host/slot per entry (previously bare ids, so
+                # fleet state took N+1 requests)
+                listing = (
+                    service.job.query_listing()
                     if service.job is not None
                     else []
                 )
-                self._reply(200, {"queries": ids})
+                self._reply(200, {"queries": _json_safe(listing)})
 
             # fst:thread-root name=service
             def do_POST(self):
@@ -364,18 +398,24 @@ class QueryControlService:
             return 200, {
                 "id": plan_id,
                 "state": "live",
+                "tenant": job.tenant_of(plan_id),
                 "enabled": bool(
                     job._folded_enabled.get(plan_id, True)
                 ),
                 "folded": {"host": host, "slot": int(slot)},
+                # live scoped metrics: rows/matches/drain legs and the
+                # shared host's footprint (docs/observability.md)
+                "metrics": _json_safe(job.plan_metrics(plan_id)),
             }
         rt = job._plans.get(plan_id)
         if rt is not None:
             return 200, {
                 "id": plan_id,
                 "state": "live",
+                "tenant": job.tenant_of(plan_id),
                 "enabled": bool(rt.enabled),
                 "folded": None,
+                "metrics": _json_safe(job.plan_metrics(plan_id)),
             }
         rej = job.control_rejections.get(plan_id)
         if rej is not None:

@@ -223,6 +223,9 @@ class ShardedJob(Job):
             acc=init_acc(),
         )
         self._routers[plan.plan_id] = Router(self.n_shards, parts)
+        # per-plan emission attribution (Job._attr_scope reads the
+        # stamp on the drain-decode path)
+        self._stamp_attribution(plan)
 
     def remove_plan(self, plan_id: str) -> None:
         super().remove_plan(plan_id)
@@ -348,6 +351,9 @@ class ShardedJob(Job):
     def _drain_plan_body(self, rt: _PlanRuntime) -> None:
         if rt.acc is None or not rt.plan.artifacts:
             return
+        # footprint meter poll (same drain-boundary contract as Job):
+        # leaf nbytes sums whole stacked shards — metadata only
+        self._update_footprint(rt)
         t_dirty = rt.dirty_since
         rt.acc_dirty = False
         rt.dirty_since = None
@@ -416,6 +422,14 @@ class ShardedJob(Job):
                             self._epoch_ms or 0, rows,
                             hist=shard_trace[s],
                         )
+                    if tel.enabled:
+                        # pre-rate-limit match attribution, summed
+                        # across shards (same scope the single-device
+                        # drain records into — the merged cross-shard
+                        # view falls out of one registry)
+                        sc = self._attr_scope(schema)
+                        if sc is not None:
+                            sc.inc("matches", len(rows))
                     per_schema.setdefault(
                         schema.stream_id, (schema, [])
                     )[1].append(rows)
@@ -440,13 +454,16 @@ class ShardedJob(Job):
             # so the metric is comparable across job kinds
             now = time.monotonic()
             tel.record_seconds("drain.total", now - t_req)
+            stale = None
             if t_dirty is not None and self._has_consumers(rt):
                 # same contract as Job: age of the oldest undrained
                 # match when its drain completed — consumer-visible
                 # drains only (capacity swaps of unobserved plans are
                 # not the scheduler's report card)
-                tel.record_seconds("drain.staleness", now - t_dirty)
+                stale = now - t_dirty
+                tel.record_seconds("drain.staleness", stale)
             tel.inc("drains.completed")
+            self._scoped_drain_record(rt, now - t_req, stale)
 
     def flush(self) -> None:
         for rt in self._plans.values():

@@ -144,6 +144,14 @@ def snapshot_job(job) -> Dict[str, Any]:
             "cql": dict(getattr(job, "_dynamic_cql", {})),
             "folded": dict(getattr(job, "_folded", {})),
             "enabled": dict(getattr(job, "_folded_enabled", {})),
+            # per-tenant attribution + footprint-meter denominators
+            # (docs/observability.md): a restored job keeps reporting
+            # each plan under its tenant, with the admitted bytes its
+            # utilization gauge compares against
+            "tenants": dict(getattr(job, "_plan_tenant", {})),
+            "admitted_bytes": dict(
+                getattr(job, "_plan_admitted_bytes", {})
+            ),
         },
         # output-rate limiter phase: events-mode chunk position and the
         # buffered rows survive a restart, so a restored job emits at
@@ -194,8 +202,15 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
         job.late_dropped = int(evt.get("late_dropped", 0))
 
     # dynamically-added queries: replay them (same runtimes, same group
-    # slots) BEFORE the plan-set compatibility check below
+    # slots) BEFORE the plan-set compatibility check below. Tenant
+    # attribution restores FIRST so the replayed adds' cache/stack
+    # counters land in the right tenant scopes (backward-compatible:
+    # absent in pre-observability checkpoints)
     dyn = snap.get("dynamic") or {}
+    job._plan_tenant.update(dyn.get("tenants") or {})
+    job._plan_admitted_bytes.update(
+        {k: int(v) for k, v in (dyn.get("admitted_bytes") or {}).items()}
+    )
     if dyn.get("cql"):
         if job._plan_compiler is None:
             raise ValueError(

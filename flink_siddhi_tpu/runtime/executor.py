@@ -585,6 +585,21 @@ class Job:
         self.control_rejections: Dict[str, dict] = {}
         self._rejections_lock = threading.Lock()
         self.MAX_REJECTIONS_KEPT = 64
+        # -- per-tenant observability (docs/observability.md) -----------
+        # plan id -> tenant (from the control event that admitted it;
+        # absent = the "default" tenant). Scoped metric attribution and
+        # the metrics()["tenants"] rollup key off it.
+        # fst:threadsafe single-writer (run loop apply path); off-thread status/metrics readers use GIL-atomic get()/dict() snapshots only
+        self._plan_tenant: Dict[str, str] = {}
+        # plan id -> admission-predicted worst-case device bytes
+        # (state + accumulator, analysis/admit.py ADM101/102): the
+        # denominator of the footprint meter's utilization gauge. Set
+        # from carried admission summaries, from apply-time analysis,
+        # or explicitly via set_admitted_footprint() for static jobs.
+        # fst:threadsafe single-writer (run loop / pre-run setup); the footprint meter reads GIL-atomic get()
+        self._plan_admitted_bytes: Dict[str, int] = {}
+        # fst:ephemeral warning rate-limit clock (monotonic); the footprint.overruns counters stay exact
+        self._footprint_warned_at = -1e9
         # output rate limiting: stream_id -> limiter (from plan
         # ``output ... every ...`` clauses, applied at emission)
         self._rate_limiters: Dict[str, _OutputRateLimiter] = {}
@@ -794,6 +809,10 @@ class Job:
         CQL; the control-event path records it automatically)."""
         self._assert_runloop_owner("add_plan")
         admit0 = None
+        # tenant attribution: the control path records the event's
+        # tenant before calling add_plan, so admits/stack-joins/cache
+        # traffic count into that tenant's scope too
+        tenant = self.tenant_of(plan.plan_id) if dynamic else None
         if dynamic:
             if plan.plan_id in self._folded or plan.plan_id in self._plans:
                 # re-add of a live id (e.g. an at-least-once control
@@ -806,10 +825,15 @@ class Job:
                 # admit: no runtime, no compile, no cache traffic
                 self._inc_control("control.admitted")
                 self._inc_control("control.stack_join")
+                self._inc_tenant(tenant, "control.admitted")
+                self._inc_tenant(tenant, "control.stack_join")
                 return
             plan, admit0 = self._wrap_dynamic(plan)
             self._inc_control("control.admitted")
-        self._create_runtime(plan, admit0, cacheable=dynamic)
+            self._inc_tenant(tenant, "control.admitted")
+        self._create_runtime(
+            plan, admit0, cacheable=dynamic, tenant=tenant
+        )
 
     def _inc_control(self, name: str, n: int = 1) -> None:
         """Control-plane counters, safe during __init__ (the registry
@@ -818,8 +842,166 @@ class Job:
         if tel is not None:
             tel.inc(name, n)
 
+    # -- per-tenant / per-plan scoped attribution ---------------------------
+    def tenant_of(self, plan_id: str) -> str:
+        """The tenant a plan is attributed to ('default' when it was
+        admitted without one — static plans, untenanted control adds)."""
+        return self._plan_tenant.get(plan_id) or "default"
+
+    def _inc_tenant(self, tenant: Optional[str], name: str,
+                    n: int = 1) -> None:
+        """Tenant-scoped counter twin of _inc_control (safe pre-registry
+        for the same __init__ reason)."""
+        tel = getattr(self, "telemetry", None)
+        if tel is not None and tel.enabled:
+            tel.scope("tenant", tenant or "default").inc(name, n)
+
+    def _stamp_attribution(self, plan: CompiledPlan) -> None:
+        """Stamp every output schema of ``plan`` with the plan id its
+        rows attribute to. Emission-path attribution reads the stamp
+        (``_attr_scope``), so per-plan row counts are exact even when
+        many plans insert into the SAME output stream: a dynamic chain
+        group's per-slot decode carries each MEMBER's own schema
+        object, stamped with the member's id below."""
+        for schemas in plan.output_streams().values():
+            for sch in schemas:
+                sch.plan_attr = plan.plan_id
+        from ..compiler.nfa import DynamicChainGroup
+
+        for a in plan.artifacts:
+            if isinstance(a, DynamicChainGroup):
+                for m in a.members:
+                    if m is not None:
+                        m[1].plan_attr = m[0]
+
+    def _attr_scope(self, schema):
+        """The plan scope a schema's rows attribute to (None for
+        unstamped schemas — e.g. hand-built test artifacts)."""
+        pid = getattr(schema, "plan_attr", None)
+        if pid is None:
+            return None
+        return self.telemetry.scope("plan", pid)
+
+    def _scope_plans_of(self, rt: _PlanRuntime) -> List[str]:
+        """USER plan ids a runtime serves: itself for a standalone
+        plan, every live member for a dynamic-group host. Shared drain
+        legs (total/staleness) record into EACH member's scope — every
+        member's matches waited through that drain, so per-plan drain
+        latency is each member's truth, while tenant rollups merging
+        them see the shared drain once per member (documented)."""
+        pid = rt.plan.plan_id
+        if not pid.startswith("@dyn:"):
+            return [pid]
+        from ..compiler.nfa import DynamicChainGroup
+
+        arts = rt.plan.artifacts
+        if arts and isinstance(arts[0], DynamicChainGroup):
+            return [m[0] for m in arts[0].members if m is not None]
+        return [pid]
+
+    def _scoped_drain_record(
+        self, rt: _PlanRuntime, total_s: float,
+        staleness_s: Optional[float],
+    ) -> None:
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        for pid in self._scope_plans_of(rt):
+            sc = tel.scope("plan", pid)
+            sc.record_seconds("drain.total", total_s)
+            if staleness_s is not None:
+                sc.record_seconds("drain.staleness", staleness_s)
+
+    # -- admitted-vs-measured footprint meter -------------------------------
+    def set_admitted_footprint(self, plan_id: str, nbytes: int) -> None:
+        """Record the admission-predicted worst-case device bytes
+        (state + accumulator) for a plan — the meter denominator. The
+        control path records this automatically from admission
+        summaries; static jobs (and tests) set it explicitly from
+        ``analysis.admit.analyze_plan(plan, deep=True)``."""
+        self._plan_admitted_bytes[plan_id] = int(nbytes)
+
+    @staticmethod
+    def _tree_live_nbytes(tree) -> int:
+        """Sum of leaf nbytes — shape/dtype METADATA only, no host
+        sync, no transfer (jax.Array.nbytes reads the aval), so the
+        meter is legal inside the guarded hot loop (FST102 /
+        HOTLOOP_TRANSFER_GUARD)."""
+        total = 0
+        for leaf in jax.tree.leaves(tree):
+            nb = getattr(leaf, "nbytes", None)
+            if nb is not None:
+                total += int(nb)
+        return total
+
+    def _update_footprint(self, rt: _PlanRuntime) -> None:
+        """Measure the runtime's LIVE device bytes (states + output
+        accumulator) against the admission-time prediction. Polled at
+        drain/checkpoint boundaries only — never per batch. Publishes
+        ``footprint.measured_bytes`` (always), and for runtimes with a
+        recorded admission prediction ``footprint.admitted_bytes``, a
+        ``footprint.utilization`` gauge, and the loud
+        ``footprint.overruns`` counter when measured exceeds admitted —
+        a live soundness monitor on the admission analyzer. Dynamic
+        group HOSTS publish measured bytes only: member predictions
+        price a standalone query, while the padded group's device
+        reality is capacity-sized shared state (docs/observability.md).
+        """
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        measured = self._tree_live_nbytes(rt.states)
+        if rt.acc is not None:
+            measured += self._tree_live_nbytes(rt.acc)
+        pid = rt.plan.plan_id
+        sc = tel.scope("plan", pid)
+        sc.gauge("footprint.measured_bytes", int(measured))
+        admitted = self._plan_admitted_bytes.get(pid)
+        if admitted is None or admitted <= 0:
+            return
+        sc.gauge("footprint.admitted_bytes", int(admitted))
+        sc.gauge(
+            "footprint.utilization", round(measured / admitted, 6)
+        )
+        if measured > admitted:
+            tel.inc("footprint.overruns")
+            sc.inc("footprint.overruns")
+            now = time.monotonic()
+            if now - self._footprint_warned_at >= 1.0:
+                self._footprint_warned_at = now
+                _LOG.warning(
+                    "%s: measured device footprint %d B exceeds the "
+                    "admitted worst-case %d B — the admission "
+                    "prediction was unsound for this plan, or its "
+                    "state grew past the admission-time shapes "
+                    "(footprint.overruns counts every over-budget "
+                    "poll; docs/observability.md has what this does "
+                    "and does not mean)",
+                    pid, measured, admitted,
+                )
+
+    def footprint_status(self) -> Dict[str, Dict[str, object]]:
+        """Last-polled footprint per runtime ({plan_id: {measured,
+        admitted?, utilization?}}); reads scope gauges only, safe
+        off-thread."""
+        out: Dict[str, Dict[str, object]] = {}
+        for pid, reg in self.telemetry.scope_map("plan").items():
+            measured = reg.gauge_value("footprint.measured_bytes")
+            if measured is None:
+                continue
+            ent: Dict[str, object] = {"measured_bytes": int(measured)}
+            admitted = reg.gauge_value("footprint.admitted_bytes")
+            if admitted is not None:
+                ent["admitted_bytes"] = int(admitted)
+                ent["utilization"] = reg.gauge_value(
+                    "footprint.utilization"
+                )
+            out[pid] = ent
+        return out
+
     def _create_runtime(
-        self, plan: CompiledPlan, admit0=None, cacheable: bool = False
+        self, plan: CompiledPlan, admit0=None, cacheable: bool = False,
+        tenant: Optional[str] = None,
     ) -> None:
         from ..compiler import pallas_ops
         from ..control.aotcache import CachedExecutables, cache_key
@@ -835,6 +1017,14 @@ class Job:
         key = cache_key(plan, capacity=self.batch_size) if cacheable \
             else None
         entry = self.aot_cache.lookup(key) if cacheable else None
+        if cacheable:
+            # tenant attribution on the AOT cache: a noisy tenant's
+            # compile churn shows in ITS scope, not only job-wide
+            self._inc_tenant(
+                tenant,
+                "control.cache_hit" if entry is not None
+                else "control.cache_miss",
+            )
         if entry is None:
             init_acc = jax.jit(plan.init_acc)
             traces = {"n": 0}
@@ -925,6 +1115,9 @@ class Job:
             None,
         )
         self._plans[plan.plan_id] = rt
+        # after admit0: a dynamic host's first member is registered by
+        # now, so its schema gets the MEMBER id stamp
+        self._stamp_attribution(plan)
         for sid, rate in plan.output_rates.items():
             self._rate_limiters[sid] = _OutputRateLimiter(
                 rate, plan.snapshot_keys.get(sid, ())
@@ -957,6 +1150,10 @@ class Job:
         rt.states = states
         self._folded[plan.plan_id] = (host_id, slot)
         self._folded_enabled[plan.plan_id] = True
+        # the member's schema object is what the group's per-slot
+        # decode will carry: stamp it with the MEMBER id so its rows
+        # attribute exactly even though every member shares one stream
+        plan.artifacts[0].output_schema.plan_attr = plan.plan_id
 
     def _try_fold(self, plan: CompiledPlan) -> bool:
         from ..compiler.nfa import DynamicChainGroup, chain_template_of
@@ -1112,6 +1309,11 @@ class Job:
         folded = self._folded.pop(plan_id, None)
         self._folded_enabled.pop(plan_id, None)
         self._dynamic_cql.pop(plan_id, None)
+        # the footprint denominator dies with the runtime (an update
+        # re-records it); tenant attribution and the plan's SCOPE
+        # persist — a retired plan's rows stay in the conservation sum
+        # and its tenant's rollup
+        self._plan_admitted_bytes.pop(plan_id, None)
         if folded is not None:
             host_id, slot = folded
             self._inc_control("control.retired")
@@ -1227,12 +1429,32 @@ class Job:
                 )
                 return True
 
+            def _note_admission(plan_id: str, plan) -> None:
+                """Tenant + admitted-footprint bookkeeping for an
+                accepted add/update: BEFORE add_plan, so the runtime's
+                cache/stack counters land in the right tenant scope and
+                the footprint meter has its denominator from the very
+                first drain. The apply-time analyzer's own prediction
+                (stamped on the compiled plan) wins over the carried
+                service-gate summary — it judged exactly what runs."""
+                if tenant is not None:
+                    self._plan_tenant[plan_id] = tenant
+                nb = getattr(plan, "_admitted_nbytes", None)
+                if nb is None:
+                    v = verdicts.get(plan_id) or {}
+                    sb, ab = v.get("state_bytes"), v.get("acc_bytes")
+                    if sb is not None and ab is not None:
+                        nb = int(sb) + int(ab)
+                if nb is not None:
+                    self._plan_admitted_bytes[plan_id] = int(nb)
+
             for plan_id, cql in ev.added_plans.items():
                 if _rejected(plan_id):
                     continue
                 plan = self._compile_admitted(plan_id, cql, tenant)
                 if plan is None:
                     continue
+                _note_admission(plan_id, plan)
                 self.add_plan(plan, dynamic=True)
                 self._dynamic_cql[plan_id] = cql
             for plan_id, cql in ev.updated_plans.items():
@@ -1242,6 +1464,7 @@ class Job:
                 if plan is None:
                     continue  # refused update: the running plan stays
                 self.remove_plan(plan_id)
+                _note_admission(plan_id, plan)
                 self.add_plan(plan, dynamic=True)
                 self._dynamic_cql[plan_id] = cql
             for plan_id in ev.deleted_plan_ids:
@@ -1284,6 +1507,15 @@ class Job:
                 )
                 rules += [i.rule for i in report.findings]
                 rendered += [i.render() for i in report.findings]
+                if (
+                    report.state_bytes is not None
+                    and report.acc_bytes is not None
+                ):
+                    # the footprint meter's denominator: what THIS
+                    # compiled plan was predicted to cost (ADM101/102)
+                    plan._admitted_nbytes = int(
+                        report.state_bytes + report.acc_bytes
+                    )
         except (PlanCheckError, AdmissionError) as e:
             # compile_plan itself verifies under FST_VERIFY_PLANS /
             # config budgets and raises — same refusal, same record
@@ -1643,6 +1875,9 @@ class Job:
         zero data transfer; see _fetch_acc)."""
         if rt.acc is None or not rt.plan.artifacts:
             return
+        # footprint meter poll: drain boundaries only, metadata-only
+        # (the FST102 hotpath rules — no host sync rides this)
+        self._update_footprint(rt)
         if not rt.acc_dirty:
             return  # provably empty: nothing to swap or fetch
         old = rt.acc
@@ -1858,6 +2093,12 @@ class Job:
                     legs["wait_ready"] + legs["fetch"],
                 )
                 tel.inc("drains.completed")
+                # plan-scoped twins of total/staleness: each plan this
+                # runtime serves waited through this drain
+                self._scoped_drain_record(
+                    rt, legs["total"],
+                    (now - t_dirty) if t_dirty is not None else None,
+                )
             for ai, a in enumerate(rt.plan.artifacts):
                 if overflow[ai] > 0:
                     _LOG.warning(
@@ -1886,6 +2127,14 @@ class Job:
 
                 for a in rt.plan.artifacts:
                     for schema, payload in decoded.get(a.name) or []:
+                        if self.telemetry.enabled:
+                            # matches = drained match rows BEFORE rate
+                            # limiting (rows_emitted is the post-limit
+                            # twin); a stacked group's per-slot decode
+                            # attributes each member exactly
+                            sc = self._attr_scope(schema)
+                            if sc is not None:
+                                sc.inc("matches", len(payload))
                         if isinstance(payload, ColumnBatch):
                             self._emit_columns(schema, payload)
                         else:
@@ -1901,6 +2150,16 @@ class Job:
                         self.emitted_counts[sch.stream_id] = (
                             self.emitted_counts.get(sch.stream_id, 0) + c
                         )
+                        if self.telemetry.enabled:
+                            # counts-only drains never fetch the data
+                            # block, so a stacked group cannot split by
+                            # slot: rows attribute to the representative
+                            # member, exactly as the stream count above
+                            # does — the conservation sum stays exact
+                            sc = self._attr_scope(sch)
+                            if sc is not None:
+                                sc.inc("rows_emitted", c)
+                                sc.inc("matches", c)
             done += 1
             if limit and done >= limit:
                 return
@@ -1929,6 +2188,13 @@ class Job:
             self.tracer.complete_rows(epoch, rows)
         sinks = self._sinks.get(sid)
         self.emitted_counts[sid] = self.emitted_counts.get(sid, 0) + len(rows)
+        if self.telemetry.enabled:
+            # per-plan attribution, at EXACTLY the site the job total
+            # counts — conservation (sum of plan scopes == job total)
+            # holds by construction (docs/observability.md)
+            sc = self._attr_scope(schema)
+            if sc is not None:
+                sc.inc("rows_emitted", len(rows))
         if not sinks:
             # retention off means off everywhere: an unbounded run must
             # not grow collected[] whether or not a sink consumes the
@@ -2000,6 +2266,11 @@ class Job:
         self.emitted_counts[sid] = (
             self.emitted_counts.get(sid, 0) + len(cb)
         )
+        if self.telemetry.enabled:
+            # same attribution contract as the row path
+            sc = self._attr_scope(schema)
+            if sc is not None:
+                sc.inc("rows_emitted", len(cb))
         sinks = self._sinks.get(sid)
         if self.retain_results:
             # the columnar gate excludes retained jobs; this defensive
@@ -2512,6 +2783,20 @@ class Job:
         n = len(batch)
         self.late_events += n
         tel = self.telemetry
+        if tel.enabled:
+            # late share, attributed where attributable: lateness is an
+            # INPUT-stream fact, so it maps to a plan only when exactly
+            # one live plan consumes the stream (a shared input's late
+            # rows stay job-level — splitting them per consumer would
+            # double count)
+            consumers = [
+                member
+                for rt in list(self._plans.values())
+                if batch.stream_id in rt.plan.spec.stream_codes
+                for member in self._scope_plans_of(rt)
+            ]
+            if len(consumers) == 1:
+                tel.scope("plan", consumers[0]).inc("late_events", n)
         if self.late_policy == "side_output":
             tel.inc("faults.late_side_output", n)
             self._emit_late(batch)
@@ -3084,15 +3369,27 @@ class Job:
             # dicts concurrently with off-thread metrics readers
             "plans": {
                 **{
-                    pid: {"enabled": rt.enabled}
+                    pid: {
+                        "enabled": rt.enabled,
+                        "tenant": self.tenant_of(pid),
+                    }
                     for pid, rt in list(self._plans.items())
                     if not pid.startswith("@dyn:")
                 },
                 **{
-                    pid: {"enabled": on}
+                    pid: {
+                        "enabled": on,
+                        "tenant": self.tenant_of(pid),
+                    }
                     for pid, on in list(self._folded_enabled.items())
                 },
             },
+            # per-tenant rollup (docs/observability.md): plan scopes
+            # merged per tenant — counters summed, histograms folded
+            # bucket-exactly via LatencyHistogram.merge
+            "tenants": self.tenant_rollup(),
+            # admitted-vs-measured footprint meter, per runtime
+            "footprint": self.footprint_status(),
             "emitted": dict(self.emitted_counts),
             "pending_batches": sum(
                 len(b) for b in list(self._pending.values())
@@ -3157,6 +3454,129 @@ class Job:
             "aot_cache": self.aot_cache.stats(),
             "rejections": rejections,
         }
+
+    def query_listing(self) -> List[Dict[str, object]]:
+        """The whole fleet in one poll (GET /api/v1/queries): id,
+        tenant, enabled state, and fold host/slot per live plan. Safe
+        off-thread — GIL-atomic snapshots only, same discipline as
+        plan_ids."""
+        out: List[Dict[str, object]] = []
+        folded = dict(self._folded)
+        folded_enabled = dict(self._folded_enabled)
+        for pid in self.plan_ids:
+            f = folded.get(pid)
+            if f is not None:
+                enabled = bool(folded_enabled.get(pid, True))
+                fold = {"host": f[0], "slot": int(f[1])}
+            else:
+                rt = self._plans.get(pid)
+                enabled = bool(rt.enabled) if rt is not None else False
+                fold = None
+            out.append(
+                {
+                    "id": pid,
+                    "tenant": self.tenant_of(pid),
+                    "enabled": enabled,
+                    "folded": fold,
+                }
+            )
+        return out
+
+    def plan_metrics(self, plan_id: str) -> Dict[str, object]:
+        """One plan's scoped metrics (GET /api/v1/queries/<id>):
+        counters/gauges/histograms of its scope, plus — for a folded
+        member — the shared host's footprint (the member's state lives
+        inside the host's padded group). Safe off-thread."""
+        scopes = self.telemetry.scope_map("plan")
+        reg = scopes.get(plan_id)
+        out: Dict[str, object] = {}
+        if reg is not None:
+            snap = reg.snapshot()
+            out = {
+                "counters": snap.get("counters", {}),
+                "gauges": snap.get("gauges", {}),
+                "histograms": snap.get("histograms", {}),
+            }
+        f = self._folded.get(plan_id)
+        if f is not None:
+            host = scopes.get(f[0])
+            if host is not None:
+                measured = host.gauge_value("footprint.measured_bytes")
+                if measured is not None:
+                    out["host_footprint"] = {
+                        "host": f[0],
+                        "measured_bytes": int(measured),
+                    }
+        return out
+
+    def tenant_rollup(self) -> Dict[str, Dict[str, object]]:
+        """metrics()["tenants"]: every tenant's plan scopes rolled up —
+        counters summed exactly, drain histograms folded with
+        ``LatencyHistogram.merge`` (the same associative primitive the
+        sharded decode fold uses), plus the tenant scope's own
+        control-path counters (cache traffic, stack joins). Dynamic
+        group hosts (shared device state) are excluded; their drain
+        legs were already recorded into each member's scope. Safe
+        off-thread."""
+        reg = self.telemetry
+        by_tenant: Dict[str, List[str]] = {}
+        plan_scopes = reg.scope_map("plan")
+        for pid in plan_scopes:
+            if pid.startswith("@dyn:"):
+                continue
+            by_tenant.setdefault(self.tenant_of(pid), []).append(pid)
+        for pid in self.plan_ids:  # live but not-yet-scoped plans
+            ids = by_tenant.setdefault(self.tenant_of(pid), [])
+            if pid not in ids:
+                ids.append(pid)
+        tenant_scopes = reg.scope_map("tenant")
+        out: Dict[str, Dict[str, object]] = {}
+        for tenant, pids in sorted(by_tenant.items()):
+            rows = matches = late = 0
+            for pid in pids:
+                sreg = plan_scopes.get(pid)
+                if sreg is None:
+                    continue
+                rows += sreg.counter_value("rows_emitted")
+                matches += sreg.counter_value("matches")
+                late += sreg.counter_value("late_events")
+            drain = reg.merged_scope_histogram(
+                "plan", pids, "drain.total"
+            )
+            stale = reg.merged_scope_histogram(
+                "plan", pids, "drain.staleness"
+            )
+            treg = tenant_scopes.get(tenant)
+            out[tenant] = {
+                "plans": sorted(pids),
+                "rows_emitted": rows,
+                "matches": matches,
+                "late_events": late,
+                "drain": drain.snapshot(),
+                "drain_staleness": stale.snapshot(),
+                "cache_hits": (
+                    treg.counter_value("control.cache_hit")
+                    if treg is not None else 0
+                ),
+                "cache_misses": (
+                    treg.counter_value("control.cache_miss")
+                    if treg is not None else 0
+                ),
+                "stack_joins": (
+                    treg.counter_value("control.stack_join")
+                    if treg is not None else 0
+                ),
+            }
+        return out
+
+    def openmetrics(self) -> str:
+        """The metrics snapshot as Prometheus text (the
+        GET /api/v1/metrics/prometheus body; telemetry/openmetrics.py
+        has the mapping). Safe off-thread — same snapshot metrics()
+        takes."""
+        from ..telemetry.openmetrics import render_openmetrics
+
+        return render_openmetrics(self.metrics())
 
     # -- results -------------------------------------------------------------
     # fst:runloop-only (drains first)

@@ -15,6 +15,7 @@ the measured overhead on headline replay throughput is <2%
 """
 
 from .histogram import LatencyHistogram
+from .openmetrics import render_openmetrics
 from .prober import ProbeReport, SideChannelProber
 from .registry import Counter, MetricsRegistry
 from .spans import NULL_SPAN, StageTimes
@@ -71,4 +72,5 @@ __all__ = [
     "StageTimes",
     "TOP_LEVEL_STAGES",
     "TraceSampler",
+    "render_openmetrics",
 ]

@@ -5,6 +5,14 @@ drain fetch thread, the replay stager, the sharded drain path, and the
 sink path all record into it; a metrics reader (``Job.metrics()`` /
 ``GET /api/v1/metrics``) snapshots it atomically from any thread.
 
+SCOPED child registries (``scope(kind, id)``) attribute metrics to one
+plan or tenant: a child is a full registry of its own (counters,
+gauges, histograms) nested under the parent's snapshot as
+``scopes[kind][id]``. Children follow the parent's ``enabled`` flag,
+and their histograms keep the mergeable-geometry contract, so a tenant
+rollup is a plain ``LatencyHistogram.merge`` fold over the tenant's
+plan scopes (docs/observability.md "Scoped metric groups").
+
 Everything degrades to near-zero cost when ``enabled`` is False: spans
 return a shared no-op context and record/inc calls return immediately —
 this is the switch the bench's telemetry-overhead A/B flips.
@@ -13,7 +21,7 @@ this is the switch the bench's telemetry-overhead A/B flips.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .histogram import LatencyHistogram
 from .spans import NULL_SPAN, StageTimes
@@ -40,13 +48,89 @@ class MetricsRegistry:
     """Named counters, gauges, histograms, and stage times with an
     atomic JSON-safe ``snapshot()``."""
 
-    def __init__(self, enabled: bool = True) -> None:
-        self.enabled = enabled
+    def __init__(
+        self, enabled: bool = True, parent: "MetricsRegistry" = None
+    ) -> None:
+        self._parent = parent
+        self._enabled = enabled
         self._lock = threading.Lock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, object] = {}
         self._hists: Dict[str, LatencyHistogram] = {}
+        # scoped children: (kind, id) -> child registry. Children are
+        # never dropped while the registry lives — a retired plan's
+        # counters must keep contributing to conservation sums and
+        # tenant rollups (bounded by the number of plans ever admitted).
+        self._scopes: Dict[Tuple[str, str], "MetricsRegistry"] = {}
         self.stages = StageTimes()
+
+    @property
+    def enabled(self) -> bool:
+        """Children follow the parent's switch: flipping the job
+        registry's ``enabled`` (the bench overhead A/B) silences every
+        plan/tenant scope with it."""
+        if self._parent is not None:
+            return self._parent.enabled
+        return self._enabled
+
+    @enabled.setter
+    def enabled(self, value: bool) -> None:
+        self._enabled = bool(value)
+
+    # -- scoped children -----------------------------------------------------
+    def scope(self, kind: str, scope_id) -> "MetricsRegistry":
+        """Get-or-create the child registry for one scope (e.g.
+        ``scope('plan', 'q1')``). Same thread-safety contract as every
+        other accessor."""
+        key = (str(kind), str(scope_id))
+        with self._lock:
+            child = self._scopes.get(key)
+            if child is None:
+                child = self._scopes[key] = MetricsRegistry(parent=self)
+            return child
+
+    def scope_map(self, kind: str) -> Dict[str, "MetricsRegistry"]:
+        """Snapshot of one kind's children ({id: registry})."""
+        kind = str(kind)
+        with self._lock:
+            return {
+                sid: reg
+                for (k, sid), reg in self._scopes.items()
+                if k == kind
+            }
+
+    # -- point accessors (rollups read live objects, not snapshots) ----------
+    def counter_value(self, name: str) -> int:
+        with self._lock:
+            c = self._counters.get(name)
+        return 0 if c is None else c.value
+
+    def gauge_value(self, name: str, default=None):
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def get_histogram(self, name: str) -> Optional[LatencyHistogram]:
+        """The live histogram object (or None) — what a cross-scope
+        rollup merges via ``LatencyHistogram.merge``."""
+        with self._lock:
+            return self._hists.get(name)
+
+    def merged_scope_histogram(
+        self, kind: str, ids: List[str], name: str
+    ) -> LatencyHistogram:
+        """Fold one named histogram across the given scopes into a
+        fresh histogram (the tenant-rollup primitive; scopes missing
+        the name contribute nothing)."""
+        out = LatencyHistogram()
+        scopes = self.scope_map(kind)
+        for sid in ids:
+            reg = scopes.get(str(sid))
+            if reg is None:
+                continue
+            h = reg.get_histogram(name)
+            if h is not None:
+                out.merge(h)
+        return out
 
     # -- spans / stage time -------------------------------------------------
     def span(self, name: str):
@@ -96,7 +180,8 @@ class MetricsRegistry:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             hists = dict(self._hists)
-        return {
+            scopes = dict(self._scopes)
+        out = {
             "enabled": self.enabled,
             "counters": {n: c.value for n, c in sorted(counters.items())},
             "gauges": dict(sorted(gauges.items())),
@@ -105,3 +190,9 @@ class MetricsRegistry:
                 n: h.snapshot() for n, h in sorted(hists.items())
             },
         }
+        if scopes:
+            by_kind: Dict[str, Dict[str, object]] = {}
+            for (kind, sid), reg in sorted(scopes.items()):
+                by_kind.setdefault(kind, {})[sid] = reg.snapshot()
+            out["scopes"] = by_kind
+        return out

@@ -94,6 +94,23 @@ Schema v7 (dynamic-control-plane round, bench.py ``schema_version:
   multi-query stacks as data updates). Pre-v7 files are exempt; a
   ``control`` block present in any version is validated.
 
+Schema v8 (per-tenant observability round, bench.py
+``schema_version: 8``) adds the attribution contract: the ``control``
+block carries an ``attribution`` block whose
+
+* per-plan ``rows_emitted`` counts (the scoped metric groups,
+  runtime/executor.py) must CONSERVE — sum exactly to
+  ``rows_emitted_total``, the job-level emitted total — and
+  ``conserved`` must say so;
+* ``footprint`` map (the admitted-vs-measured meter) must be
+  non-empty with finite positive ``measured_bytes`` per runtime, and
+  at least ONE runtime must carry a finite positive ``utilization``
+  against a finite positive ``admitted_bytes`` (the ADM101/102
+  admission prediction actually compared to device reality).
+
+Pre-v8 files are exempt; an ``attribution`` block present in any
+version is validated.
+
 Optional ``recovery`` block (``bench.py --fault``, any version): when
 present it must carry a finite positive measured ``recovery_time_ms``,
 at least one injected crash, ``stale_tmp_swept: true``, and EXACT
@@ -662,6 +679,112 @@ def validate_v7(doc, errors: List[str], where: str) -> None:
         validate_control(ctrl, errors, where)
 
 
+def validate_attribution(att, errors: List[str], where: str) -> None:
+    """The schema-v8 ``attribution`` block: per-plan scoped row counts
+    that must conserve against the job total, and the admitted-vs-
+    measured footprint meter. An attribution whose rows do not sum, or
+    whose meter never compared a measured footprint to an admission
+    prediction, is a failed claim."""
+    where = f"{where}:attribution"
+    if not isinstance(att, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    plans = att.get("plans")
+    total = att.get("rows_emitted_total")
+    if not isinstance(plans, dict) or not plans:
+        errors.append(
+            f"{where}: plans missing/empty — per-plan attribution is "
+            "the point of the block"
+        )
+    else:
+        attributed = 0
+        ok = True
+        for pid, ent in plans.items():
+            if not isinstance(ent, dict):
+                errors.append(f"{where}: plans[{pid!r}] not an object")
+                ok = False
+                continue
+            n = ent.get("rows_emitted")
+            if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+                errors.append(
+                    f"{where}: plans[{pid!r}].rows_emitted "
+                    f"missing/non-int ({n!r})"
+                )
+                ok = False
+                continue
+            attributed += n
+        if not isinstance(total, int) or isinstance(total, bool):
+            errors.append(
+                f"{where}: rows_emitted_total missing/non-int "
+                f"({total!r})"
+            )
+        elif ok and attributed != total:
+            errors.append(
+                f"{where}: per-plan rows do not CONSERVE — scoped sum "
+                f"{attributed} != job total {total} (attribution "
+                "dropped or double-counted rows)"
+            )
+    if att.get("conserved") is not True:
+        errors.append(f"{where}: conserved must be true")
+    fp = att.get("footprint")
+    if not isinstance(fp, dict) or not fp:
+        errors.append(
+            f"{where}: footprint map missing/empty — the "
+            "admitted-vs-measured meter never polled"
+        )
+        return
+    n_compared = 0
+    for rid, ent in fp.items():
+        if not isinstance(ent, dict):
+            errors.append(f"{where}: footprint[{rid!r}] not an object")
+            continue
+        m = ent.get("measured_bytes")
+        if not _finite(m) or m <= 0:
+            errors.append(
+                f"{where}: footprint[{rid!r}].measured_bytes "
+                f"missing/non-positive ({m!r})"
+            )
+        if "admitted_bytes" in ent or "utilization" in ent:
+            a = ent.get("admitted_bytes")
+            u = ent.get("utilization")
+            if not _finite(a) or a <= 0:
+                errors.append(
+                    f"{where}: footprint[{rid!r}].admitted_bytes "
+                    f"non-finite/non-positive ({a!r})"
+                )
+            elif not _finite(u) or u <= 0:
+                errors.append(
+                    f"{where}: footprint[{rid!r}].utilization "
+                    f"non-finite/non-positive ({u!r}) — utilization "
+                    "must be a finite measured/admitted ratio"
+                )
+            else:
+                n_compared += 1
+    if n_compared == 0:
+        errors.append(
+            f"{where}: no runtime carries an admitted-vs-measured "
+            "utilization — the meter never compared a prediction to "
+            "device reality"
+        )
+
+
+def validate_v8(doc, errors: List[str], where: str) -> None:
+    """The per-tenant observability contract (on top of v3..v7). The
+    control block itself is validated by validate_v7; here only its
+    attribution rider is required."""
+    ctrl = doc.get("control")
+    if not isinstance(ctrl, dict):
+        return  # v7 validation already reported the missing block
+    att = ctrl.get("attribution")
+    if att is None:
+        errors.append(
+            f"{where}:control: attribution block missing (schema v8 "
+            "requires per-plan attribution + the footprint meter)"
+        )
+    else:
+        validate_attribution(att, errors, f"{where}:control")
+
+
 def validate_recovery(rec, errors: List[str], where: str) -> None:
     """The ``--fault`` recovery block (optional in every version; when
     present it must carry real measurements and the exactly-once
@@ -767,6 +890,17 @@ def validate_doc(
         # same exemption shape as disorder: v6-era lines need not
         # carry the block, but a present one is held to its contract
         validate_control(doc["control"], errors, where)
+    if version >= 8:
+        validate_v8(doc, errors, where)
+    elif (
+        isinstance(doc.get("control"), dict)
+        and "attribution" in doc["control"]
+    ):
+        # pre-v8 exemption, but a present attribution block is held
+        # to its contract
+        validate_attribution(
+            doc["control"]["attribution"], errors, f"{where}:control"
+        )
     if "recovery" in doc:
         validate_recovery(doc["recovery"], errors, where)
 

@@ -243,9 +243,15 @@ def test_control_service_rest_roundtrip(tmp_path):
         job.run_cycle()
         assert len(job.results("ones")) > ones_so_far
 
-        # listing + delete
+        # listing + delete: one poll shows the whole fleet (id,
+        # tenant, enabled, fold host/slot per entry)
         status, resp = call("GET", "/api/v1/queries")
-        assert status == 200 and qid in resp["queries"]
+        assert status == 200
+        by_id = {q["id"]: q for q in resp["queries"]}
+        assert qid in by_id
+        assert by_id[qid]["enabled"] is True
+        assert by_id[qid]["tenant"] == "default"
+        assert "folded" in by_id[qid]
         status, _ = call("DELETE", f"/api/v1/queries/{qid}")
         assert status == 200
         src.emit(Rec(1, 99.0, 2000), 2000)

@@ -690,6 +690,126 @@ def test_v6_era_docs_unaffected_by_v7_gate():
     assert any("dropped_events" in e for e in errors)
 
 
+# -- schema v8: per-plan attribution + footprint meter ----------------------
+
+
+def _attribution_blk(**over):
+    blk = {
+        "plans": {
+            "q0": {"tenant": "tenant0", "rows_emitted": 120,
+                   "matches": 120},
+            "q1": {"tenant": "tenant1", "rows_emitted": 80,
+                   "matches": 80},
+            "flat": {"tenant": "tenant0", "rows_emitted": 300,
+                     "matches": 300},
+        },
+        "rows_emitted_total": 500,
+        "conserved": True,
+        "footprint": {
+            "@dyn:q0": {"measured_bytes": 134_217_728},
+            "flat": {
+                "measured_bytes": 100_000_000,
+                "admitted_bytes": 100_663_296,
+                "utilization": 0.993,
+            },
+        },
+    }
+    blk.update(over)
+    return blk
+
+
+def _v8_doc(**att_over):
+    doc = _v7_doc()
+    doc["schema_version"] = 8
+    doc["control"]["attribution"] = _attribution_blk(**att_over)
+    return doc
+
+
+def test_valid_v8_doc_passes():
+    errors = []
+    CHECK.validate_doc(_v8_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v8_requires_attribution_block():
+    doc = _v8_doc()
+    del doc["control"]["attribution"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("attribution block missing" in e for e in errors)
+
+
+def test_v8_rows_must_conserve():
+    # scoped sum != job total: attribution dropped rows
+    errors = []
+    CHECK.validate_doc(
+        _v8_doc(rows_emitted_total=501), errors, "doc"
+    )
+    assert any("do not CONSERVE" in e for e in errors)
+    # a declared conserved=false is itself a failure
+    errors = []
+    CHECK.validate_doc(_v8_doc(conserved=False), errors, "doc")
+    assert any("conserved must be true" in e for e in errors)
+    # empty plans map measures nothing
+    errors = []
+    CHECK.validate_doc(
+        _v8_doc(plans={}, rows_emitted_total=0), errors, "doc"
+    )
+    assert any("plans missing/empty" in e for e in errors)
+
+
+def test_v8_footprint_utilization_must_be_finite_and_compared():
+    # a non-finite utilization is a failed claim
+    errors = []
+    CHECK.validate_doc(
+        _v8_doc(footprint={
+            "flat": {
+                "measured_bytes": 1, "admitted_bytes": 1,
+                "utilization": float("inf"),
+            },
+        }),
+        errors, "doc",
+    )
+    assert any("utilization" in e for e in errors)
+    # measured-only everywhere = the meter never compared anything
+    errors = []
+    CHECK.validate_doc(
+        _v8_doc(footprint={"@dyn:q0": {"measured_bytes": 7}}),
+        errors, "doc",
+    )
+    assert any("never compared" in e for e in errors)
+    # an empty meter is a missing meter
+    errors = []
+    CHECK.validate_doc(_v8_doc(footprint={}), errors, "doc")
+    assert any("footprint map missing/empty" in e for e in errors)
+    # measured bytes must be positive finite
+    errors = []
+    CHECK.validate_doc(
+        _v8_doc(footprint={
+            "x": {"measured_bytes": 0},
+            "flat": {
+                "measured_bytes": 1, "admitted_bytes": 2,
+                "utilization": 0.5,
+            },
+        }),
+        errors, "doc",
+    )
+    assert any("measured_bytes" in e for e in errors)
+
+
+def test_v7_era_docs_unaffected_by_v8_gate():
+    """Pre-v8 lines need no attribution block, but one present is
+    held to its contract (same exemption shape as disorder/control)."""
+    errors = []
+    CHECK.validate_doc(_v7_doc(), errors, "doc")
+    assert errors == []
+    doc = _v7_doc()
+    doc["control"]["attribution"] = _attribution_blk(conserved=False)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("conserved must be true" in e for e in errors)
+
+
 # -- optional recovery block (bench.py --fault) ----------------------------
 
 
@@ -799,14 +919,15 @@ def test_fault_block_live_and_gate_accepts():
     assert errors == []
 
 
-def test_dryrun_emits_schema_complete_v7(tmp_path):
+def test_dryrun_emits_schema_complete_v8(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
     replay, short paced phase) exercises resident + streaming + sink,
     the out-of-process prober, the small-skew disorder sweep, AND the
-    control-plane sustained-load run, and its JSON line passes the v7
-    schema gate — in the tier-1 lane, under its timeout. (The --fault
-    recovery block has its own in-process live test below, so this
-    subprocess stays at its historical cost.)"""
+    control-plane sustained-load run (now with the v8 per-plan
+    attribution block), and its JSON line passes the v8 schema gate —
+    in the tier-1 lane, under its timeout. (The --fault recovery block
+    has its own in-process live test below, so this subprocess stays
+    at its historical cost.)"""
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
@@ -855,7 +976,7 @@ def test_dryrun_emits_schema_complete_v7(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 7
+    assert doc["schema_version"] == 8
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]
@@ -917,6 +1038,20 @@ def test_dryrun_emits_schema_complete_v7(tmp_path):
     assert ctrl["cache"]["hits"] >= 1
     assert math.isfinite(ctrl["admit_rate_qps"])
     assert ctrl["admit_rate_qps"] > 0
+    # the v8 additions: per-plan scoped row counts really conserve
+    # against the job total, every plan carries its tenant, and the
+    # footprint meter compared at least one admission prediction to
+    # live device bytes (see also the unit v8 cases above)
+    att = ctrl["attribution"]
+    assert att["conserved"] is True
+    assert sum(
+        p["rows_emitted"] for p in att["plans"].values()
+    ) == att["rows_emitted_total"] > 0
+    assert all("tenant" in p for p in att["plans"].values())
+    assert any(
+        math.isfinite(ent.get("utilization", float("nan")))
+        for ent in att["footprint"].values()
+    )
 
 
 def test_repo_bench_files_validate():
