@@ -5,7 +5,7 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line (``schema_version: 8``). One invocation measures
+Prints ONE JSON line (``schema_version: 9``). One invocation measures
 THREE execution modes and emits all of them in the same document, so a
 regression in any path stays a tracked number:
 
@@ -114,6 +114,18 @@ footprint meter per runtime (gated: measured bytes positive, and at
 least one runtime carrying a finite utilization against its
 admission-time ADM101/102 prediction). docs/observability.md has the
 model.
+
+Schema v9 (flight-recorder / measured-attribution round) adds the
+``limiting_leg`` block per mode: the run-loop stage ledger folded into
+a fixed leg cover (setup / host_staging / h2d / dispatch /
+device_compute / drain_fetch, plus overlapped decode / sink detail —
+flink_siddhi_tpu/telemetry/attribution.py), shares stated against the
+mode's measured wall-clock window, and the limiting leg NAMED as the
+argmax. Gated: the cover must attribute >= 95% of the window and the
+named leg must re-derive as the argmax from the published per-leg
+seconds (scripts/check_bench_schema.py), so the "limiting leg" each
+bench round reports is a measurement, not an opinion. Bench prints
+one ``LIMITING LEG (<mode>): ...`` line per mode to stderr.
 
 ``--fault`` (composable with ``--dryrun``): appends a ``recovery``
 block — a supervised run (runtime/supervisor.py) under a seeded crash
@@ -277,6 +289,15 @@ def _config_cql(config):
             )
         return "; ".join(parts)
     raise SystemExit(f"unknown BENCH_CONFIG {config!r}")
+
+
+def _schema_version():
+    """One definition (flink_siddhi_tpu.BENCH_SCHEMA_VERSION): the
+    emitted line, the schema gate, and the fst_build_info OpenMetrics
+    gauge all read it."""
+    from flink_siddhi_tpu import BENCH_SCHEMA_VERSION
+
+    return BENCH_SCHEMA_VERSION
 
 
 def _telemetry_enabled():
@@ -519,6 +540,8 @@ def _mode_resident(config, n_events, batch, dryrun):
         "runs_elapsed_s": [round(t, 3) for t in run_times],
         "fusion": _resident_fusion_block(job, rep),
         "stage_breakdown": _stage_breakdown(job, elapsed_wall),
+        "limiting_leg": _limiting_leg_block(job, elapsed_wall,
+                                            "resident"),
     }
     return section, job, ev_per_sec
 
@@ -784,6 +807,8 @@ def _mode_streaming(config, n_events, batch, dryrun):
         ),
         "fusion": _fusion_block(job, seg),
         "stage_breakdown": _stage_breakdown(job, elapsed_wall),
+        "limiting_leg": _limiting_leg_block(job, elapsed_wall,
+                                            "streaming"),
     }
     return section, job
 
@@ -863,6 +888,7 @@ def _mode_sink(config, n_events, batch):
         "sink_batches": sink.batches,
         "fusion": _fusion_block(job, seg),
         "stage_breakdown": _stage_breakdown(job, elapsed_wall),
+        "limiting_leg": _limiting_leg_block(job, elapsed_wall, "sink"),
     }
     return section, job
 
@@ -1524,9 +1550,17 @@ def main():
         # provenance: which denominator vs_baseline divides by (ADVICE
         # r4: the JSON line should be self-describing off this machine)
         "baseline_source": "pinned-measurement (BASELINE.md)",
-        "schema_version": 8,
+        "schema_version": _schema_version(),
         "modes": modes,
     }
+    # schema v9: print each mode's measured limiting-leg verdict so
+    # BASELINE.md's column is copied from output, never eyeballed
+    from flink_siddhi_tpu.telemetry.attribution import render_verdict
+
+    for sec in modes.values():
+        ll = sec.get("limiting_leg")
+        if isinstance(ll, dict) and "limiting_leg" in ll:
+            print(render_verdict(ll), file=sys.stderr)
     if set(want_modes) != {"resident", "streaming", "sink"}:
         out["partial"] = True  # profiling subset: schema gate rejects
     # schema v5: the fused-dispatch contract. Streaming mode must reach
@@ -1799,6 +1833,27 @@ def main():
         dryrun, full="--control" in sys.argv
     )
     print(json.dumps(out))
+
+
+def _limiting_leg_block(job, elapsed_wall, mode):
+    """Schema v9: the measured limiting-leg verdict for one mode
+    (flink_siddhi_tpu/telemetry/attribution.py) — the run-loop stage
+    ledger folded into the fixed leg cover, shares stated against the
+    mode's measured build..flush wall-clock window, argmax named.
+    Gated by scripts/check_bench_schema.py: the cover must attribute
+    >= 95% of the window and the named leg must re-derive as the
+    argmax from the published per-leg seconds, so BASELINE.md's
+    "limiting leg" column is a copy of a measurement, not an
+    opinion."""
+    from flink_siddhi_tpu.telemetry.attribution import limiting_leg
+
+    if not job.telemetry.enabled:
+        return {"telemetry": "off"}
+    snap = job.telemetry.snapshot()
+    return limiting_leg(
+        snap["stages"], elapsed_wall, mode=mode,
+        histograms=snap.get("histograms", {}),
+    )
 
 
 def _stage_breakdown(job, elapsed_wall):

@@ -16,6 +16,11 @@ Routes (JSON in/out):
                                            scoped series; telemetry/
                                            openmetrics.py)
     GET    /api/v1/traces                -> per-event trace sampling view
+    GET    /api/v1/flightrecorder        -> the job's flight-recorder
+                                           journal (telemetry/
+                                           flightrec.py), filterable:
+                                           ?kind=control&plan=q1&
+                                           since_seq=42&limit=100
     GET    /api/v1/health                -> supervisor liveness: alive +
                                            last-checkpoint age + restart
                                            count (Supervisor.health();
@@ -181,7 +186,62 @@ class QueryControlService:
 
             # fst:thread-root name=service
             def do_GET(self):
-                parts = [p for p in self.path.split("/") if p]
+                from urllib.parse import parse_qs, urlsplit
+
+                url = urlsplit(self.path)
+                parts = [p for p in url.path.split("/") if p]
+                if parts == ["api", "v1", "flightrecorder"]:
+                    # the flight-recorder journal (telemetry/
+                    # flightrec.py), filterable by kind / plan /
+                    # since-seq — the black-box poll a post-incident
+                    # investigation starts from. Lock-guarded snapshot:
+                    # safe off the run-loop thread.
+                    job = service.job
+                    if job is None and service.supervisor is not None:
+                        # supervised pipeline: the CURRENT job's
+                        # journal (Supervisor.job is a GIL-atomic
+                        # read; None mid-restart)
+                        job = service.supervisor.job
+                    fr = getattr(job, "flightrec", None)
+                    if fr is None:
+                        return self._reply(
+                            200, {"seq": 0, "events": []}
+                        )
+                    q = parse_qs(url.query)
+
+                    def _one(name):
+                        v = q.get(name)
+                        return v[0] if v else None
+
+                    # seq BEFORE events(): the two reads are separate
+                    # lock acquisitions, and an event recorded between
+                    # them must not be skipped by a cursor client —
+                    # reading seq first means it can only UNDERstate,
+                    # so such an event re-delivers on the next poll
+                    # (at-least-once, never lost)
+                    seq = fr.seq
+                    try:
+                        since = _one("since_seq")
+                        limit = _one("limit")
+                        events = fr.events(
+                            kind=_one("kind"),
+                            plan=_one("plan"),
+                            since_seq=(
+                                int(since) if since is not None else None
+                            ),
+                            limit=(
+                                int(limit) if limit is not None else 512
+                            ),
+                        )
+                    except ValueError:
+                        return self._reply(
+                            400,
+                            {"error": "since_seq/limit must be ints"},
+                        )
+                    return self._reply(
+                        200,
+                        {"seq": seq, "events": _json_safe(events)},
+                    )
                 if parts == ["api", "v1", "health"]:
                     # liveness + checkpoint freshness + restart count.
                     # 200 while supervised-and-alive (or merely

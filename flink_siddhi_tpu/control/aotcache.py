@@ -110,6 +110,17 @@ def cache_key(plan, capacity: int = 128) -> Optional[Tuple[str, str]]:
     return ("exact", f"{sig}:{digest}")
 
 
+def sig_label(key: Optional[Tuple[str, str]]) -> Optional[str]:
+    """Human/metrics label for a cache key: kind-prefixed, signature
+    truncated. ONE definition — the executor's compile-attribution
+    labels (``metrics()["compiles"].by_signature``) and the flight
+    recorder's aotcache.* event signatures are cross-correlated by
+    exact string match, so they must be minted by the same code."""
+    if key is None:
+        return None
+    return f"{key[0]}:{key[1][:32]}"
+
+
 class AOTExecutableCache:
     """Bounded LRU of :class:`CachedExecutables` keyed by
     :func:`cache_key`. Thread-compat: control-plane admits run on the
@@ -124,6 +135,7 @@ class AOTExecutableCache:
             OrderedDict()
         )
         self._telemetry = telemetry
+        self._flightrec = None
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -131,25 +143,39 @@ class AOTExecutableCache:
     def bind_telemetry(self, registry) -> None:
         self._telemetry = registry
 
+    def bind_flightrec(self, recorder) -> None:
+        """Journal hit/miss/evict into the bound job's flight recorder
+        (telemetry/flightrec.py) alongside the counters."""
+        self._flightrec = recorder
+
     def _inc(self, name: str) -> None:
         if self._telemetry is not None:
             self._telemetry.inc(name)
+
+    def _rec(self, kind: str, key, **kw) -> None:
+        if self._flightrec is not None:
+            self._flightrec.record(kind, signature=sig_label(key), **kw)
 
     def lookup(self, key) -> Optional[CachedExecutables]:
         """Counted lookup: a None key (uncacheable plan) is a miss."""
         if key is None:
             self.misses += 1
             self._inc("control.cache_miss")
+            self._rec("aotcache.miss", key, uncacheable=True)
             return None
         entry = self._entries.get(key)
         if entry is None:
             self.misses += 1
             self._inc("control.cache_miss")
+            self._rec("aotcache.miss", key)
             return None
         self._entries.move_to_end(key)
         entry.reuses += 1
         self.hits += 1
         self._inc("control.cache_hit")
+        self._rec(
+            "aotcache.hit", key, first_plan_id=entry.first_plan_id
+        )
         return entry
 
     def insert(self, key, entry: CachedExecutables) -> None:
@@ -161,6 +187,10 @@ class AOTExecutableCache:
             old_key, old = self._entries.popitem(last=False)
             self.evictions += 1
             self._inc("control.cache_evict")
+            self._rec(
+                "aotcache.evict", old_key,
+                first_plan_id=old.first_plan_id, reuses=old.reuses,
+            )
             _LOG.debug(
                 "AOT cache evicted %s (first compiled for %s, "
                 "%d reuses)", old_key[0], old.first_plan_id, old.reuses,

@@ -153,6 +153,16 @@ def snapshot_job(job) -> Dict[str, Any]:
                 getattr(job, "_plan_admitted_bytes", {})
             ),
         },
+        # flight-recorder journal (telemetry/flightrec.py): seq +
+        # entries ride the snapshot so the journal survives restore
+        # exactly once — entries after this snapshot roll back with a
+        # crash, like uncommitted output; the restored recorder
+        # continues the sequence monotonically
+        "flightrec": (
+            job.flightrec.state_dict()
+            if getattr(job, "flightrec", None) is not None
+            else None
+        ),
         # output-rate limiter phase: events-mode chunk position and the
         # buffered rows survive a restart, so a restored job emits at
         # the same chunk boundaries as an uninterrupted run (ADVICE r4).
@@ -329,6 +339,16 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
                 tuple(k): tuple(v) for k, v in d.get("snap", [])
             }
             lim.deadline = None
+
+    # 6. flight-recorder journal — LAST, so it overwrites any events
+    # the restore itself synthesized (the dynamic-query replay above
+    # re-runs add_plan, whose control.admit records are a
+    # reconstruction, not new admits: adopting the checkpointed
+    # journal wholesale is what keeps every pre-crash entry exactly
+    # once). Absent in pre-flight-recorder checkpoints: fresh journal.
+    fr = getattr(job, "flightrec", None)
+    if fr is not None and snap.get("flightrec"):
+        fr.restore_state(snap["flightrec"])
 
 
 def _check_compatible(ref, restored, plan_id: str) -> None:

@@ -29,6 +29,9 @@ import numpy as np
 from ..compiler.plan import CompiledPlan
 from ..schema.batch import EventBatch
 from ..telemetry import MetricsRegistry
+from ..telemetry import compile_events
+from ..telemetry.attribution import limiting_leg as _attr_limiting_leg
+from ..telemetry.flightrec import FlightRecorder
 from ..telemetry.tracing import TraceSampler
 from .sources import Source
 from .tape import bucket_size, build_wire_tape
@@ -669,6 +672,24 @@ class Job:
         # span/record to a no-op (the bench overhead A/B switch).
         self.telemetry = MetricsRegistry()
         self.aot_cache.bind_telemetry(self.telemetry)
+        # flight recorder (telemetry/flightrec.py): the job's bounded
+        # black-box journal — control applies, checkpoint save/restore,
+        # shed/late/stall bursts (rate-collapsed), AOT-cache traffic,
+        # XLA compiles. Follows the registry's enabled switch; its
+        # seq + entries are part of the checkpoint (runtime/
+        # checkpoint.py), so the journal survives restore exactly once.
+        self.flightrec = FlightRecorder(registry=self.telemetry)
+        self.aot_cache.bind_flightrec(self.flightrec)
+        # permanent compile telemetry (telemetry/compile_events.py):
+        # the register-once jax.monitoring listener plus this job's
+        # attribution sink — per-plan-signature lowering counts +
+        # durations in metrics()["compiles"], mirrored into the
+        # registry (compile.lowerings / compile.lowering) and journal.
+        # fst:ephemeral per-process compile accounting; a restored job pays (and records) its own compiles
+        self._compile_sink = compile_events.CompileSink(
+            self.telemetry, self.flightrec
+        )
+        compile_events.install()
         # per-event trace sampling: a deterministic 1-in-N sample of
         # events (abs_ts % sample_every == 0) is stamped at source pull
         # and completed when a row carrying that timestamp surfaces to
@@ -827,13 +848,29 @@ class Job:
                 self._inc_control("control.stack_join")
                 self._inc_tenant(tenant, "control.admitted")
                 self._inc_tenant(tenant, "control.stack_join")
+                self._frec(
+                    "control.admit", plan=plan.plan_id, tenant=tenant,
+                    stack_join=True,
+                )
                 return
+            self._frec(
+                "control.admit", plan=plan.plan_id, tenant=tenant,
+                stack_join=False,
+            )
             plan, admit0 = self._wrap_dynamic(plan)
             self._inc_control("control.admitted")
             self._inc_tenant(tenant, "control.admitted")
         self._create_runtime(
             plan, admit0, cacheable=dynamic, tenant=tenant
         )
+
+    def _frec(self, kind: str, **kw) -> None:
+        """Flight-recorder append, safe during __init__ (the recorder
+        is created after the static add_plan loop) — same shape as
+        :meth:`_inc_control` below."""
+        fr = getattr(self, "flightrec", None)
+        if fr is not None:
+            fr.record(kind, **kw)
 
     def _inc_control(self, name: str, n: int = 1) -> None:
         """Control-plane counters, safe during __init__ (the registry
@@ -1004,7 +1041,11 @@ class Job:
         tenant: Optional[str] = None,
     ) -> None:
         from ..compiler import pallas_ops
-        from ..control.aotcache import CachedExecutables, cache_key
+        from ..control.aotcache import (
+            CachedExecutables,
+            cache_key,
+            sig_label as _sig_label,
+        )
 
         pallas_ops.warmup()  # probe TPU kernels outside any trace
         # the AOT executable cache (dynamic adds only — a static plan
@@ -1017,6 +1058,13 @@ class Job:
         key = cache_key(plan, capacity=self.batch_size) if cacheable \
             else None
         entry = self.aot_cache.lookup(key) if cacheable else None
+        # compile-attribution label (telemetry/compile_events.py): the
+        # shape-class signature where the cache already computed it
+        # (minted by aotcache.sig_label so it string-matches the
+        # aotcache.* journal events); plan id for static plans, which
+        # deliberately skip signature hashing (see the cache comment
+        # above)
+        sig_label = _sig_label(key) or f"plan:{plan.plan_id}"
         if cacheable:
             # tenant attribution on the AOT cache: a noisy tenant's
             # compile churn shows in ITS scope, not only job-wide
@@ -1082,6 +1130,7 @@ class Job:
             wire_kinds={},
         )
         rt.traces = entry.traces
+        rt.sig_label = sig_label
         # drain pack programs ride the cache entry too: a cache-hit
         # admit's first drain must not pay a pack recompile
         rt.pack_jits = entry.pack_jits
@@ -1306,6 +1355,11 @@ class Job:
 
     def remove_plan(self, plan_id: str) -> None:
         self._assert_runloop_owner("remove_plan")
+        if plan_id in self._folded or plan_id in self._plans:
+            self._frec(
+                "control.retire", plan=plan_id,
+                tenant=self._plan_tenant.get(plan_id),
+            )
         folded = self._folded.pop(plan_id, None)
         self._folded_enabled.pop(plan_id, None)
         self._dynamic_cql.pop(plan_id, None)
@@ -1346,6 +1400,10 @@ class Job:
 
     def set_plan_enabled(self, plan_id: str, enabled: bool) -> None:
         self._assert_runloop_owner("set_plan_enabled")
+        self._frec(
+            "control.enable" if enabled else "control.disable",
+            plan=plan_id,
+        )
         folded = self._folded.get(plan_id)
         if folded is not None:
             self._folded_enabled[plan_id] = enabled
@@ -1548,6 +1606,12 @@ class Job:
         source: str = "apply-time",
     ) -> None:
         self._inc_control("control.admission_rejected")
+        # journal the refusal too (the recorder has its own lock — the
+        # service thread records boundary refusals concurrently)
+        self._frec(
+            "control.reject", plan=plan_id, tenant=tenant,
+            rules=[r for r in rules if r], source=source,
+        )
         # under the lock: the REST service thread records boundary
         # refusals concurrently with the run loop's apply-time ones,
         # and the eviction walk below iterates the dict
@@ -1672,6 +1736,18 @@ class Job:
             for sid, limiter in self._rate_limiters.items():
                 self._emit_pending(sid, limiter.flush())
 
+    def _compile_scope(self, rt: _PlanRuntime):
+        """Compile-attribution scope for one plan's jit calls
+        (telemetry/compile_events.py): any XLA lowering fired inside
+        it lands in ``metrics()["compiles"]`` under the plan's
+        shape-class signature label. Thread-local and re-entrant; a
+        plain attribute store on enter/exit, so the hot loop pays
+        nothing measurable."""
+        return compile_events.attribution(
+            getattr(self, "_compile_sink", None),
+            getattr(rt, "sig_label", None) or f"plan:{rt.plan.plan_id}",
+        )
+
     _noop_jit = None
 
     @classmethod
@@ -1710,7 +1786,11 @@ class Job:
 
         # fst:thread-root name=warm-compile
         def compile_it():
-            return rt.jitted_flush.lower(abstract).compile()
+            # attribution scope is thread-local: re-enter it on the
+            # pool thread so the background lowering still lands in
+            # this job's compile accounting
+            with self._compile_scope(rt):
+                return rt.jitted_flush.lower(abstract).compile()
 
         pool = getattr(self, "_compile_pool", None)
         if pool is None:
@@ -2580,6 +2660,9 @@ class Job:
                 continue
             if block and self._source_wm[i] > wm:
                 self.telemetry.inc("faults.backpressure_blocks")
+                self._frec(
+                    "fault.backpressure", stream=src.stream_id,
+                )
                 continue
             batch, swm, done = src.poll(self.batch_size)
             if batch is not None and len(batch):
@@ -2603,6 +2686,9 @@ class Job:
                         # rejoins the min from this cycle on
                         self._source_idle[i] = False
                         self.telemetry.inc("idle.unidled")
+                        self._frec(
+                            "watermark.unidle", stream=src.stream_id
+                        )
             elif timeout is not None and not self._source_idle[i]:
                 if self._source_last_t[i] is None:
                     self._source_last_t[i] = now  # arm at first poll
@@ -2611,6 +2697,12 @@ class Job:
                     # (visible in metrics()["sources"] and /health)
                     self._source_idle[i] = True
                     self.telemetry.inc("idle.marked")
+                    self._frec(
+                        "watermark.idle", stream=src.stream_id,
+                        idle_ms=round(
+                            (now - self._source_last_t[i]) * 1e3, 1
+                        ),
+                    )
                     _LOG.debug(
                         "source %s idle for %.0fms; excluded from the "
                         "min watermark until its next event",
@@ -2655,6 +2747,11 @@ class Job:
         if shed:
             self.shed_events += shed
             self.telemetry.inc("faults.shed_events", shed)
+            # journal the burst (rate-collapsed: repeats within the
+            # window fold into one entry; exact totals stay above)
+            self._frec(
+                "fault.shed", events=shed, policy="drop_oldest",
+            )
             # rate-limited: under sustained overload a shed happens
             # every cycle — the counters carry the exact total; the
             # log line only needs to keep saying it is still happening
@@ -2762,6 +2859,20 @@ class Job:
             else:
                 del self._pending[sid]
                 self._pending_t.pop(sid, None)
+        if not ready and self._pending and wm != MAX_WM:
+            # the gate is holding data it cannot release this cycle —
+            # a watermark stall (idle/lagging source, or the 'allow'
+            # holdback). Rate-collapsed: a multi-second stall is one
+            # journal entry with a repeat count, not one per cycle.
+            self._frec(
+                "watermark.stall",
+                pending=self._pending_total(),
+                gate_wm=(
+                    int(self._gate_wm)
+                    if self._gate_wm > MIN_WM
+                    else None
+                ),
+            )
         if eff != MAX_WM:
             if eff > self._released_wm:
                 self._released_wm = eff
@@ -2782,6 +2893,12 @@ class Job:
         reconcile them against the injected schedule)."""
         n = len(batch)
         self.late_events += n
+        # journal the burst (rate-collapsed across repeats; the exact
+        # per-policy totals live in the counters below)
+        self._frec(
+            "fault.late", events=n, policy=self.late_policy,
+            stream=batch.stream_id,
+        )
         tel = self.telemetry
         if tel.enabled:
             # late share, attributed where attributable: lateness is an
@@ -3107,7 +3224,7 @@ class Job:
         if busy:
             tel.inc("fusion.h2d_overlapped")
         plan = rt.plan
-        with tel.span("dispatch"):
+        with self._compile_scope(rt), tel.span("dispatch"):
             t0 = time.monotonic()
             # host interning during staging may have discovered new
             # group keys: grow once per segment, before the scanned
@@ -3168,7 +3285,7 @@ class Job:
         # retrace; host-driven re-bucketing = staging-class work)
         with _staging_allow():
             rt.states = plan.grow_state(rt.states)
-        with tel.span("dispatch"):
+        with self._compile_scope(rt), tel.span("dispatch"):
             t0 = time.monotonic()
             # NO device->host fetch here: emissions append to the
             # on-device accumulator and are drained in bulk
@@ -3330,10 +3447,22 @@ class Job:
         """``keep > 1`` retains the K latest checkpoint generations
         (path, path.1, ..; checkpoint.save rotation) so a restore can
         fall back past a checkpoint a crash made unreadable."""
+        import os
+
         from .checkpoint import save
 
         # same contract as snapshot(): surface accumulated emissions first
         self.drain_outputs()
+        # journal BEFORE the state capture: the save event itself is
+        # part of the snapshot, so a restored journal shows the save
+        # that produced it (exactly once). fspath, not the raw
+        # argument: a journaled pathlib.Path would pickle fine but be
+        # refused by the restore safelist unpickler — a checkpoint
+        # unrestorable exactly when it is needed
+        self._frec(
+            "checkpoint.save", path=os.fspath(path), keep=int(keep),
+            processed_events=int(self.processed_events),
+        )
         save(self, path, keep=keep)
 
     # fst:runloop-only (replaces device state wholesale)
@@ -3346,6 +3475,13 @@ class Job:
             load(self, os.fspath(snapshot_or_path))
         else:
             restore_job(self, snapshot_or_path)
+        # after restore_job adopted the checkpointed journal: the
+        # restore event extends it with the next monotone seq
+        self._frec(
+            "checkpoint.restore",
+            processed_events=int(self.processed_events),
+            plans=len(self._plans),
+        )
 
     # -- observability ------------------------------------------------------
     # The reference only counts processed events per runtime, logged at
@@ -3425,6 +3561,25 @@ class Job:
             "control": self.control_status(
                 counters=telemetry.get("counters", {})
             ),
+            # permanent compile telemetry (telemetry/compile_events.py):
+            # per-plan-signature lowering counts + duration histogram
+            "compiles": self._compile_sink.snapshot(),
+            # measured limiting-leg attribution over the live stage
+            # ledger (telemetry/attribution.py; shares against the
+            # attributed total — bench states them against the mode's
+            # measured wall-clock window instead)
+            "attribution": _attr_limiting_leg(
+                telemetry.get("stages", {}),
+                None,
+                "streaming",
+                telemetry.get("histograms", {}),
+            ),
+            # flight-recorder summary (GET /api/v1/flightrecorder has
+            # the filterable journal itself)
+            "flight_recorder": {
+                "seq": self.flightrec.seq,
+                "by_kind": self.flightrec.counts_by_kind(),
+            },
             # stage-attributed wall clock, latency histograms (drain.*
             # legs at least; jobs under bench add more), counters —
             # an atomic registry snapshot, safe off-thread

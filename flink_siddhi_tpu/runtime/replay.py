@@ -214,7 +214,9 @@ class ResidentReplay:
         # seed jit.__call__'s cache, so calling the jit wrapper in
         # run() would pay the compile (or its multi-second cache
         # deserialize) on the clock
-        with tel.span("stage.compile"):
+        # compile-attribution scope: the replay's off-clock lowering
+        # still lands in metrics()["compiles"] under the plan label
+        with job._compile_scope(rt), tel.span("stage.compile"):
             scan = rt.jitted_seg.lower(
                 rt.states, rt.acc, segments[0]
             ).compile()

@@ -105,6 +105,17 @@ class Supervisor:
         self._alive = True
         self._last_ckpt_t: Optional[float] = None
         self._ckpt_count = 0
+        # post-crash self-explanation (the /health last_restart block
+        # + the supervisor.restart journal event): filled when a
+        # restore completes after a crash
+        # fst:threadsafe single-writer (supervisor thread); health() reads the whole dict reference GIL-atomically
+        self._last_restart: Optional[Dict[str, object]] = None
+        # events replayed by the MOST RECENT crash (computed at crash
+        # time against the last committed checkpoint)
+        self._last_replayed = 0
+        # where the flight-recorder crash dump landed once the restart
+        # budget was exhausted (None until then)
+        self.crash_dump_path: Optional[str] = None
         # exactly-once commit protocol state
         self._committed: Dict[str, List[Tuple[int, tuple]]] = {}
         self._uncommitted: Dict[str, List[Tuple[int, tuple]]] = {}
@@ -216,11 +227,13 @@ class Supervisor:
             self.telemetry.inc("recovery.rows_discarded", discarded)
         dead = self._job
         self._job = None  # a crash during rebuild must not re-account it
+        replayed = 0
         if dead is not None:
             replayed = max(
                 int(dead.processed_events) - int(self._ckpt_processed), 0
             )
             self.telemetry.inc("recovery.events_replayed", replayed)
+        self._last_replayed = replayed
         self._crash_times = [
             t for t in self._crash_times
             if now - t <= self.restart_window_s
@@ -232,6 +245,46 @@ class Supervisor:
         )
         if len(self._crash_times) > self.max_restarts:
             self._alive = False
+            # black-box dump: the dead job's journal, written next to
+            # the checkpoints BEFORE raising, so the terminal failure
+            # leaves its own evidence file (best-effort — a dump
+            # failure must not mask the budget error)
+            if dead is not None:
+                fr = getattr(dead, "flightrec", None)
+                if fr is not None:
+                    try:
+                        fr.record(
+                            "supervisor.budget_exhausted",
+                            cause=f"{type(exc).__name__}: {exc}",
+                            crashes=len(self._crash_times),
+                            max_restarts=self.max_restarts,
+                        )
+                        self.crash_dump_path = fr.dump(
+                            self.checkpoint_path + ".flightdump.json",
+                            header={
+                                "reason": "restart budget exhausted",
+                                "cause": (
+                                    f"{type(exc).__name__}: {exc}"
+                                ),
+                                "crashes_in_window": len(
+                                    self._crash_times
+                                ),
+                                "max_restarts": self.max_restarts,
+                                "restart_window_s": (
+                                    self.restart_window_s
+                                ),
+                                "checkpoint_path": self.checkpoint_path,
+                                "processed_events": int(
+                                    dead.processed_events
+                                ),
+                            },
+                        )
+                        _LOG.error(
+                            "flight-recorder crash dump written to %s",
+                            self.crash_dump_path,
+                        )
+                    except Exception:  # noqa: BLE001 — best-effort
+                        _LOG.exception("flight-recorder dump failed")
             raise RestartBudgetExceeded(
                 f"{len(self._crash_times)} crashes within "
                 f"{self.restart_window_s:.0f}s exceed the restart "
@@ -286,6 +339,35 @@ class Supervisor:
                     self.telemetry.record_seconds(
                         "recovery.restore_ms", restore_ms / 1e3
                     )
+                    # journal the restart INTO THE RESTORED JOB: the
+                    # journal is checkpoint state, so once the next
+                    # checkpoint commits, this restart is recorded in
+                    # it exactly once (a crash before that checkpoint
+                    # rolls the entry back with everything else —
+                    # the uncommitted-output contract)
+                    cause = (
+                        f"{type(self.last_error).__name__}: "
+                        f"{self.last_error}"
+                        if self.last_error is not None
+                        else None
+                    )
+                    self._last_restart = {
+                        "cause": cause,
+                        "restore_ms": round(restore_ms, 3),
+                        "events_replayed": int(self._last_replayed),
+                        "restored_from": restored_from,
+                        "restart": self.restart_count,
+                        "flightrec_seq": None,
+                    }
+                    fr = getattr(job, "flightrec", None)
+                    if fr is not None:
+                        self._last_restart["flightrec_seq"] = fr.record(
+                            "supervisor.restart",
+                            cause=cause,
+                            restore_ms=round(restore_ms, 3),
+                            events_replayed=int(self._last_replayed),
+                            restart=self.restart_count,
+                        )
                     _LOG.info(
                         "restored from %s in %.1fms "
                         "(processed_events=%d)",
@@ -306,6 +388,14 @@ class Supervisor:
                 raise  # not a crash to retry: retrying cannot fix it
             except Exception as e:
                 self._record_crash(e)
+
+    @property
+    def job(self):
+        """The job currently being driven (None mid-restart/rebuild).
+        GIL-atomic attribute read — safe from the REST service thread
+        (the flight-recorder route reads the live job's journal
+        through this)."""
+        return self._job
 
     # -- health --------------------------------------------------------------
     def health(self) -> Dict[str, object]:
@@ -344,6 +434,12 @@ class Supervisor:
                 else None
             ),
             "last_recovery_ms": self.last_recovery_ms,
+            # post-crash self-explanation (ISSUE 15): cause, restore
+            # cost, replay size, and the journal seq of the restart
+            # event — a scrape explains the last restart without
+            # journal spelunking
+            "last_restart": self._last_restart,
+            "crash_dump_path": self.crash_dump_path,
             "processed_events": (
                 int(job.processed_events) if job is not None else None
             ),

@@ -14,6 +14,7 @@ the measured overhead on headline replay throughput is <2%
 (docs/observability.md).
 """
 
+from .flightrec import FlightRecorder
 from .histogram import LatencyHistogram
 from .openmetrics import render_openmetrics
 from .prober import ProbeReport, SideChannelProber
@@ -64,6 +65,7 @@ TOP_LEVEL_STAGES = (
 
 __all__ = [
     "Counter",
+    "FlightRecorder",
     "LatencyHistogram",
     "MetricsRegistry",
     "NULL_SPAN",

@@ -1,0 +1,214 @@
+"""Permanent XLA compile telemetry: the ``jax.monitoring`` listener as
+a register-once surface instead of a per-test footgun.
+
+Every executable LOWERING fires
+``/jax/core/compile/jaxpr_to_mlir_module_duration`` — before the
+persistent compilation cache is consulted, so a warm ``.jax_cache``
+cannot mask a retrace regression (cache hits skip backend_compile,
+not lowering). Two tests used to register private listeners for it
+and tear down with ``jax.monitoring.clear_event_listeners()``, which
+their own comments flagged as clobbering every other listener in the
+process. This module replaces that pattern:
+
+* :func:`install` registers ONE process-wide listener, idempotently,
+  and re-registers if some other code cleared the global listener list
+  (the footgun, now survivable). ``Job.__init__`` and the test
+  session fixture both call it; calling it again is free.
+* :func:`watch` is what tests use instead of private listeners: a
+  context manager collecting every lowering (count + durations) that
+  fires anywhere in the process while it is open — including
+  background compile threads. Watchers stack and never unregister
+  anything global.
+* :class:`CompileSink` is the per-Job half: the executor marks its
+  compile-bearing call sites with :func:`attribution` (a thread-local
+  scope carrying the job's sink and a plan-signature label), so a
+  lowering that fires inside a marked section lands in that job's
+  sink — per-signature counts and a lowering-duration histogram,
+  surfaced as ``Job.metrics()["compiles"]`` — AND in the job's
+  registry (``compile.lowerings`` counter + ``compile.lowering``
+  histogram, which the OpenMetrics exposition renders) and flight
+  recorder (kind ``compile.xla``). Labels are the AOT-cache plan
+  signature where the control plane already computed it (shape-class
+  attribution: a cache-hit re-admit records ZERO new lowerings under
+  it), and ``plan:<id>`` for static plans, which deliberately skip
+  signature hashing (runtime/executor.py ``_create_runtime``).
+
+The listener body never raises (a telemetry bug must not break a
+compile) and does near-zero work for non-lowering events.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .histogram import LatencyHistogram
+
+LOWERING_EVENT = "/jax/core/compile/jaxpr_to_mlir_module_duration"
+UNATTRIBUTED = "(unattributed)"
+
+_lock = threading.Lock()
+_installed = False
+# open watch() contexts: every lowering in the process feeds each.
+# Appended/removed under _lock; the listener iterates a list() snapshot
+_watchers: List["CompileWatcher"] = []
+# thread-local attribution scope: (CompileSink, label) or None
+_tls = threading.local()
+
+
+def _listener(name: str, secs: float, **_kw) -> None:
+    """The one process-wide jax.monitoring duration listener."""
+    if name != LOWERING_EVENT:
+        return
+    try:
+        with _lock:
+            watchers = list(_watchers)
+        for w in watchers:
+            w._add(secs)
+        scope = getattr(_tls, "scope", None)
+        if scope is not None:
+            sink, label = scope
+            sink._add(label, secs)
+    except Exception:  # noqa: BLE001 — telemetry must not break compiles
+        pass
+
+
+def install() -> None:
+    """Register the listener once; re-register if a stray
+    ``clear_event_listeners()`` wiped it. Idempotent and cheap —
+    call freely."""
+    global _installed
+    import jax
+
+    with _lock:
+        present = False
+        try:
+            from jax._src import monitoring as _m
+
+            present = _listener in _m.get_event_duration_listeners()
+        except Exception:  # noqa: BLE001 — private API moved: trust the flag
+            present = _installed
+        if present:
+            return
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def installed() -> bool:
+    try:
+        from jax._src import monitoring as _m
+
+        return _listener in _m.get_event_duration_listeners()
+    except Exception:  # noqa: BLE001
+        return _installed
+
+
+class CompileWatcher:
+    """A ``watch()`` handle: process-wide lowering count + durations
+    while open. Thread-safe (compiles fire from the run loop AND the
+    background warm-compile pool)."""
+
+    def __init__(self) -> None:
+        self._wlock = threading.Lock()
+        self.durations: List[float] = []
+
+    def _add(self, secs: float) -> None:
+        with self._wlock:
+            self.durations.append(float(secs))
+
+    @property
+    def count(self) -> int:
+        with self._wlock:
+            return len(self.durations)
+
+
+class watch:
+    """``with compile_events.watch() as w: ...; w.count`` — the test
+    surface replacing private listeners + ``clear_event_listeners``."""
+
+    def __enter__(self) -> CompileWatcher:
+        install()
+        self._w = CompileWatcher()
+        with _lock:
+            _watchers.append(self._w)
+        return self._w
+
+    def __exit__(self, *exc) -> bool:
+        with _lock:
+            try:
+                _watchers.remove(self._w)
+            except ValueError:
+                pass
+        return False
+
+
+class attribution:
+    """Thread-local compile-attribution scope for one call section:
+    lowerings fired inside it land in ``sink`` under ``label``.
+    Re-entrant (restores the outer scope on exit); a None sink is a
+    no-op scope (telemetry off)."""
+
+    __slots__ = ("_scope", "_prev")
+
+    def __init__(self, sink: Optional["CompileSink"], label: str) -> None:
+        self._scope = None if sink is None else (sink, label)
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "scope", None)
+        _tls.scope = self._scope
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _tls.scope = self._prev
+        return False
+
+
+class CompileSink:
+    """One Job's compile accounting: per-signature lowering counts and
+    one lowering-duration histogram, mirrored into the job's metrics
+    registry (OpenMetrics rides that) and flight recorder."""
+
+    def __init__(self, registry=None, flightrec=None) -> None:
+        self._slock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._registry = registry
+        self._flightrec = flightrec
+        self._hist = LatencyHistogram()
+        self._total = 0
+        self._total_s = 0.0
+
+    def _add(self, label: str, secs: float) -> None:
+        with self._slock:
+            self._counts[label] = self._counts.get(label, 0) + 1
+            self._total += 1
+            self._total_s += float(secs)
+        self._hist.record_seconds(secs)
+        reg = self._registry
+        if reg is not None:
+            reg.inc("compile.lowerings")
+            reg.record_seconds("compile.lowering", secs)
+        fr = self._flightrec
+        if fr is not None:
+            fr.record(
+                "compile.xla", signature=label,
+                duration_ms=round(float(secs) * 1e3, 3),
+            )
+
+    @property
+    def total(self) -> int:
+        with self._slock:
+            return self._total
+
+    def snapshot(self) -> dict:
+        """``Job.metrics()["compiles"]``: totals, per-signature counts,
+        and the lowering-duration distribution (ms)."""
+        with self._slock:
+            counts = dict(self._counts)
+            total = self._total
+            total_s = self._total_s
+        return {
+            "total_lowerings": total,
+            "total_duration_s": round(total_s, 6),
+            "by_signature": dict(sorted(counts.items())),
+            "lowering_duration": self._hist.snapshot(),
+        }

@@ -172,9 +172,33 @@ def _tenant_of_map(metrics: Dict) -> Dict[str, str]:
     return out
 
 
+def _build_info_labels() -> Dict[str, str]:
+    """The fst_build_info label set: package version, jax version,
+    backend, bench schema version — the standard *_info gauge pattern
+    (value always 1; the labels ARE the payload), so a scraper can
+    join any series against what produced it."""
+    import jax
+
+    import flink_siddhi_tpu as _pkg
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend is still scrapeable
+        backend = "unavailable"
+    return {
+        "package_version": str(getattr(_pkg, "__version__", "0")),
+        "jax_version": str(jax.__version__),
+        "backend": str(backend),
+        "bench_schema_version": str(
+            getattr(_pkg, "BENCH_SCHEMA_VERSION", 0)
+        ),
+    }
+
+
 def render_openmetrics(metrics: Dict) -> str:
     """Render a ``Job.metrics()`` snapshot as Prometheus text."""
     w = _Writer()
+    w.sample(metric_name("build_info"), "gauge", _build_info_labels(), 1)
     w.sample(
         metric_name("processed_events", "_total"), "counter", None,
         metrics.get("processed_events"),

@@ -111,6 +111,18 @@ block carries an ``attribution`` block whose
 Pre-v8 files are exempt; an ``attribution`` block present in any
 version is validated.
 
+Schema v9 (flight-recorder / measured-attribution round, bench.py
+``schema_version: 9``) adds the per-mode ``limiting_leg`` contract:
+every mode section carries the stage ledger folded into the fixed leg
+cover (flink_siddhi_tpu/telemetry/attribution.py), and this gate
+RE-DERIVES the claim — the non-overlapped legs must attribute >= 95%
+of the mode's measured wall-clock window, the declared coverage and
+limiting share must match a recompute from the published per-leg
+seconds, and the named leg must be the argmax over the candidate legs
+(setup and the overlapped decode/sink detail legs are reported but
+never named). Pre-v9 files are exempt; a present block in any version
+is validated.
+
 Optional ``recovery`` block (``bench.py --fault``, any version): when
 present it must carry a finite positive measured ``recovery_time_ms``,
 at least one injected crash, ``stale_tmp_swept: true``, and EXACT
@@ -768,6 +780,121 @@ def validate_attribution(att, errors: List[str], where: str) -> None:
         )
 
 
+def validate_limiting_leg(ll, errors: List[str], where: str) -> None:
+    """The schema-v9 ``limiting_leg`` block: per-leg seconds/shares
+    over the mode's measured wall-clock window, with the verdict
+    RE-DERIVED here — the non-overlapped legs must attribute >= 95%
+    of the window, and the named leg must be the argmax of the
+    published per-leg seconds over the candidate set (everything but
+    ``setup`` and the overlapped fetch-lane legs). A verdict that
+    contradicts its own numbers is a failed claim."""
+    where = f"{where}:limiting_leg"
+    if not isinstance(ll, dict):
+        errors.append(f"{where}: not an object")
+        return
+    if ll.get("telemetry") == "off":
+        return  # explicit BENCH_TELEMETRY=0 opt-out: no contract
+    for key in ("elapsed_s", "coverage", "limiting_share"):
+        if not _finite(ll.get(key)):
+            errors.append(f"{where}: {key} missing/non-numeric")
+            return
+    if ll["elapsed_s"] <= 0:
+        errors.append(f"{where}: elapsed_s must be > 0")
+        return
+    legs = ll.get("legs")
+    if not isinstance(legs, dict) or not legs:
+        errors.append(f"{where}: legs missing/empty")
+        return
+    from flink_siddhi_tpu.telemetry.attribution import (
+        CANDIDATE_LEGS,
+        LEG_STAGES,
+        OVERLAPPED_LEGS,
+    )
+
+    expected = set(LEG_STAGES) | set(OVERLAPPED_LEGS)
+    missing = sorted(expected - set(legs))
+    if missing:
+        errors.append(f"{where}: legs missing from the cover: {missing}")
+        return
+    cover_s = 0.0
+    for name, leg in legs.items():
+        if not isinstance(leg, dict) or not _finite(
+            leg.get("seconds")
+        ) or leg["seconds"] < 0:
+            errors.append(
+                f"{where}: legs[{name!r}].seconds missing/negative"
+            )
+            return
+        if not leg.get("overlapped"):
+            cover_s += leg["seconds"]
+    cov = cover_s / ll["elapsed_s"]
+    if abs(cov - ll["coverage"]) > 0.02:
+        errors.append(
+            f"{where}: declared coverage {ll['coverage']:.4f} != "
+            f"recomputed {cov:.4f} from per-leg seconds"
+        )
+    if cov < MIN_COVERAGE:
+        errors.append(
+            f"{where}: leg cover attributes only {cov:.1%} of the "
+            f"measured window (< {MIN_COVERAGE:.0%}): unattributed "
+            "wall-clock"
+        )
+    named = ll.get("limiting_leg")
+    candidates = {
+        name: legs[name]["seconds"]
+        for name in CANDIDATE_LEGS
+        if name in legs
+    }
+    if named not in candidates:
+        errors.append(
+            f"{where}: limiting_leg {named!r} is not a candidate leg "
+            f"({sorted(candidates)})"
+        )
+        return
+    best = max(candidates.values())
+    # argmax with a rounding-tie tolerance (per-leg seconds are
+    # published rounded to 4 decimals)
+    if candidates[named] < best - max(1e-3, 0.001 * best):
+        top = max(candidates, key=lambda k: candidates[k])
+        errors.append(
+            f"{where}: declared limiting leg {named!r} "
+            f"({candidates[named]}s) is not the argmax — "
+            f"{top!r} measured {candidates[top]}s"
+        )
+    share = candidates[named] / ll["elapsed_s"]
+    if abs(share - ll["limiting_share"]) > 0.02:
+        errors.append(
+            f"{where}: limiting_share {ll['limiting_share']:.4f} != "
+            f"recomputed {share:.4f}"
+        )
+
+
+def validate_v9(doc, errors: List[str], where: str) -> None:
+    """The measured-attribution contract (on top of v3..v8): every
+    mode section carries a gated ``limiting_leg`` block."""
+    modes = doc.get("modes")
+    if not isinstance(modes, dict):
+        return  # v3 validation already reported the missing object
+    for name in V3_MODES:
+        sec = modes.get(name)
+        if not isinstance(sec, dict):
+            continue  # v3 validation already reported it
+        mwhere = f"{where}:modes.{name}"
+        sb = sec.get("stage_breakdown")
+        telemetry_off = (
+            isinstance(sb, dict) and sb.get("telemetry") == "off"
+        )
+        ll = sec.get("limiting_leg")
+        if ll is None:
+            if not telemetry_off:
+                errors.append(
+                    f"{mwhere}: limiting_leg block missing (schema v9 "
+                    "requires the measured bottleneck verdict per mode)"
+                )
+        else:
+            validate_limiting_leg(ll, errors, mwhere)
+
+
 def validate_v8(doc, errors: List[str], where: str) -> None:
     """The per-tenant observability contract (on top of v3..v7). The
     control block itself is validated by validate_v7; here only its
@@ -890,6 +1017,18 @@ def validate_doc(
         # same exemption shape as disorder: v6-era lines need not
         # carry the block, but a present one is held to its contract
         validate_control(doc["control"], errors, where)
+    if version >= 9:
+        validate_v9(doc, errors, where)
+    elif isinstance(doc.get("modes"), dict):
+        # pre-v9 exemption (same shape as disorder/control): a
+        # limiting_leg block present in an older line is still held
+        # to its contract
+        for name, sec in doc["modes"].items():
+            if isinstance(sec, dict) and "limiting_leg" in sec:
+                validate_limiting_leg(
+                    sec["limiting_leg"], errors,
+                    f"{where}:modes.{name}",
+                )
     if version >= 8:
         validate_v8(doc, errors, where)
     elif (

@@ -106,6 +106,30 @@ def _pallas_fallback_gate():
     yield
 
 
+# The permanent compile-telemetry surface (telemetry/compile_events.py)
+# is the suite's ONE jax.monitoring registration: tests that count XLA
+# lowerings use compile_events.watch() instead of registering private
+# listeners — the historical per-test register +
+# clear_event_listeners() teardown clobbered every other listener in
+# the process (the footgun the old test comments flagged). install()
+# is idempotent AND self-healing (re-registers if something cleared
+# the global list), so asserting it here keeps the guarantee live for
+# the whole session.
+@pytest.fixture(scope="session", autouse=True)
+def _compile_events_surface():
+    from flink_siddhi_tpu.telemetry import compile_events
+
+    compile_events.install()
+    yield
+    # a test that calls jax.monitoring.clear_event_listeners() has
+    # reintroduced the footgun this surface replaced — fail loudly
+    assert compile_events.installed(), (
+        "the permanent compile-events listener was cleared mid-session"
+        " (use compile_events.watch() instead of private listeners + "
+        "jax.monitoring.clear_event_listeners())"
+    )
+
+
 # The jitted-step suites run the engine hot loop under jax's transfer
 # guard (runtime/executor.py HOTLOOP_TRANSFER_GUARD): an IMPLICIT
 # host<->device transfer inside run_cycle — a numpy array silently

@@ -810,6 +810,154 @@ def test_v7_era_docs_unaffected_by_v8_gate():
     assert any("conserved must be true" in e for e in errors)
 
 
+# -- schema v9: the measured limiting-leg verdict ---------------------------
+
+
+def _limiting_leg_blk(mode="streaming", **over):
+    blk = {
+        "mode": mode,
+        "elapsed_s": 10.0,
+        "coverage": 0.98,
+        "legs": {
+            "setup": {"seconds": 1.0, "share": 0.1,
+                      "overlapped": False, "stages": ["prewarm"]},
+            "host_staging": {"seconds": 2.0, "share": 0.2,
+                             "overlapped": False,
+                             "stages": ["ingest", "tape_build"]},
+            "h2d": {"seconds": 0.3, "share": 0.03,
+                    "overlapped": False,
+                    "stages": ["stage.h2d_overlap"]},
+            "dispatch": {"seconds": 5.0, "share": 0.5,
+                         "overlapped": False, "stages": ["dispatch"]},
+            "device_compute": {"seconds": 0.5, "share": 0.05,
+                               "overlapped": False,
+                               "stages": ["backpressure_wait"]},
+            "drain_fetch": {"seconds": 1.0, "share": 0.1,
+                            "overlapped": False, "stages": ["drain"]},
+            "decode": {"seconds": 0.4, "share": 0.04,
+                       "overlapped": True,
+                       "stages": ["drain.decode (histogram mass)"]},
+            "sink": {"seconds": 0.1, "share": 0.01,
+                     "overlapped": True, "stages": ["sink"]},
+        },
+        "limiting_leg": "dispatch",
+        "limiting_share": 0.5,
+        "basis": "test fixture",
+    }
+    blk.update(over)
+    return blk
+
+
+def _v9_doc(**over):
+    doc = _v8_doc()
+    doc["schema_version"] = 9
+    for name, sec in doc["modes"].items():
+        sec["limiting_leg"] = _limiting_leg_blk(mode=name)
+    doc.update(over)
+    return doc
+
+
+def test_valid_v9_doc_passes():
+    errors = []
+    CHECK.validate_doc(_v9_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v9_requires_limiting_leg_per_mode():
+    doc = _v9_doc()
+    del doc["modes"]["streaming"]["limiting_leg"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "modes.streaming: limiting_leg block missing" in e
+        for e in errors
+    )
+
+
+def test_v9_named_leg_must_be_argmax():
+    """A verdict contradicting its own published seconds is rejected —
+    the gate re-derives the argmax, a declared name cannot lie."""
+    doc = _v9_doc()
+    doc["modes"]["sink"]["limiting_leg"]["limiting_leg"] = (
+        "host_staging"  # dispatch measured 5.0s, host_staging 2.0s
+    )
+    doc["modes"]["sink"]["limiting_leg"]["limiting_share"] = 0.2
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("is not the argmax" in e for e in errors)
+    # setup and the overlapped legs are never nameable, however large
+    doc = _v9_doc()
+    doc["modes"]["sink"]["limiting_leg"]["limiting_leg"] = "setup"
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("not a candidate leg" in e for e in errors)
+
+
+def test_v9_cover_must_reach_95_percent():
+    blk = _limiting_leg_blk()
+    blk["legs"]["dispatch"]["seconds"] = 1.0  # cover drops to 58%
+    blk["coverage"] = 0.58
+    blk["limiting_leg"] = "host_staging"
+    blk["limiting_share"] = 0.2
+    doc = _v9_doc()
+    doc["modes"]["resident"]["limiting_leg"] = blk
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("attributes only" in e for e in errors)
+    # and a declared coverage that disagrees with the per-leg seconds
+    blk2 = _limiting_leg_blk(coverage=0.99)
+    blk2["legs"]["dispatch"]["seconds"] = 4.0
+    doc = _v9_doc()
+    doc["modes"]["resident"]["limiting_leg"] = blk2
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("declared coverage" in e for e in errors)
+
+
+def test_v9_overlapped_legs_outside_cover():
+    """decode/sink (fetch-lane) seconds must not rescue a failing
+    cover: only non-overlapped legs sum into coverage."""
+    blk = _limiting_leg_blk()
+    blk["legs"]["dispatch"]["seconds"] = 1.0
+    blk["legs"]["decode"]["seconds"] = 6.0  # overlapped: not cover
+    blk["coverage"] = 0.58
+    blk["limiting_leg"] = "host_staging"
+    blk["limiting_share"] = 0.2
+    doc = _v9_doc()
+    doc["modes"]["streaming"]["limiting_leg"] = blk
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("attributes only" in e for e in errors)
+
+
+def test_v9_telemetry_off_exempt():
+    doc = _v9_doc()
+    doc["modes"]["sink"]["stage_breakdown"] = {"telemetry": "off"}
+    doc["modes"]["sink"]["limiting_leg"] = {"telemetry": "off"}
+    # the latency block keeps only the external half under
+    # telemetry-off (same exemption as v3)
+    doc["modes"]["sink"]["latency"].pop("telemetry_p99_ms", None)
+    doc["modes"]["sink"]["latency"]["discrepancy_ratio"] = None
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []
+
+
+def test_v8_era_docs_unaffected_by_v9_gate():
+    """Pre-v9 lines need no limiting_leg, but a present one is held
+    to its contract (same exemption shape as disorder/control)."""
+    errors = []
+    CHECK.validate_doc(_v8_doc(), errors, "doc")
+    assert errors == []
+    doc = _v8_doc()
+    doc["modes"]["streaming"]["limiting_leg"] = _limiting_leg_blk(
+        limiting_leg="h2d", limiting_share=0.03
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("is not the argmax" in e for e in errors)
+
+
 # -- optional recovery block (bench.py --fault) ----------------------------
 
 
@@ -919,15 +1067,16 @@ def test_fault_block_live_and_gate_accepts():
     assert errors == []
 
 
-def test_dryrun_emits_schema_complete_v8(tmp_path):
+def test_dryrun_emits_schema_complete_v9(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
     replay, short paced phase) exercises resident + streaming + sink,
-    the out-of-process prober, the small-skew disorder sweep, AND the
-    control-plane sustained-load run (now with the v8 per-plan
-    attribution block), and its JSON line passes the v8 schema gate —
-    in the tier-1 lane, under its timeout. (The --fault recovery block
-    has its own in-process live test below, so this subprocess stays
-    at its historical cost.)"""
+    the out-of-process prober, the small-skew disorder sweep, the
+    control-plane sustained-load run (with the v8 per-plan
+    attribution block), AND the v9 measured limiting-leg verdict per
+    mode, and its JSON line passes the v9 schema gate — in the tier-1
+    lane, under its timeout. (The --fault recovery block has its own
+    in-process live test below, so this subprocess stays at its
+    historical cost.)"""
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
@@ -976,7 +1125,7 @@ def test_dryrun_emits_schema_complete_v8(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 8
+    assert doc["schema_version"] == 9
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]
@@ -987,6 +1136,22 @@ def test_dryrun_emits_schema_complete_v8(tmp_path):
         assert math.isfinite(lat["telemetry_p99_ms"])
         assert math.isfinite(lat["discrepancy_ratio"])
         assert sec["stage_breakdown"]["coverage"] >= 0.95
+        # the v9 additions: the LIVE limiting-leg block — coverage,
+        # a named leg that is the argmax of its own published
+        # numbers, and the overlapped decode/sink detail legs
+        ll = sec["limiting_leg"]
+        assert ll["mode"] == name
+        assert ll["coverage"] >= 0.95
+        cands = {
+            k: v["seconds"]
+            for k, v in ll["legs"].items()
+            if not v["overlapped"] and k != "setup"
+        }
+        assert ll["limiting_leg"] == max(cands, key=cands.get)
+        assert {"decode", "sink"} <= set(ll["legs"])
+        assert all(
+            ll["legs"][k]["overlapped"] for k in ("decode", "sink")
+        )
     assert "prober_contradiction" not in doc
     # the v4 additions ride the same dryrun line: the columnar sink
     # lane really materialized rows, the latency verdict passed one of

@@ -8,7 +8,6 @@ docs/control_plane.md states the contracts these tests pin."""
 import json
 import urllib.request
 
-import jax
 import numpy as np
 import pytest
 
@@ -188,8 +187,17 @@ def test_aot_cache_hit_on_constants_variant_readmit():
     """The acceptance criterion: after full retire drops the group
     host, re-admitting a constants-only variant re-forms it from the
     AOT executable cache — a measured cache HIT with ZERO new XLA
-    lowerings (the retrace-budget monitoring hook, counted at the
-    jaxpr->MLIR stage so a warm persistent cache cannot mask it)."""
+    lowerings, counted via the PERMANENT compile-telemetry surface
+    (telemetry/compile_events.py; the lowering event fires at the
+    jaxpr->MLIR stage, so a warm persistent cache cannot mask it).
+    Previously this test registered a private jax.monitoring listener
+    and tore down with clear_event_listeners() — the footgun the
+    surface replaced. The same pin now also rides
+    ``Job.metrics()["compiles"]``: the first admit records >= 1
+    attributed lowering with finite duration, the cache-hit re-admit
+    adds ZERO."""
+    from flink_siddhi_tpu.telemetry import compile_events
+
     src = CallbackSource("S", SCHEMA)
     ctrl = ControlQueueSource()
     job = make_job(src, ctrl)
@@ -200,32 +208,41 @@ def test_aot_cache_hit_on_constants_variant_readmit():
     job.run_cycle()
     job.drain_outputs()
     assert job.aot_cache.stats()["misses"] == 1
+    # first admit of the shape class: the permanent surface recorded
+    # its compiles — attributed to the 'dyn:' signature label, with a
+    # finite lowering-duration distribution
+    comp0 = job.metrics()["compiles"]
+    assert comp0["total_lowerings"] >= 1
+    assert comp0["total_duration_s"] > 0
+    assert any(
+        label.startswith("dyn:") for label in comp0["by_signature"]
+    ), comp0["by_signature"]
 
     plane.retire("q1")
     job.run_cycle()
     assert not job._plans  # host dropped; executables stay cached
 
-    lowered = []
-
-    def listener(name, _secs):
-        if name == "/jax/core/compile/jaxpr_to_mlir_module_duration":
-            lowered.append(name)
-
-    jax.monitoring.register_event_duration_secs_listener(listener)
-    try:
+    with compile_events.watch() as w:
         plane.admit(chain_cql(2, 3), plan_id="q2")
         feed(src, 8, 16)
         job.run_cycle()
         job.drain_outputs()
-        assert job.results("out")[-2:] == [(1010, 1011), (1014, 1015)]
-        assert lowered == [], (
-            f"{len(lowered)} executables lowered on a cache-hit "
-            "re-admit — the AOT cache is not serving the shape class"
-        )
-    finally:
-        jax.monitoring.clear_event_listeners()
+    assert job.results("out")[-2:] == [(1010, 1011), (1014, 1015)]
+    assert w.count == 0, (
+        f"{w.count} executables lowered on a cache-hit re-admit — "
+        "the AOT cache is not serving the shape class"
+    )
+    # the job's own accounting agrees: zero new attributed lowerings
+    comp1 = job.metrics()["compiles"]
+    assert comp1["total_lowerings"] == comp0["total_lowerings"]
     stats = job.aot_cache.stats()
     assert stats["hits"] == 1 and stats["misses"] == 1
+    # and the cache traffic is journaled: one miss then one hit for
+    # the same shape-class signature (telemetry/flightrec.py)
+    hits = job.flightrec.events(kind="aotcache.hit")
+    misses = job.flightrec.events(kind="aotcache.miss")
+    assert len(hits) == 1 and len(misses) == 1
+    assert hits[0]["signature"] == misses[0]["signature"]
 
 
 def test_cache_eviction_is_bounded_and_counted():

@@ -439,6 +439,31 @@ def test_prometheus_route_serves_text_format():
         svc.stop()
 
 
+def test_build_info_gauge_present_and_parses():
+    """Satellite (ISSUE 15): the exposition carries the standard
+    *_info gauge — package version, jax version, backend, bench schema
+    version as labels, value 1 — and the whole document still parses
+    under the standalone text-format checker."""
+    import jax
+
+    import flink_siddhi_tpu as pkg
+
+    job = _job_for("streaming")
+    text = job.openmetrics()
+    n_samples, types = check_prometheus_text(text)
+    assert n_samples > 0
+    assert types.get("fst_build_info") == "gauge"
+    m = re.search(r"^fst_build_info\{([^}]*)\} 1$", text, re.M)
+    assert m, "fst_build_info sample missing"
+    labels = dict(_LABEL_RE.findall(m.group(1)))
+    assert labels["package_version"] == pkg.__version__
+    assert labels["jax_version"] == jax.__version__
+    assert labels["backend"] == "cpu"
+    assert labels["bench_schema_version"] == str(
+        pkg.BENCH_SCHEMA_VERSION
+    )
+
+
 def test_checker_rejects_malformed_text():
     """The checker itself must actually check (a checker that accepts
     anything proves nothing)."""
