@@ -5,7 +5,7 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line (``schema_version: 9``). One invocation measures
+Prints ONE JSON line (``schema_version: 10``). One invocation measures
 THREE execution modes and emits all of them in the same document, so a
 regression in any path stays a tracked number:
 
@@ -134,6 +134,18 @@ kill-mid-checkpoint) reporting measured ``recovery_time_ms`` and
 ``events_replayed``, with ``duplicate_rows`` / ``lost_rows`` counted
 against an unfaulted oracle (both must be 0 — the schema gate rejects
 anything else). BENCH_FAULT_EVENTS / BENCH_FAULT_BATCH size it.
+
+Schema v10 (transactional-sink round) requires the ``recovery`` block
+to carry a ``transactional`` sub-block: a second supervised run whose
+output leaves the process through a KIP-98 transactional KafkaSink
+(runtime/kafka.py) into the fake broker's transaction coordinator,
+with the crash schedule extended by a kill-mid-TRANSACTION (after the
+durable snapshot, before EndTxn) — the external read-committed topic
+is then diffed against the unfaulted oracle, and
+``read_committed_duplicates`` / ``read_committed_lost`` must both be
+0 with a finite measured ``recovery_time_ms`` (the gate rejects
+anything else). BENCH_FAULT_TXN_EVENTS / BENCH_FAULT_TXN_BATCH size
+it.
 
 Honest wall-clock accounting: every mode section carries a
 ``stage_breakdown`` computed from the telemetry subsystem
@@ -1014,8 +1026,165 @@ def _fault_recovery_block(dryrun):
             "exactly_once": committed == oracle_rows,
             "stale_tmp_swept": _glob.glob(f"{ckpt}.tmp.*") == [],
             "elapsed_s": round(elapsed, 3),
+            # schema v10: the end-to-end transactional leg — the same
+            # crash zoo, but the rows leave the process through a
+            # KIP-98 transactional sink and the exactly-once diff runs
+            # against the EXTERNAL read-committed topic
+            "transactional": _transactional_sink_block(dryrun),
         }
     finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+def _transactional_sink_block(dryrun):
+    """Schema v10 sub-block of ``recovery``: exactly-once measured at
+    the EXTERNAL boundary. A supervised run writes every output row
+    through a transactional KafkaSink (one transaction per checkpoint
+    epoch, committed only after the snapshot is durable) into the fake
+    broker's KIP-98 transaction coordinator, under a crash schedule
+    that adds the new failure mode: a kill-mid-TRANSACTION, between
+    the durable snapshot and EndTxn — restore must RESUME that commit,
+    not repeat or drop it. The read-committed topic is then diffed
+    row-for-row against an unfaulted oracle
+    (``read_committed_duplicates`` / ``read_committed_lost``, both
+    gated to 0 by scripts/check_bench_schema.py), while
+    read_uncommitted must show strictly MORE rows — the aborted debris
+    the dead runs left proves the kills hit data-bearing
+    transactions."""
+    import collections
+    import shutil
+    import tempfile
+
+    from flink_siddhi_tpu import CEPEnvironment
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.faultinject import CrashPlan, wrap_job
+    from flink_siddhi_tpu.runtime.kafka import KafkaSink
+    from flink_siddhi_tpu.runtime.sources import ReplayBatchSource
+    from flink_siddhi_tpu.runtime.supervisor import Supervisor
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+    from tests.fake_kafka import FakeBroker, read_topic
+
+    n = int(
+        os.environ.get(
+            "BENCH_FAULT_TXN_EVENTS", 8_192 if dryrun else 40_000
+        )
+    )
+    batch = int(
+        os.environ.get(
+            "BENCH_FAULT_TXN_BATCH", 1_024 if dryrun else 4_096
+        )
+    )
+    env = CEPEnvironment(batch_size=batch)
+    schema = StreamSchema(
+        [
+            ("id", AttributeType.INT),
+            ("name", AttributeType.STRING),
+            ("price", AttributeType.DOUBLE),
+            ("timestamp", AttributeType.LONG),
+        ],
+        shared_strings=env.shared_strings,
+    )
+    cql = (
+        "from inputStream#window.length(64) "
+        "select id, sum(price) as total insert into matches"
+    )
+    batches = make_batches(n, batch, schema, "inputStream")
+    # the new kill in the zoo: at_commits fires AFTER the snapshot is
+    # durable and recorded but BEFORE EndTxn reaches the coordinator —
+    # the prepared transaction must be resume-committed on restore
+    crash = CrashPlan(
+        at_pulls=(3,), at_checkpoints=(2,), at_commits=(1,)
+    )
+    broker = FakeBroker()
+    broker.create_topic("bench_txn")
+
+    def build(faulted):
+        src = ReplayBatchSource("inputStream", schema, batches)
+        plan = compile_plan(
+            cql, {"inputStream": schema}, plan_id="bench_fault_txn"
+        )
+        job = Job(
+            [plan], [src], batch_size=batch, retain_results=False
+        )
+        job.telemetry.enabled = _telemetry_enabled()
+        if faulted:
+            job.add_sink(
+                "matches",
+                KafkaSink(
+                    broker.bootstrap, "bench_txn", ["id", "total"],
+                    stream_id="matches",
+                    transactional_id="bench-tx", flush_every=256,
+                ),
+            )
+            return wrap_job(job, crash)
+        return job
+
+    oracle_rows = collections.Counter()
+    oracle = build(faulted=False)
+    oracle.add_sink(
+        "matches",
+        lambda ts, row: oracle_rows.update([(ts, row[0], row[1])]),
+    )
+    oracle.run()
+    oracle.flush()
+
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_fault_txn_")
+    ckpt = os.path.join(ckpt_dir, "ckpt")
+    try:
+        sup = Supervisor(
+            lambda: build(faulted=True), ckpt,
+            checkpoint_every_cycles=2, keep_checkpoints=2,
+            max_restarts=8, restart_window_s=3600.0,
+        )
+        t0 = time.perf_counter()
+        sup.run()
+        elapsed = time.perf_counter() - t0
+        committed = collections.Counter(
+            (d["ts"], d["id"], d["total"])
+            for d in (
+                json.loads(v)
+                for v in read_topic(
+                    broker.bootstrap, "bench_txn", committed=True
+                )
+            )
+        )
+        uncommitted = read_topic(
+            broker.bootstrap, "bench_txn", committed=False
+        )
+        return {
+            "events": n,
+            "crash_pulls": sorted(crash.at_pulls),
+            "kill_mid_checkpoint": True,
+            "kill_mid_transaction": True,
+            "crashes": sup.restart_count,
+            "restarts": sup.restart_count,
+            "recovery_time_ms": (
+                round(sup.last_recovery_ms, 3)
+                if sup.last_recovery_ms is not None
+                else None
+            ),
+            "rows_emitted": sum(committed.values()),
+            # exactly-once at the EXTERNAL boundary: what a
+            # read-committed consumer of the broker actually sees
+            "read_committed_duplicates": sum(
+                (committed - oracle_rows).values()
+            ),
+            "read_committed_lost": sum(
+                (oracle_rows - committed).values()
+            ),
+            "exactly_once": committed == oracle_rows,
+            # the kills really hit data-bearing transactions: the
+            # aborted suffixes are visible to read_uncommitted only
+            "read_uncommitted_rows": len(uncommitted),
+            "aborted_rows_invisible": (
+                len(uncommitted) > sum(committed.values())
+            ),
+            "elapsed_s": round(elapsed, 3),
+        }
+    finally:
+        broker.close()
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
 

@@ -27,11 +27,22 @@ and error semantics live in one place:
   unsupported codecs, offset-out-of-range, oversized messages,
   authorization failures. These propagate immediately.
 
-Produce retries are **at-least-once**: a request that failed after the
-broker appended it is re-sent on retry (no idempotent-producer
-sequence numbers). Exactly-once output therefore lives a layer up, in
-the supervisor's checkpoint-commit protocol (runtime/supervisor.py),
-not in the produce path.
+Produce retries on the PLAIN (non-transactional) path are
+**at-least-once**: a request that failed after the broker appended it
+is re-sent on retry with no sequence number to dedupe against.
+The transactional path (connectors/kafka/txn.py + a ``KafkaSink``
+built with ``transactional_id=...``) closes that hole: batches carry
+producer_id/epoch/sequence, a re-sent batch the broker already holds
+is acknowledged as ``DUPLICATE_SEQUENCE_NUMBER`` (success, not a
+duplicate append), and transactions commit exactly when the
+supervisor's checkpoint-commit protocol commits. The old caveat
+still applies to sinks WITHOUT a transactional id.
+
+One transactional code is deliberately fatal-with-its-own-class:
+``ProducerFencedError`` (INVALID_PRODUCER_EPOCH). A fenced producer
+is a zombie — a newer incarnation holds its transactional id — and a
+fenced producer that retries is exactly the split-brain duplicate
+writer the epoch exists to prevent. It must crash, never retry.
 """
 
 from __future__ import annotations
@@ -75,10 +86,15 @@ RETRYABLE_BROKER_CODES = {
     16: "NOT_COORDINATOR",
     19: "NOT_ENOUGH_REPLICAS",
     20: "NOT_ENOUGH_REPLICAS_AFTER_APPEND",
+    51: "CONCURRENT_TRANSACTIONS",  # prior txn still completing
 }
 
 # Named fatal codes (for messages only — ANY code not in the retryable
-# table is treated as fatal, named or not).
+# table is treated as fatal, named or not). The transactional block
+# (45..53) is fatal by design: an out-of-order sequence means the
+# idempotence window was lost, a stale epoch means this producer is a
+# fenced zombie, a state-machine violation means the caller's commit
+# protocol is broken — none of these can succeed on retry.
 FATAL_BROKER_CODES = {
     1: "OFFSET_OUT_OF_RANGE",
     4: "INVALID_FETCH_SIZE",
@@ -88,7 +104,22 @@ FATAL_BROKER_CODES = {
     29: "TOPIC_AUTHORIZATION_FAILED",
     30: "GROUP_AUTHORIZATION_FAILED",
     31: "CLUSTER_AUTHORIZATION_FAILED",
+    45: "OUT_OF_ORDER_SEQUENCE_NUMBER",
+    46: "DUPLICATE_SEQUENCE_NUMBER",  # produce path treats as success
+    47: "INVALID_PRODUCER_EPOCH",  # raised as ProducerFencedError
+    48: "INVALID_TXN_STATE",
+    49: "INVALID_PRODUCER_ID_MAPPING",
+    53: "TRANSACTIONAL_ID_AUTHORIZATION_FAILED",
 }
+
+#: INVALID_PRODUCER_EPOCH — the fencing code (KIP-98).
+PRODUCER_FENCED_CODE = 47
+#: DUPLICATE_SEQUENCE_NUMBER — the broker already holds this batch;
+#: the idempotent produce path treats it as a successful append.
+DUPLICATE_SEQUENCE_CODE = 46
+#: INVALID_TXN_STATE — on a resumed EndTxn(commit) this means the
+#: commit already happened before the crash (see runtime/kafka.py).
+INVALID_TXN_STATE_CODE = 48
 
 
 def broker_code_name(code: int) -> str:
@@ -110,6 +141,30 @@ class BrokerErrorResponse(KafkaError):
     @property
     def retryable(self) -> bool:  # type: ignore[override]
         return self.code in RETRYABLE_BROKER_CODES
+
+
+class ProducerFencedError(BrokerErrorResponse):
+    """This producer's (transactional_id, epoch) was superseded —
+    a newer incarnation ran InitProducerId on the same id. FATAL:
+    retrying from a fenced producer is the split-brain duplicate
+    writer the epoch fence exists to prevent. The only correct
+    response is to stop producing and let the current incarnation
+    own the id."""
+
+    #: Shadows BrokerErrorResponse's computed property: fenced is
+    #: fatal no matter what any retry table says.
+    retryable = False
+
+    def __init__(self, message: str, api: str = "") -> None:
+        super().__init__(message, code=PRODUCER_FENCED_CODE, api=api)
+
+
+def broker_error(message: str, code: int, api: str = "") -> BrokerErrorResponse:
+    """Build the right exception for a broker error code — the single
+    place the fencing code is promoted to its own class."""
+    if int(code) == PRODUCER_FENCED_CODE:
+        return ProducerFencedError(message, api=api)
+    return BrokerErrorResponse(message, code=code, api=api)
 
 
 def is_retryable(exc: BaseException) -> bool:

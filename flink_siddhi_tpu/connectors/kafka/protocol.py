@@ -31,15 +31,26 @@ API_FETCH = 1
 API_LIST_OFFSETS = 2
 API_METADATA = 3
 API_VERSIONS = 18
+API_INIT_PRODUCER_ID = 22
+API_ADD_PARTITIONS_TO_TXN = 24
+API_END_TXN = 26
 
 # api -> versions this codebase implements, best first. Produce v3 /
 # Fetch v4 are the first versions whose record sets are v2 batches.
+# The transactional trio (22/24/26, KIP-98) negotiates v0; a broker
+# that predates them falls back to "v0" too (negotiate's blanket
+# rule), so the transactional produce path must check the broker
+# actually ADVERTISED them before relying on the dialect — see
+# runtime/kafka.py's transactional preflight.
 IMPLEMENTED: Dict[int, Tuple[int, ...]] = {
     API_PRODUCE: (3, 0),
     API_FETCH: (4, 0),
     API_LIST_OFFSETS: (0,),
     API_METADATA: (0,),
     API_VERSIONS: (0,),
+    API_INIT_PRODUCER_ID: (0,),
+    API_ADD_PARTITIONS_TO_TXN: (0,),
+    API_END_TXN: (0,),
 }
 
 

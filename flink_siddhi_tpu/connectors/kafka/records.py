@@ -46,7 +46,13 @@ MAGIC_V2 = 2
 
 # attributes bits (magic 2)
 _CODEC_MASK = 0x07
+_FLAG_TXN = 0x10
 _FLAG_CONTROL = 0x20
+
+# control-record marker types (KIP-98): the key of a control record is
+# ``version:int16 type:int16``; type 1 commits, type 0 aborts.
+CONTROL_ABORT = 0
+CONTROL_COMMIT = 1
 
 _NO_TIMESTAMP = -1
 
@@ -166,6 +172,10 @@ def encode_record_batch(
     base_offset: int = 0,
     codec: int = CODEC_NONE,
     producer_id: int = -1,
+    producer_epoch: int = -1,
+    base_sequence: int = -1,
+    transactional: bool = False,
+    control: bool = False,
 ) -> bytes:
     """Encode one RecordBatch.
 
@@ -174,6 +184,13 @@ def encode_record_batch(
     record section is compressed with ``codec`` (codecs.py id); the
     batch header, including the record count, stays uncompressed so
     brokers and clients can account records without inflating.
+
+    ``producer_id``/``producer_epoch``/``base_sequence`` are the
+    KIP-98 idempotence fields (``-1`` = non-idempotent, the classic
+    path). ``transactional`` sets attributes bit 0x10 (the batch is
+    invisible to read-committed consumers until its transaction's
+    commit marker lands); ``control`` sets bit 0x20 (the batch holds
+    transaction markers, not data).
     """
     if not records:
         raise ValueError("record batch needs at least one record")
@@ -187,6 +204,10 @@ def encode_record_batch(
         encoded += _encode_record(i, ts - base_ts, key, value, headers)
     payload = compress(codec, bytes(encoded))
     attrs = codec & _CODEC_MASK
+    if transactional:
+        attrs |= _FLAG_TXN
+    if control:
+        attrs |= _FLAG_CONTROL
     # header from attributes onward is what the CRC covers
     after_crc = (
         struct.pack(
@@ -196,8 +217,8 @@ def encode_record_batch(
             base_ts,
             max_ts,
             producer_id,
-            -1,  # producerEpoch
-            -1,  # baseSequence
+            producer_epoch,
+            base_sequence,
             len(records),
         )
         + payload
@@ -205,6 +226,72 @@ def encode_record_batch(
     crc = crc32c(after_crc)
     body = struct.pack(">iBI", 0, MAGIC_V2, crc) + after_crc
     return struct.pack(">qi", base_offset, len(body)) + body
+
+
+def encode_control_batch(
+    base_offset: int,
+    producer_id: int,
+    producer_epoch: int,
+    commit: bool,
+    ts_ms: int = 0,
+) -> bytes:
+    """One transaction marker (COMMIT or ABORT) as a control batch.
+
+    The marker's key is ``version:int16 type:int16`` (type 1 commit,
+    0 abort), its value ``version:int16 coordinator_epoch:int32`` —
+    both ignored by this client's decode path (control payloads are
+    nulled), but encoded faithfully so the on-wire bytes are real.
+    Control batches are transactional and carry the producer's
+    id/epoch; their base_sequence is -1 (markers don't consume
+    sequence numbers)."""
+    marker = CONTROL_COMMIT if commit else CONTROL_ABORT
+    key = struct.pack(">hh", 0, marker)
+    value = struct.pack(">hi", 0, 0)
+    return encode_record_batch(
+        [(ts_ms, key, value)],
+        base_offset=base_offset,
+        producer_id=producer_id,
+        producer_epoch=producer_epoch,
+        transactional=True,
+        control=True,
+    )
+
+
+def decode_batch_meta(data: bytes, pos: int = 0) -> dict:
+    """Header fields of the magic-2 batch at ``pos``, without decoding
+    (or validating) the record payload — what a broker needs to route
+    a produce (producer id/epoch/sequence, transactional bit) and a
+    read-committed consumer needs to attribute a batch to its
+    transaction. Raises ``CorruptBatchError`` on truncation or wrong
+    magic; CRC is NOT checked here (use ``decode_record_batch`` for
+    that)."""
+    if pos + 61 > len(data):
+        raise CorruptBatchError("truncated record batch header")
+    base_offset, batch_len = struct.unpack_from(">qi", data, pos)
+    _epoch, magic, _crc = struct.unpack_from(">iBI", data, pos + 12)
+    if magic != MAGIC_V2:
+        raise CorruptBatchError(f"not a v2 batch (magic {magic})")
+    (
+        attrs,
+        last_off_delta,
+        _base_ts,
+        _max_ts,
+        producer_id,
+        producer_epoch,
+        base_seq,
+        n_records,
+    ) = struct.unpack_from(">hiqqqhii", data, pos + 21)
+    return {
+        "base_offset": int(base_offset),
+        "length": int(batch_len) + 12,  # whole frame, header included
+        "last_offset": int(base_offset) + int(last_off_delta),
+        "records": int(n_records),
+        "producer_id": int(producer_id),
+        "producer_epoch": int(producer_epoch),
+        "base_sequence": int(base_seq),
+        "transactional": bool(attrs & _FLAG_TXN),
+        "control": bool(attrs & _FLAG_CONTROL),
+    }
 
 
 def _decode_record(

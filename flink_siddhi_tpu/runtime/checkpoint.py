@@ -111,6 +111,22 @@ def snapshot_job(job) -> Dict[str, Any]:
     routers = {
         pid: r.state_dict() for pid, r in getattr(job, "_routers", {}).items()
     }
+    # transactional sinks (runtime/kafka.py KafkaSink): the pending
+    # transaction's identity — stamped by prepare_commit just before
+    # this capture — rides the snapshot, keyed by (output stream,
+    # attach index). Attach order is deterministic per factory, so the
+    # index addresses the same sink on a rebuilt job; sinks without
+    # state_dict (plain closures, the supervisor's commit buckets)
+    # occupy indices but contribute nothing.
+    sinks = {}
+    for sid, fns in getattr(job, "_sinks", {}).items():
+        per = {}
+        for i, fn in enumerate(fns):
+            sd = getattr(fn, "state_dict", None)
+            if sd is not None:
+                per[i] = sd()
+        if per:
+            sinks[sid] = per
     return {
         "version": FORMAT_VERSION,
         "epoch_ms": job._epoch_ms,
@@ -138,6 +154,7 @@ def snapshot_job(job) -> Dict[str, Any]:
         "control_pending": list(job._control_pending),
         "sources": sources,
         "routers": routers,
+        "sinks": sinks,
         # dynamically-added queries (control plane): CQL + group slot map
         # so restore can replay them into identical runtimes/slots
         "dynamic": {
@@ -349,6 +366,25 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
     fr = getattr(job, "flightrec", None)
     if fr is not None and snap.get("flightrec"):
         fr.restore_state(snap["flightrec"])
+
+    # 7. transactional sinks — AFTER the journal adoption above,
+    # deliberately: load_state_dict RESUMES the snapshot's pending
+    # commit (a real EndTxn against the broker, not a reconstruction)
+    # and re-runs InitProducerId to fence the pre-crash zombie; the
+    # txn.commit / session events those record are genuinely new
+    # actions of the restored run and must EXTEND the adopted journal,
+    # not be overwritten by it. Missing indices are skipped: a rebuilt
+    # job legitimately may attach fewer sinks (results-only replay).
+    sinks_snap = snap.get("sinks") or {}
+    for sid, per in sinks_snap.items():
+        fns = getattr(job, "_sinks", {}).get(sid, [])
+        for i, sd in per.items():
+            i = int(i)
+            if i >= len(fns):
+                continue
+            load = getattr(fns[i], "load_state_dict", None)
+            if load is not None:
+                load(sd)
 
 
 def _check_compatible(ref, restored, plan_id: str) -> None:

@@ -1641,6 +1641,16 @@ class Job:
         attached are counted but not delivered, rows after are."""
         for rt in self._plans.values():
             self._drain_poll(rt, block=True)
+        # observability handles are ephemeral on the sink side
+        # (fst:ephemeral there): binding at attach time is what keeps a
+        # restored / re-attached sink journaling into THIS job's
+        # recorder and counting into THIS job's registry
+        bind_t = getattr(fn, "bind_telemetry", None)
+        if bind_t is not None:
+            bind_t(self.telemetry)
+        bind_f = getattr(fn, "bind_flightrec", None)
+        if bind_f is not None:
+            bind_f(self.flightrec)
         self._sinks.setdefault(output_stream, []).append(fn)
 
     def reset_engine_state(self) -> None:
@@ -3433,6 +3443,32 @@ class Job:
 
     # -- checkpoint / restore (exceeds the reference: restore of engine
     # state was an abandoned TODO there, AbstractSiddhiOperator.java:341) --
+    def _prepare_sink_commits(self) -> None:
+        """Phase one of the transactional-sink commit protocol
+        (runtime/kafka.py KafkaSink): after the drain surfaced every
+        row, each capable sink flushes them into its open transaction
+        and stamps the transaction pending, so the snapshot about to
+        be captured carries its identity. Sinks without the hook are
+        untouched."""
+        for sinks in self._sinks.values():
+            for s in sinks:
+                prep = getattr(s, "prepare_commit", None)
+                if prep is not None:
+                    prep()
+
+    def commit_sink_transactions(self) -> None:
+        """Phase two, driven by the supervisor only once the snapshot
+        that will never re-emit the pending rows is durably on disk:
+        EndTxn(commit) on every transactional sink. A crash BEFORE
+        this call is healed at restore — the snapshot's pending
+        identity is resumed; a crash AFTER it finds the transaction
+        already closed (INVALID_TXN_STATE, treated as committed)."""
+        for sinks in self._sinks.values():
+            for s in sinks:
+                commit = getattr(s, "commit_transaction", None)
+                if commit is not None:
+                    commit()
+
     # fst:runloop-only (drains + reads device state)
     def snapshot(self) -> Dict:
         from .checkpoint import snapshot_job
@@ -3440,6 +3476,11 @@ class Job:
         # accumulated-but-undrained emissions are not part of the snapshot;
         # surface them to collectors/sinks first so nothing is lost
         self.drain_outputs()
+        # transactional sinks: flush the drained rows into the open
+        # transaction and stamp it pending BEFORE the capture, so the
+        # snapshot carries the transaction identity (checkpoint.py
+        # "sinks" block) — the restore side resumes exactly that commit
+        self._prepare_sink_commits()
         return snapshot_job(self)
 
     # fst:runloop-only (drains + captures device state)
@@ -3451,8 +3492,10 @@ class Job:
 
         from .checkpoint import save
 
-        # same contract as snapshot(): surface accumulated emissions first
+        # same contract as snapshot(): surface accumulated emissions
+        # first, then phase one of the transactional-sink protocol
         self.drain_outputs()
+        self._prepare_sink_commits()
         # journal BEFORE the state capture: the save event itself is
         # part of the snapshot, so a restored journal shows the save
         # that produced it (exactly once). fspath, not the raw

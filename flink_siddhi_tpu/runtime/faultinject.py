@@ -16,7 +16,10 @@ disorder oracle tests (tests/test_event_time.py) and ``bench.py
 
 :class:`CrashPlan` + :func:`wrap_job` inject crashes into a SUPERVISED
 job: at scheduled source-pull boundaries (mode-agnostic: streaming
-``run_cycle`` and resident ``stage`` both pull), and killed
+``run_cycle`` and resident ``stage`` both pull), killed
+MID-transaction (after the snapshot commits, before the
+transactional sinks' EndTxn — the window the KIP-98 resume-commit
+protocol exists to close), and killed
 MID-checkpoint — a half-written ``*.tmp.*`` sibling is left behind
 (exactly what a process death between the temp write and the atomic
 replace leaves) and the crash raises BEFORE the replace, so the
@@ -55,17 +58,26 @@ class CrashPlan:
     mode). ``at_checkpoints``: kill the Nth checkpoint attempt
     (1-based) mid-write — a garbage ``*.tmp.*`` sibling appears (as a
     dying writer leaves) and the crash fires BEFORE the atomic
-    replace, so the previous good generation survives."""
+    replace, so the previous good generation survives.
+    ``at_commits``: kill the Nth sink-transaction commit (1-based)
+    BEFORE EndTxn fires — the narrowest exactly-once window: the
+    snapshot is already durable and the supervisor's internal rows
+    already promoted, but the external transaction is still open. The
+    restored job must RESUME that exact commit (not re-emit) for a
+    read-committed consumer to stay 0-dup/0-lost."""
 
     def __init__(
         self,
         at_pulls: Sequence[int] = (),
         at_checkpoints: Sequence[int] = (),
+        at_commits: Sequence[int] = (),
     ) -> None:
         self.at_pulls = frozenset(int(i) for i in at_pulls)
         self.at_checkpoints = frozenset(int(i) for i in at_checkpoints)
+        self.at_commits = frozenset(int(i) for i in at_commits)
         self.pulls = 0
         self.checkpoints = 0
+        self.commits = 0
         self.crashes = 0
 
     def tick_pull(self) -> None:
@@ -73,6 +85,12 @@ class CrashPlan:
         if self.pulls in self.at_pulls:
             self.crashes += 1
             raise InjectedCrash(f"killed at source pull {self.pulls}")
+
+    def will_kill_checkpoint(self) -> bool:
+        """Whether the NEXT checkpoint attempt is scheduled to die —
+        wrap_job peeks so it can replay the steps a real save runs
+        before the mid-write death (drain + transactional prepare)."""
+        return (self.checkpoints + 1) in self.at_checkpoints
 
     def tick_checkpoint(self, path: str) -> None:
         self.checkpoints += 1
@@ -84,6 +102,17 @@ class CrashPlan:
                 f.write(b"partial checkpoint debris")
             raise InjectedCrash(
                 f"killed mid-checkpoint {self.checkpoints}"
+            )
+
+    def tick_commit(self) -> None:
+        self.commits += 1
+        if self.commits in self.at_commits:
+            self.crashes += 1
+            # after the snapshot's durable replace, before EndTxn:
+            # the transaction the snapshot stamped pending stays OPEN
+            # on the broker until the restored sink resumes the commit
+            raise InjectedCrash(
+                f"killed mid-transaction at commit {self.commits}"
             )
 
 
@@ -338,15 +367,34 @@ def wrap_job(job, plan: CrashPlan):
     level wraps; the plan itself persists across factory rebuilds)."""
     orig_pull = job._pull_sources
     orig_save = job.save_checkpoint
+    orig_commit = job.commit_sink_transactions
 
     def pull_sources():
         plan.tick_pull()
         return orig_pull()
 
     def save_checkpoint(path, keep=1):
+        if plan.will_kill_checkpoint():
+            # a mid-WRITE death (what the tmp debris simulates)
+            # happens after the real save's first steps — the drain
+            # and the transactional prepare — so run them before
+            # raising: rows are then already flushed into the open
+            # transaction whose identity the never-completed snapshot
+            # would have carried. The restored job must ABORT that
+            # orphan (eager InitProducerId on the epoch id), never
+            # resume it — the abort half of the exactly-once claim.
+            job.drain_outputs()
+            prep = getattr(job, "_prepare_sink_commits", None)
+            if prep is not None:
+                prep()
         plan.tick_checkpoint(path)
         return orig_save(path, keep=keep)
 
+    def commit_sink_transactions():
+        plan.tick_commit()
+        return orig_commit()
+
     job._pull_sources = pull_sources
     job.save_checkpoint = save_checkpoint
+    job.commit_sink_transactions = commit_sink_transactions
     return job

@@ -23,6 +23,10 @@ KIP-35) and speaks the newest dialect both sides implement:
   optional compression codec; v0 sends CRC32 message sets, acks=1
 * ApiVersions(api 18, v0)     — brokers that slam the connection are
   taken at their word and get the v0 dialect
+* InitProducerId (22, v0), AddPartitionsToTxn (24, v0), EndTxn
+  (26, v0) — the KIP-98 transactional trio (connectors.kafka.txn);
+  only issued against brokers that ADVERTISE them (no v0 fallback:
+  a pre-transactions broker cannot speak these at any version)
 
 Offsets are first-class source positions: ``KafkaSource.state_dict``
 returns the per-partition next-fetch offsets and participates in the
@@ -54,14 +58,21 @@ from ..connectors.kafka.errors import (
     BrokerClosedError,
     BrokerErrorResponse,
     BrokerIOError,
+    DUPLICATE_SEQUENCE_CODE,
+    INVALID_TXN_STATE_CODE,
     KafkaError,
+    ProducerFencedError,
     broker_code_name,
+    broker_error,
     is_connection_error,
     is_retryable,
 )
 from ..connectors.kafka.retry import RetryPolicy
 from ..connectors.kafka.protocol import (
+    API_ADD_PARTITIONS_TO_TXN,
+    API_END_TXN,
     API_FETCH,
+    API_INIT_PRODUCER_ID,
     API_LIST_OFFSETS,
     API_METADATA,
     API_PRODUCE,
@@ -73,9 +84,22 @@ from ..connectors.kafka.protocol import (
     request_header,
 )
 from ..connectors.kafka.records import (
+    MAGIC_V2,
+    decode_batch_meta,
+    decode_record_batch,
     decode_record_set,
     encode_message_set,
     encode_record_batch,
+)
+from ..connectors.kafka.txn import (
+    DEFAULT_TXN_TIMEOUT_MS,
+    TransactionState,
+    decode_add_partitions_response,
+    decode_end_txn_response,
+    decode_init_producer_id_response,
+    encode_add_partitions_request,
+    encode_end_txn_request,
+    encode_init_producer_id_request,
 )
 from ..schema.batch import EventBatch
 from ..schema.stream_schema import StreamSchema
@@ -89,6 +113,7 @@ __all__ = [
     "KafkaError",
     "KafkaSink",
     "KafkaSource",
+    "ProducerFencedError",
     "RetryPolicy",
 ]
 
@@ -111,6 +136,49 @@ DEFAULT_RETRY = RetryPolicy(
 # recovering broker — the stampede the jitter exists to prevent.
 # Deterministic per process (a plain counter), distinct per client.
 _CLIENT_SEQ = itertools.count()
+
+
+def _decode_committed(
+    rset: bytes, aborted: List[Tuple[int, int]]
+) -> List:
+    """Read-committed decode of a fetch record set (KIP-98 consumer
+    algorithm): walk batches in offset order with the response's
+    aborted-transactions index ``[(producer_id, first_offset)]`` —
+    when a batch's base offset reaches an index entry, that producer's
+    transactional data is aborted until its next control batch (the
+    marker) clears it. Aborted data records keep their offsets but
+    lose their payloads (``value=None``), exactly like control
+    records, so consumers advance past them without observing them."""
+    pending = sorted(aborted, key=lambda e: e[1])
+    active: set = set()
+    out: List = []
+    pos, n = 0, len(rset)
+    while pos + 17 <= n:
+        size = struct.unpack_from(">i", rset, pos + 8)[0]
+        if pos + 12 + size > n:
+            break  # partial trailing entry (Fetch max_bytes cut)
+        magic = rset[pos + 16]
+        if magic != MAGIC_V2:
+            # legacy entries predate transactions: always committed
+            out.extend(decode_record_set(rset[pos : pos + 12 + size]))
+            pos += 12 + size
+            continue
+        meta = decode_batch_meta(rset, pos)
+        while pending and pending[0][1] <= meta["base_offset"]:
+            active.add(pending.pop(0)[0])
+        records, pos = decode_record_batch(rset, pos)
+        if meta["control"]:
+            # the marker ends its producer's transaction in this
+            # partition; records are already nulled by the decoder
+            active.discard(meta["producer_id"])
+            out.extend(records)
+        elif meta["transactional"] and meta["producer_id"] in active:
+            out.extend(
+                (off, ts, None, None) for off, ts, _k, _v in records
+            )
+        else:
+            out.extend(records)
+    return out
 
 
 # -- client ----------------------------------------------------------------
@@ -141,6 +209,12 @@ class KafkaClient:
         self._lock = threading.Lock()
         self._timeout = timeout_s
         self._versions: Optional[Dict[int, int]] = None
+        # raw ApiVersions advertisement from the broker (None = legacy
+        # broker, or not yet negotiated): the transactional preflight
+        # reads it — negotiate() falls back to v0 for apis a broker
+        # OMITS, which is correct for the legacy data apis but would
+        # silently aim transactions at a broker that cannot speak them
+        self._broker_versions: Optional[Dict[int, Tuple[int, int]]] = None
         if retry is DEFAULT_RETRY:  # see _CLIENT_SEQ above
             retry = dataclasses.replace(
                 retry,
@@ -310,6 +384,7 @@ class KafkaClient:
                         )
                         # fst:blocking-ok constant <=50ms delay, never the exponential sequence (see comment above): every other call on this client gates on negotiation anyway, so waiting on the lock == waiting on negotiation — the PR 7 bug was the EXPONENTIAL backoff here
                         time.sleep(delay_s)
+            self._broker_versions = broker
             self._versions = negotiate(broker)
         return self._versions
 
@@ -382,6 +457,7 @@ class KafkaClient:
         max_bytes: int = 1 << 20,
         max_wait_ms: int = 100,
         min_bytes: int = 1,
+        isolation: int = 0,
     ) -> Dict[int, Tuple[int, List, int]]:
         """-> {partition: (high_watermark, [(offset, ts, key, value)],
         raw_record_set_bytes)} — the raw size lets callers distinguish
@@ -389,11 +465,20 @@ class KafkaClient:
         negotiated Fetch >= 4 the records arrive as v2 batches
         (CRC32C-checked, decompressed); either way records below the
         requested offset may appear (whole-batch/segment resends) and
-        callers must skip them."""
+        callers must skip them.
+
+        ``isolation=1`` (read_committed; needs Fetch >= 4) serves only
+        up to the partition's last stable offset and filters ABORTED
+        transactional data client-side using the response's
+        aborted-transactions index, the way real consumers do: an
+        aborted batch's records are returned with ``None`` values so
+        offsets still advance past them (exactly like control
+        batches), but no payload survives."""
         return self._retrying(
             "fetch",
             lambda: self._fetch_once(
-                topic, offsets, max_bytes, max_wait_ms, min_bytes
+                topic, offsets, max_bytes, max_wait_ms, min_bytes,
+                isolation,
             ),
         )
 
@@ -404,12 +489,20 @@ class KafkaClient:
         max_bytes: int,
         max_wait_ms: int,
         min_bytes: int,
+        isolation: int = 0,
     ) -> Dict[int, Tuple[int, List, int]]:
         with self._lock:
             version = self._ensure_versions_locked()[API_FETCH]
+            if isolation and version < 4:
+                raise KafkaError(
+                    "read_committed needs a broker speaking Fetch >= 4"
+                    " (v2 record batches carry the transactional "
+                    "attribution); this broker negotiated the v0 "
+                    "dialect"
+                )
             w = Writer().i32(-1).i32(max_wait_ms).i32(min_bytes)
             if version >= 4:
-                w.i32(max_bytes).i8(0)  # total max_bytes, isolation=read_uncommitted
+                w.i32(max_bytes).i8(isolation)
             w.i32(1).string(topic).i32(len(offsets))
             for p, off in sorted(offsets.items()):
                 w.i32(p).i64(off).i32(max_bytes)
@@ -421,10 +514,11 @@ class KafkaClient:
             r.string()
             for _ in range(r.i32()):
                 pid, err, hw = r.i32(), r.i16(), r.i64()
+                aborted: List[Tuple[int, int]] = []
                 if version >= 4:
                     r.i64()  # last_stable_offset
                     for _ in range(r.i32()):  # aborted_transactions
-                        r.i64(), r.i64()
+                        aborted.append((r.i64(), r.i64()))
                 rset = r.bytes_() or b""
                 if err:
                     raise BrokerErrorResponse(
@@ -432,7 +526,11 @@ class KafkaClient:
                         f"({broker_code_name(err)})",
                         code=err, api="Fetch",
                     )
-                out[pid] = (hw, decode_record_set(rset), len(rset))
+                if isolation:
+                    records = _decode_committed(rset, aborted)
+                else:
+                    records = decode_record_set(rset)
+                out[pid] = (hw, records, len(rset))
         return out
 
     def produce(
@@ -444,21 +542,36 @@ class KafkaClient:
         timeout_ms: int = 10_000,
         ts_ms: int = 0,
         compression: str = "none",
+        transactional_id: Optional[str] = None,
+        producer_id: int = -1,
+        producer_epoch: int = -1,
+        base_sequence: int = -1,
+        transactional: bool = False,
     ) -> int:
         """-> base offset assigned by the broker. ``compression`` is a
         codecs.py name; anything but 'none' needs a broker speaking
         Produce >= 3 (v2 record batches).
 
-        Retried produce is AT-LEAST-ONCE: a request that failed after
-        the broker appended it (e.g. the ack was lost to a connection
-        drop) is re-sent whole — there are no idempotent-producer
-        sequence numbers. Exactly-once output lives a layer up, in the
-        supervisor's checkpoint-commit protocol."""
+        PLAIN retried produce (no producer id) is AT-LEAST-ONCE: a
+        request that failed after the broker appended it (e.g. the ack
+        was lost to a connection drop) is re-sent whole with nothing
+        for the broker to dedupe against. Passing the KIP-98 fields
+        (``producer_id``/``producer_epoch``/``base_sequence``, granted
+        by :meth:`init_producer_id`) closes that hole: the broker acks
+        a re-send of an already-appended batch as
+        DUPLICATE_SEQUENCE_NUMBER, which this method treats as success
+        — the batch landed exactly once. ``transactional=True``
+        additionally marks the batch invisible to read-committed
+        consumers until its transaction commits (the ``KafkaSink``
+        transactional path binds that commit to the supervisor's
+        checkpoint-commit protocol). A stale epoch raises
+        ``ProducerFencedError`` (fatal: this producer is a zombie)."""
         return self._retrying(
             "produce",
             lambda: self._produce_once(
                 topic, partition, values, acks, timeout_ms, ts_ms,
-                compression,
+                compression, transactional_id, producer_id,
+                producer_epoch, base_sequence, transactional,
             ),
         )
 
@@ -471,15 +584,32 @@ class KafkaClient:
         timeout_ms: int,
         ts_ms: int,
         compression: str,
+        transactional_id: Optional[str] = None,
+        producer_id: int = -1,
+        producer_epoch: int = -1,
+        base_sequence: int = -1,
+        transactional: bool = False,
     ) -> int:
         codec = codec_id(compression)
         with self._lock:
             version = self._ensure_versions_locked()[API_PRODUCE]
             if version >= 3:
                 rset = encode_record_batch(
-                    [(ts_ms, None, v) for v in values], codec=codec
+                    [(ts_ms, None, v) for v in values],
+                    codec=codec,
+                    producer_id=producer_id,
+                    producer_epoch=producer_epoch,
+                    base_sequence=base_sequence,
+                    transactional=transactional,
                 )
             else:
+                if producer_id >= 0 or transactional:
+                    raise KafkaError(
+                        "idempotent/transactional produce needs a "
+                        "broker speaking Produce >= 3 (v2 record "
+                        "batches carry the producer fields); this "
+                        "broker negotiated the v0 dialect"
+                    )
                 if codec != CODEC_NONE:
                     raise KafkaError(
                         f"compression {compression!r} needs a broker "
@@ -490,7 +620,7 @@ class KafkaClient:
                 rset = encode_message_set(values, ts_ms=ts_ms)
             w = Writer()
             if version >= 3:
-                w.string(None)  # transactional_id
+                w.string(transactional_id)
             (
                 w.i16(acks)
                 .i32(timeout_ms)
@@ -508,14 +638,136 @@ class KafkaClient:
                 pid, err, off = r.i32(), r.i16(), r.i64()
                 if version >= 2:
                     r.i64()  # log_append_time
+                if err == DUPLICATE_SEQUENCE_CODE and producer_id >= 0:
+                    # the retry-after-append shape: the broker already
+                    # holds this batch at ``off`` — exactly-once, done
+                    base = off
+                    continue
                 if err:
-                    raise BrokerErrorResponse(
+                    raise broker_error(
                         f"Produce {topic}/{pid}: error {err} "
                         f"({broker_code_name(err)})",
                         code=err, api="Produce",
                     )
                 base = off
         return base
+
+    # -- transactions (KIP-98) --------------------------------------------
+    def _txn_preflight_locked(self) -> None:
+        """Transactions need the broker to ADVERTISE apis 22/24/26 —
+        negotiate() falls back to v0 for omitted apis (right for the
+        legacy data dialect, wrong here: a pre-transactions broker
+        would just hang up on an InitProducerId)."""
+        self._ensure_versions_locked()
+        adv = self._broker_versions
+        if adv is None or API_INIT_PRODUCER_ID not in adv:
+            raise KafkaError(
+                f"broker {self.host}:{self.port} does not advertise "
+                "the transactional apis (InitProducerId/"
+                "AddPartitionsToTxn/EndTxn) — transactional produce "
+                "needs a >= 0.11 broker"
+            )
+
+    def init_producer_id(
+        self,
+        transactional_id: Optional[str],
+        txn_timeout_ms: int = DEFAULT_TXN_TIMEOUT_MS,
+    ) -> Tuple[int, int]:
+        """-> ``(producer_id, producer_epoch)``. Re-running on the
+        same transactional id bumps the epoch: every older holder is
+        FENCED and any transaction it left open is aborted broker-side
+        — the restart/zombie half of exactly-once output."""
+        return self._retrying(
+            "init_producer_id",
+            lambda: self._init_producer_id_once(
+                transactional_id, txn_timeout_ms
+            ),
+        )
+
+    def _init_producer_id_once(
+        self, transactional_id: Optional[str], txn_timeout_ms: int
+    ) -> Tuple[int, int]:
+        with self._lock:
+            self._txn_preflight_locked()
+            r = self._call_locked(
+                API_INIT_PRODUCER_ID,
+                0,
+                encode_init_producer_id_request(
+                    transactional_id, txn_timeout_ms
+                ),
+            )
+        return decode_init_producer_id_response(r)
+
+    def add_partitions_to_txn(
+        self,
+        transactional_id: str,
+        producer_id: int,
+        producer_epoch: int,
+        partitions: List[Tuple[str, int]],
+    ) -> None:
+        """Register partitions with the ongoing transaction (where
+        commit/abort markers will be written) before producing."""
+        self._retrying(
+            "add_partitions_to_txn",
+            lambda: self._add_partitions_once(
+                transactional_id, producer_id, producer_epoch,
+                partitions,
+            ),
+        )
+
+    def _add_partitions_once(
+        self,
+        transactional_id: str,
+        producer_id: int,
+        producer_epoch: int,
+        partitions: List[Tuple[str, int]],
+    ) -> None:
+        with self._lock:
+            self._txn_preflight_locked()
+            r = self._call_locked(
+                API_ADD_PARTITIONS_TO_TXN,
+                0,
+                encode_add_partitions_request(
+                    transactional_id, producer_id, producer_epoch,
+                    partitions,
+                ),
+            )
+        decode_add_partitions_response(r)
+
+    def end_txn(
+        self,
+        transactional_id: str,
+        producer_id: int,
+        producer_epoch: int,
+        commit: bool,
+    ) -> None:
+        """Two-phase commit's second phase: the coordinator writes the
+        COMMIT/ABORT marker into every registered partition."""
+        self._retrying(
+            "end_txn",
+            lambda: self._end_txn_once(
+                transactional_id, producer_id, producer_epoch, commit
+            ),
+        )
+
+    def _end_txn_once(
+        self,
+        transactional_id: str,
+        producer_id: int,
+        producer_epoch: int,
+        commit: bool,
+    ) -> None:
+        with self._lock:
+            self._txn_preflight_locked()
+            r = self._call_locked(
+                API_END_TXN,
+                0,
+                encode_end_txn_request(
+                    transactional_id, producer_id, producer_epoch,
+                    commit,
+                ),
+            )
+        decode_end_txn_response(r)
 
 
 # -- source / sink ---------------------------------------------------------
@@ -889,7 +1141,33 @@ class KafkaSink:
     attach with ``job.add_sink(stream, sink)``; call ``flush()`` (or use
     the pipeline wiring, which flushes per drain) to bound batching.
     ``compression`` is a codecs.py name applied per produced batch
-    (requires a broker negotiating Produce >= 3)."""
+    (requires a broker negotiating Produce >= 3).
+
+    **Transactional mode** (``transactional_id=...``): the two-phase-
+    commit sink (Flink lineage, PAPERS.md #1). Each checkpoint epoch
+    ``n`` gets its own transaction on the epoch-suffixed id
+    ``f"{transactional_id}-{n}"``; rows flush into the OPEN transaction
+    (idempotent produce: producer id/epoch/sequence per batch, so a
+    wire-level retry can never double-append) and stay invisible to
+    read-committed consumers until the supervisor's commit protocol
+    commits the checkpoint — ``prepare_commit()`` (flush + stamp the
+    pending transaction into the snapshot via ``state_dict``) runs
+    before the snapshot is captured, ``commit_transaction()`` (EndTxn)
+    only after it is durably on disk. A crash between the two is
+    healed at restore: ``load_state_dict`` RESUMES the snapshot's
+    pending commit (an INVALID_TXN_STATE answer means the commit
+    already landed pre-crash — success either way), then re-runs
+    InitProducerId on the next epoch's id, which aborts whatever the
+    pre-crash zombie left open and fences the zombie itself
+    (``ProducerFencedError``, fatal, on its next produce). Net effect:
+    an external read-committed consumer sees every committed row
+    exactly once across any crash point — the suffix a restart
+    discards and re-emits is aborted broker-side, never observed.
+
+    Transaction lifecycle events journal to the flight recorder
+    (``txn.begin/commit/abort/fenced``, abort storms rate-collapsed)
+    and mirror as ``faults.txn.*`` counters once ``bind_telemetry`` /
+    ``bind_flightrec`` are called (``job.add_sink`` does both)."""
 
     def __init__(
         self,
@@ -901,6 +1179,8 @@ class KafkaSink:
         flush_every: int = 1024,
         compression: str = "none",
         client: Optional[KafkaClient] = None,
+        transactional_id: Optional[str] = None,
+        txn_timeout_ms: int = DEFAULT_TXN_TIMEOUT_MS,
     ) -> None:
         import json as _json
 
@@ -915,9 +1195,33 @@ class KafkaSink:
         self.stream_id = stream_id
         self.flush_every = flush_every
         self.compression = compression
+        # fst:ephemeral drained into the open transaction by prepare_commit before every snapshot (plain sinks re-emit on replay, at-least-once)
         self._buf: List[bytes] = []
         self._json = _json
         self.produced = 0
+        # -- transactional state ------------------------------------
+        self.transactional_id = transactional_id
+        self._txn_timeout_ms = int(txn_timeout_ms)
+        self._txn: Optional[TransactionState] = None
+        #: checkpoint-epoch counter: transaction n runs on the id
+        #: f"{transactional_id}-{n}" (fresh id per epoch, so a
+        #: restored job's InitProducerId aborts exactly the zombie's
+        #: orphan and nothing else)
+        self._epoch_n = 0
+        #: the prepared-but-uncommitted transaction's identity — set
+        #: by prepare_commit, carried in state_dict, consumed by
+        #: commit_transaction (or by load_state_dict's resume)
+        self._pending: Optional[dict] = None
+        self.txn_commits = 0
+        self.txn_aborts = 0
+        self.txn_fenced = 0
+        self.txn_resumed = 0
+        # fst:ephemeral observability handles; job.add_sink re-binds after restore
+        self._flightrec = None
+
+    @property
+    def transactional(self) -> bool:
+        return self.transactional_id is not None
 
     def __call__(self, ts: int, row: tuple) -> None:
         # mirror the file sink's payload shape (app/pipeline.py): the
@@ -937,16 +1241,231 @@ class KafkaSink:
     def bind_telemetry(self, registry) -> None:
         self.client.bind_telemetry(registry)
 
+    def bind_flightrec(self, recorder) -> None:
+        """Journal txn lifecycle events into the job's flight
+        recorder, scoped by the sink's stream (or topic)."""
+        # fst:ephemeral recorder handle; job.add_sink re-binds after restore
+        self._flightrec = recorder
+
+    def _txn_event(self, kind: str, **data) -> None:
+        """One txn lifecycle event: flight-recorder journal entry
+        (rate-collapsed for abort storms) + faults.txn.* counter."""
+        self.client._note_fault(f"faults.txn.{kind.split('.', 1)[1]}")
+        if self._flightrec is not None:
+            self._flightrec.record(
+                kind, plan=self.stream_id or self.topic, **data
+            )
+
+    # -- transactional plumbing -----------------------------------------
+    def _txn_id_for(self, n: int) -> str:
+        return f"{self.transactional_id}-{int(n)}"
+
+    def _ensure_session(self) -> None:
+        """InitProducerId for the current epoch's id (idempotent per
+        epoch). THIS is the call that aborts an orphan transaction a
+        pre-crash zombie left on this id and fences the zombie."""
+        if self._txn is not None:
+            return
+        txn_id = self._txn_id_for(self._epoch_n)
+        pid, epoch = self.client.init_producer_id(
+            txn_id, self._txn_timeout_ms
+        )
+        st = TransactionState(txn_id)
+        st.open(pid, epoch)
+        self._txn = st
+
+    def _ensure_txn(self) -> None:
+        self._ensure_session()
+        if not self._txn.in_txn:
+            self._txn.begin()
+            self._txn_event(
+                "txn.begin",
+                txn_id=self._txn.transactional_id,
+                producer_id=self._txn.producer_id,
+                producer_epoch=self._txn.producer_epoch,
+            )
+
     def flush(self) -> None:
         if not self._buf:
             return
-        self.client.produce(
-            self.topic, self.partition, self._buf,
-            compression=self.compression,
-        )
+        if not self.transactional:
+            self.client.produce(
+                self.topic, self.partition, self._buf,
+                compression=self.compression,
+            )
+            self.produced += len(self._buf)
+            self._buf = []
+            return
+        try:
+            self._ensure_txn()
+            st = self._txn
+            if st.needs_partition(self.topic, self.partition):
+                self.client.add_partitions_to_txn(
+                    st.transactional_id,
+                    st.producer_id,
+                    st.producer_epoch,
+                    [(self.topic, self.partition)],
+                )
+                st.partition_added(self.topic, self.partition)
+            self.client.produce(
+                self.topic, self.partition, self._buf,
+                compression=self.compression,
+                transactional_id=st.transactional_id,
+                producer_id=st.producer_id,
+                producer_epoch=st.producer_epoch,
+                base_sequence=st.next_sequence(
+                    self.topic, self.partition
+                ),
+                transactional=True,
+            )
+            st.advance(self.topic, self.partition, len(self._buf))
+        except ProducerFencedError:
+            self.txn_fenced += 1
+            self._txn_event(
+                "txn.fenced", txn_id=self._txn_id_for(self._epoch_n)
+            )
+            raise
         self.produced += len(self._buf)
         self._buf = []
 
-    def close(self) -> None:
+    # -- the checkpoint-commit protocol ----------------------------------
+    def prepare_commit(self) -> None:
+        """Phase one, called AFTER the job drained its outputs and
+        BEFORE the snapshot is captured: flush every buffered row into
+        the open transaction and stamp its identity pending, so the
+        snapshot about to be written carries it (state_dict). No rows
+        this epoch => no transaction => nothing pending (empty
+        transactions are never opened)."""
         self.flush()
+        if (
+            self.transactional
+            and self._txn is not None
+            and self._txn.in_txn
+        ):
+            self._pending = {
+                "txn_id": self._txn.transactional_id,
+                "producer_id": self._txn.producer_id,
+                "producer_epoch": self._txn.producer_epoch,
+                "n": self._epoch_n,
+            }
+
+    def commit_transaction(self) -> None:
+        """Phase two, called only once the snapshot that will never
+        re-emit the pending transaction's rows is durably on disk:
+        EndTxn(commit), then advance to the next epoch's id. A crash
+        BEFORE this call leaves the pending identity in the snapshot;
+        restore resumes the commit (load_state_dict)."""
+        if not self.transactional or self._pending is None:
+            return
+        p = self._pending
+        try:
+            self.client.end_txn(
+                p["txn_id"], p["producer_id"], p["producer_epoch"],
+                commit=True,
+            )
+        except ProducerFencedError:
+            self.txn_fenced += 1
+            self._txn_event("txn.fenced", txn_id=p["txn_id"])
+            raise
+        self.txn_commits += 1
+        self._txn_event("txn.commit", txn_id=p["txn_id"])
+        if self._txn is not None:
+            self._txn.closed()
+        self._txn = None  # next epoch inits a fresh id
+        self._epoch_n = p["n"] + 1
+        self._pending = None
+
+    def abort_transaction(self) -> None:
+        """Abort the open (uncommitted) transaction, if any — the
+        discard half of the protocol; its rows were never visible."""
+        if not self.transactional:
+            return
+        self._buf = []
+        st, self._pending = self._txn, None
+        if st is None or not st.in_txn:
+            return
+        try:
+            self.client.end_txn(
+                st.transactional_id, st.producer_id,
+                st.producer_epoch, commit=False,
+            )
+        except ProducerFencedError:
+            # a successor already owns the id: its InitProducerId
+            # aborted this transaction for us — the outcome stands
+            self.txn_fenced += 1
+            self._txn_event("txn.fenced", txn_id=st.transactional_id)
+        self.txn_aborts += 1
+        self._txn_event("txn.abort", txn_id=st.transactional_id)
+        st.closed()
+        self._txn = None
+
+    def txn_stats(self) -> dict:
+        """Plain-builtins transactional account (health endpoints)."""
+        return {
+            "transactional_id": self.transactional_id,
+            "epoch_n": self._epoch_n,
+            "commits": self.txn_commits,
+            "aborts": self.txn_aborts,
+            "fenced": self.txn_fenced,
+            "resumed": self.txn_resumed,
+            "pending": self._pending is not None,
+        }
+
+    # -- checkpoint participation (plain builtins only) -------------------
+    def state_dict(self) -> dict:
+        d: dict = {
+            "epoch_n": int(self._epoch_n),
+            "produced": int(self.produced),
+        }
+        if self._pending is not None:
+            d["pending"] = dict(self._pending)
+        return d
+
+    def load_state_dict(self, d: dict) -> None:
+        self._epoch_n = int(d.get("epoch_n", 0))
+        self.produced = int(d.get("produced", 0))
+        if not self.transactional:
+            return
+        pending = d.get("pending")
+        if pending:
+            # RESUME the commit the snapshot promised: the crash
+            # landed between the snapshot and EndTxn (commit now —
+            # zero lost), or after it (the broker answers
+            # INVALID_TXN_STATE: nothing open on that id — the commit
+            # already happened, zero duplicated). Real brokers add a
+            # third possibility — the transaction TIMED OUT and was
+            # aborted, indistinguishable from committed here; the
+            # fake broker never times out, and docs/fault_tolerance.md
+            # carries the honest statement.
+            try:
+                self.client.end_txn(
+                    pending["txn_id"],
+                    pending["producer_id"],
+                    pending["producer_epoch"],
+                    commit=True,
+                )
+                self.txn_resumed += 1
+                self._txn_event(
+                    "txn.commit", txn_id=pending["txn_id"], resumed=True
+                )
+            except BrokerErrorResponse as e:
+                if e.code != INVALID_TXN_STATE_CODE:
+                    raise
+            self.txn_commits += 1
+            self._epoch_n = int(pending["n"]) + 1
+        self._pending = None
+        self._txn = None
+        # eagerly claim the next epoch's id: fences the pre-crash
+        # zombie NOW and aborts whatever it left open, instead of
+        # waiting for the first post-restore row
+        self._ensure_session()
+
+    def close(self) -> None:
+        """Flush (non-transactional) or abort-what's-open
+        (transactional: visibility is the commit protocol's decision,
+        never close()'s) and drop the connection."""
+        if self.transactional:
+            self.abort_transaction()
+        else:
+            self.flush()
         self.client.close()

@@ -174,6 +174,15 @@ class Supervisor:
         )
         self.telemetry.inc("recovery.checkpoints")
         self._commit()
+        # external transactional sinks commit ONLY here: the snapshot
+        # that provably will not re-emit this epoch's rows is durable
+        # and the internal row-account just promoted — EndTxn(commit)
+        # now makes the epoch visible to read-committed consumers. A
+        # crash between the save above and this call leaves the
+        # pending transaction identity in the snapshot; the restore
+        # resumes that exact commit (KafkaSink.load_state_dict), so
+        # the external account stays exactly-once across the window.
+        job.commit_sink_transactions()
         self._ckpt_count += 1
         self._last_ckpt_t = time.monotonic()
         self._ckpt_processed = job.processed_events
@@ -452,5 +461,24 @@ class Supervisor:
             "late_dropped": (
                 int(job.late_dropped) if job is not None else None
             ),
+            # transactional-sink account (runtime/kafka.py txn_stats):
+            # epoch counter, commit/abort/fence/resume totals, and
+            # whether a prepared commit is in flight — the external
+            # exactly-once story in one scrape
+            "transactional_sinks": self._txn_sink_stats(job),
             "telemetry": self.telemetry.snapshot(),
         }
+
+    @staticmethod
+    def _txn_sink_stats(job) -> List[Dict[str, object]]:
+        if job is None:
+            return []
+        out: List[Dict[str, object]] = []
+        # list() snapshots: health() runs on the REST service thread
+        # while the run loop may attach sinks
+        for sid, fns in list(getattr(job, "_sinks", {}).items()):
+            for fn in list(fns):
+                stats = getattr(fn, "txn_stats", None)
+                if stats is not None:
+                    out.append({"stream": sid, **stats()})
+        return out

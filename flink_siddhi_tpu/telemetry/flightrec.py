@@ -73,6 +73,11 @@ COLLAPSIBLE_KINDS = frozenset(
         # that evicts the control/checkpoint/restart history; exact
         # counts live in the compile.lowerings counter
         "compile.xla",
+        # a flapping transactional sink (broker rejecting every
+        # EndTxn) aborts once per checkpoint epoch — collapsed so an
+        # abort storm cannot evict the checkpoint/restart history;
+        # commits/fences are discrete transitions and always append
+        "txn.abort",
     }
 )
 

@@ -129,6 +129,18 @@ at least one injected crash, ``stale_tmp_swept: true``, and EXACT
 exactly-once numbers — ``duplicate_rows`` and ``lost_rows`` (counted
 against an unfaulted oracle, not assumed) must both be 0.
 
+Schema v10 (transactional-sink round, bench.py ``schema_version:
+10``) extends the recovery contract to the EXTERNAL boundary: a
+``recovery`` block in a v10+ line must carry a ``transactional``
+sub-block — the supervised KIP-98 transactional-sink run (crash zoo
+extended with a kill-mid-transaction) — with
+``read_committed_duplicates`` and ``read_committed_lost`` both 0, a
+finite positive measured ``recovery_time_ms``, at least one injected
+crash, ``exactly_once: true``, and ``aborted_rows_invisible: true``
+(the dead runs' transactions really carried data and a read-committed
+consumer never saw it). Pre-v10 lines are exempt from requiring the
+sub-block; a present one is validated in any version.
+
 Usage:
     python scripts/check_bench_schema.py [FILES...]
     python scripts/check_bench_schema.py --require-stages FILES...
@@ -912,11 +924,80 @@ def validate_v8(doc, errors: List[str], where: str) -> None:
         validate_attribution(att, errors, f"{where}:control")
 
 
-def validate_recovery(rec, errors: List[str], where: str) -> None:
+def validate_txn_recovery(txn, errors: List[str], where: str) -> None:
+    """The v10 ``recovery.transactional`` sub-block: exactly-once
+    measured at the external read-committed boundary of a KIP-98
+    transactional sink. Duplicates or losses visible to a
+    read-committed consumer are a failed claim, not a benchmark."""
+    where = f"{where}.transactional"
+    if not isinstance(txn, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    for key in (
+        "events",
+        "crashes",
+        "restarts",
+        "rows_emitted",
+        "read_committed_duplicates",
+        "read_committed_lost",
+        "read_uncommitted_rows",
+    ):
+        v = txn.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(
+                f"{where}: {key} missing/non-int/negative ({v!r})"
+            )
+    rt = txn.get("recovery_time_ms")
+    if not _finite(rt) or rt <= 0:
+        errors.append(
+            f"{where}: recovery_time_ms missing/non-positive ({rt!r}) "
+            "— transactional recovery must be a measured number"
+        )
+    if txn.get("crashes") == 0:
+        errors.append(
+            f"{where}: crashes == 0 — a transactional recovery block "
+            "with no injected crash measures nothing"
+        )
+    if txn.get("kill_mid_transaction") is not True:
+        errors.append(
+            f"{where}: kill_mid_transaction must be true — the new "
+            "failure mode (death between snapshot and EndTxn) is the "
+            "point of the block"
+        )
+    if txn.get("read_committed_duplicates") != 0:
+        errors.append(
+            f"{where}: read_committed_duplicates="
+            f"{txn.get('read_committed_duplicates')!r} — exactly-once "
+            "violated at the external boundary (a read-committed "
+            "consumer saw repeated rows)"
+        )
+    if txn.get("read_committed_lost") != 0:
+        errors.append(
+            f"{where}: read_committed_lost="
+            f"{txn.get('read_committed_lost')!r} — exactly-once "
+            "violated at the external boundary (a read-committed "
+            "consumer is missing oracle rows)"
+        )
+    if txn.get("exactly_once") is not True:
+        errors.append(f"{where}: exactly_once must be true")
+    if txn.get("aborted_rows_invisible") is not True:
+        errors.append(
+            f"{where}: aborted_rows_invisible must be true — either "
+            "the kills never hit a data-bearing transaction (the "
+            "block measured nothing) or aborted rows leaked to "
+            "read_committed"
+        )
+
+
+def validate_recovery(
+    rec, errors: List[str], where: str, version: int = 1
+) -> None:
     """The ``--fault`` recovery block (optional in every version; when
     present it must carry real measurements and the exactly-once
     numbers must actually be exact — a recovery claim with duplicates
-    or losses is a failed claim, not a benchmark)."""
+    or losses is a failed claim, not a benchmark). From v10 the block
+    must additionally carry the ``transactional`` sub-block; pre-v10
+    lines are exempt, but a present sub-block is always validated."""
     where = f"{where}:recovery"
     if not isinstance(rec, dict):
         errors.append(f"{where}: must be an object")
@@ -964,6 +1045,15 @@ def validate_recovery(rec, errors: List[str], where: str) -> None:
         errors.append(
             f"{where}: stale_tmp_swept must be true — the "
             "kill-mid-checkpoint debris was not cleaned up"
+        )
+    if "transactional" in rec:
+        validate_txn_recovery(rec["transactional"], errors, where)
+    elif version >= 10:
+        errors.append(
+            f"{where}: schema v10 recovery block lacks the "
+            "transactional sub-block — exactly-once must be measured "
+            "at the external read-committed boundary, not only "
+            "against internal committed results"
         )
 
 
@@ -1041,7 +1131,7 @@ def validate_doc(
             doc["control"]["attribution"], errors, f"{where}:control"
         )
     if "recovery" in doc:
-        validate_recovery(doc["recovery"], errors, where)
+        validate_recovery(doc["recovery"], errors, where, version)
 
 
 def extract_docs(text: str, errors: List[str], path: str):

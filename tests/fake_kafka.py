@@ -41,6 +41,36 @@ exactly). Actions:
                          apis: like ``drop``)
 * ``"delay"``          — serve normally after ``fault_delay_s``
                          (default 2 ms; bounded, never a test clock)
+* ``"fence"``          — bump the requesting producer's epoch
+                         coordinator-side BEFORE handling, so this and
+                         every later request from the old incarnation
+                         answers INVALID_PRODUCER_EPOCH (the zombie-
+                         producer shape; txn/produce apis only, other
+                         apis: like ``drop``). Opt-in: NOT in
+                         ``FaultSchedule.ACTIONS`` (seeded draws of
+                         existing schedules must not shift).
+* ``"abort_txn"``      — abort the requester's ongoing transaction
+                         server-side (the transaction-timeout shape:
+                         markers written, data becomes invisible to
+                         read-committed) then handle the request
+                         normally against the now-empty txn state.
+                         Opt-in, like ``"fence"``.
+
+Transaction coordinator (KIP-98, single node): InitProducerId (22)
+grants ``(producer_id, epoch)`` per transactional id — re-running it
+bumps the epoch, fences older holders, and aborts any transaction
+they left open; AddPartitionsToTxn (24) registers marker targets;
+EndTxn (26) appends a COMMIT/ABORT control batch to every registered
+partition. Produce v3 validates the batch header's
+producer_id/epoch/sequence: a stale epoch is fenced (47), the
+expected next sequence appends, a re-send of the last appended batch
+acks as DUPLICATE_SEQUENCE_NUMBER (46 — the client treats it as
+success, closing the retry-duplicates hole), anything else is
+OUT_OF_ORDER_SEQUENCE_NUMBER (45). Fetch v4 honors
+``isolation_level``: read_committed (1) is capped at the last stable
+offset and carries the aborted-transactions index for the served
+range. Transactions never time out here — tests are exact where real
+brokers are ambiguous (docs/fault_tolerance.md).
 """
 
 from __future__ import annotations
@@ -53,7 +83,10 @@ from bisect import bisect_right
 from typing import Callable, Dict, List, Optional, Tuple
 
 from flink_siddhi_tpu.connectors.kafka.protocol import (
+    API_ADD_PARTITIONS_TO_TXN,
+    API_END_TXN,
     API_FETCH,
+    API_INIT_PRODUCER_ID,
     API_LIST_OFFSETS,
     API_METADATA,
     API_PRODUCE,
@@ -64,7 +97,9 @@ from flink_siddhi_tpu.connectors.kafka.protocol import (
 )
 from flink_siddhi_tpu.connectors.kafka.records import (
     CorruptBatchError,
+    decode_batch_meta,
     decode_record_set,
+    encode_control_batch,
     encode_message_set,
     encode_record_batch,
 )
@@ -72,6 +107,17 @@ from flink_siddhi_tpu.connectors.kafka.records import (
 ERR_CORRUPT_MESSAGE = 2
 ERR_UNKNOWN_TOPIC = 3
 ERR_NOT_LEADER = 6  # transient: the client's retry taxonomy retries it
+ERR_OUT_OF_ORDER_SEQ = 45
+ERR_DUPLICATE_SEQ = 46  # client's idempotent path treats as success
+ERR_INVALID_EPOCH = 47  # fenced: fatal client-side
+ERR_INVALID_TXN_STATE = 48
+ERR_INVALID_PID_MAPPING = 49
+
+# per-batch producer metadata for non-idempotent appends (the shape
+# ``FakeBroker.append`` and legacy produce record per bound)
+_PLAIN_META = {
+    "pid": -1, "epoch": -1, "base_seq": -1, "txn": False, "control": None,
+}
 
 # what the modern dialect advertises (intentionally wider than the
 # client implements: negotiation must intersect, not parrot)
@@ -81,6 +127,9 @@ MODERN_API_VERSIONS: Dict[int, Tuple[int, int]] = {
     API_LIST_OFFSETS: (0, 2),
     API_METADATA: (0, 5),
     API_VERSIONS: (0, 1),
+    API_INIT_PRODUCER_ID: (0, 1),
+    API_ADD_PARTITIONS_TO_TXN: (0, 1),
+    API_END_TXN: (0, 1),
 }
 
 
@@ -98,6 +147,27 @@ class FakeBroker:
         # (topic, partition) -> sorted batch start offsets; batch i
         # covers [starts[i], starts[i+1]) (last runs to len(log))
         self.bounds: Dict[Tuple[str, int], List[int]] = {}
+        # (topic, partition) -> per-bound producer metadata (parallel
+        # to ``bounds``): pid/epoch/base_seq/txn/control — what a
+        # served batch's header must carry back
+        self.batch_meta: Dict[Tuple[str, int], List[dict]] = {}
+        # -- transaction coordinator state (all under self._lock) ----
+        self._next_pid = 1000
+        # transactional_id -> {pid, epoch, state: "empty"|"ongoing",
+        #                      partitions: set of (topic, partition)}
+        self._txns: Dict[str, dict] = {}
+        # producer_id -> current epoch (the fencing source of truth,
+        # covers idempotent-only producers with no transactional id)
+        self._pid_epoch: Dict[int, int] = {}
+        # (topic, partition) -> {pid: (next_seq, last_base_seq,
+        #                              last_base_off, epoch)}
+        self._seqs: Dict[Tuple[str, int], Dict[int, tuple]] = {}
+        # (topic, partition) -> {pid: first data offset of the OPEN
+        # transaction} — what caps the last stable offset
+        self._open_txn: Dict[Tuple[str, int], Dict[int, int]] = {}
+        # (topic, partition) -> [(pid, first_offset, marker_offset)]
+        # for every ABORTED transaction (the Fetch v4 index)
+        self.aborted: Dict[Tuple[str, int], List[Tuple[int, int, int]]] = {}
         self.legacy = legacy
         self.fetch_codec = fetch_codec
         self.api_versions = dict(
@@ -126,6 +196,7 @@ class FakeBroker:
             for p in range(partitions):
                 self.logs.setdefault((topic, p), [])
                 self.bounds.setdefault((topic, p), [])
+                self.batch_meta.setdefault((topic, p), [])
 
     def append(self, topic: str, partition: int, values, ts_ms=0):
         """Append values as ONE batch (one bound) — a v4 fetch of any
@@ -133,6 +204,9 @@ class FakeBroker:
         with self._lock:
             log = self.logs[(topic, partition)]
             self.bounds.setdefault((topic, partition), []).append(len(log))
+            self.batch_meta.setdefault((topic, partition), []).append(
+                dict(_PLAIN_META)
+            )
             for v in values:
                 if isinstance(v, str):
                     v = v.encode()
@@ -205,16 +279,35 @@ class FakeBroker:
         if fault == "delay":
             time.sleep(self.fault_delay_s)
             fault = None
+        _txn_apis = (
+            API_PRODUCE, API_ADD_PARTITIONS_TO_TXN, API_END_TXN,
+        )
         forced_err = 0
         corrupt = False
+        fence = False
+        abort_txn = False
         if fault == "error":
-            if api in (API_FETCH, API_PRODUCE, API_LIST_OFFSETS):
+            if api in (
+                API_FETCH, API_PRODUCE, API_LIST_OFFSETS,
+                API_INIT_PRODUCER_ID, API_ADD_PARTITIONS_TO_TXN,
+                API_END_TXN,
+            ):
                 forced_err = ERR_NOT_LEADER
             else:
                 return None  # no error slot in these responses: drop
         elif fault == "corrupt":
             if api == API_FETCH:
                 corrupt = True
+            else:
+                return None
+        elif fault == "fence":
+            if api in _txn_apis:
+                fence = True
+            else:
+                return None  # no producer identity to fence: drop
+        elif fault == "abort_txn":
+            if api in _txn_apis:
+                abort_txn = True
             else:
                 return None
         w = Writer().i32(corr)
@@ -233,7 +326,21 @@ class FakeBroker:
         elif api == API_PRODUCE:
             if version not in (0, 3):
                 raise AssertionError(f"fake broker: Produce v{version}")
-            self._produce(r, w, version, forced_err)
+            self._produce(r, w, version, forced_err, fence, abort_txn)
+        elif api == API_INIT_PRODUCER_ID:
+            if self.legacy:
+                return None
+            self._init_producer_id(r, w, forced_err)
+        elif api == API_ADD_PARTITIONS_TO_TXN:
+            if self.legacy:
+                return None
+            self._add_partitions_to_txn(
+                r, w, forced_err, fence, abort_txn
+            )
+        elif api == API_END_TXN:
+            if self.legacy:
+                return None
+            self._end_txn(r, w, forced_err, fence, abort_txn)
         else:
             if self.legacy:
                 return None
@@ -287,8 +394,10 @@ class FakeBroker:
         forced_err: int = 0, corrupt: bool = False,
     ) -> None:
         r.i32(), r.i32(), r.i32()  # replica, max_wait, min_bytes
+        isolation = 0
         if version >= 4:
-            r.i32(), r.i8()  # total max_bytes, isolation_level
+            r.i32()  # total max_bytes
+            isolation = r.i8()  # 0 = read_uncommitted, 1 = read_committed
             w.i32(0)  # throttle_time_ms
         nt = r.i32()
         w.i32(nt)
@@ -301,7 +410,16 @@ class FakeBroker:
                 with self._lock:
                     log = list(self.logs.get((t, pid), ()))
                     bounds = list(self.bounds.get((t, pid), ()))
+                    meta = list(self.batch_meta.get((t, pid), ()))
+                    open_firsts = list(
+                        self._open_txn.get((t, pid), {}).values()
+                    )
+                    aborted = list(self.aborted.get((t, pid), ()))
                 hw = len(log)
+                # last stable offset: everything below it is decided
+                # (committed or aborted-with-marker); an OPEN
+                # transaction's first data offset pins it down
+                lso = min(open_firsts) if open_firsts else hw
                 if forced_err:
                     w.i32(pid).i16(forced_err).i64(hw)
                     if version >= 4:
@@ -309,12 +427,29 @@ class FakeBroker:
                     w.bytes_(b"")
                     continue
                 if version >= 4:
-                    rset = self._serve_batches(
-                        log, bounds, off, maxb, corrupt=corrupt
+                    cap = lso if isolation == 1 else hw
+                    rset, end = self._serve_batches(
+                        log, bounds, meta, off, maxb,
+                        corrupt=corrupt, cap=cap,
                     )
                     w.i32(pid).i16(0).i64(hw)
-                    w.i64(hw)  # last_stable_offset
-                    w.i32(0)  # aborted_transactions
+                    w.i64(lso if isolation == 1 else hw)
+                    if isolation == 1:
+                        # aborted transactions overlapping the served
+                        # range: first data offset <= served end and
+                        # marker at/after the fetch offset (KIP-98's
+                        # index; the client clears each pid at its
+                        # control batch)
+                        rel = [
+                            (apid, first)
+                            for apid, first, marker in aborted
+                            if first < end and marker >= off
+                        ]
+                        w.i32(len(rel))
+                        for apid, first in rel:
+                            w.i64(apid).i64(first)
+                    else:
+                        w.i32(0)  # aborted_transactions
                     w.bytes_(rset)
                 else:
                     rset = self._serve_messages(log, off, maxb)
@@ -335,28 +470,54 @@ class FakeBroker:
         return mset
 
     def _serve_batches(
-        self, log, bounds, off: int, maxb: int, corrupt: bool = False
-    ) -> bytes:
+        self, log, bounds, meta, off: int, maxb: int,
+        corrupt: bool = False, cap: Optional[int] = None,
+    ) -> Tuple[bytes, int]:
         """v4 dialect: whole v2 batches, starting with the batch that
-        CONTAINS the fetch offset; always at least one batch.
-        ``corrupt=True`` (one fetch's fault action) flips a payload
-        bit in every served batch — CRC32C fails client-side, the log
-        itself stays clean."""
+        CONTAINS the fetch offset; always at least one batch. Returns
+        ``(record_set, end_offset_served)``. Batches are re-encoded
+        with their recorded producer metadata (id/epoch/sequence, the
+        transactional bit) so a consumer can attribute each batch to
+        its transaction; control bounds re-encode as real control
+        batches. ``cap`` (the last stable offset under
+        read_committed) stops serving at the first batch that starts
+        at or beyond it. ``corrupt=True`` (one fetch's fault action)
+        flips a payload bit in every served batch — CRC32C fails
+        client-side, the log itself stays clean."""
         if off >= len(log) or not bounds:
-            return b""
+            return b"", off
         from flink_siddhi_tpu.connectors.kafka.codecs import codec_id
 
+        if cap is None:
+            cap = len(log)
         i = max(bisect_right(bounds, off) - 1, 0)
         out = b""
+        served_end = off
         while i < len(bounds) and (not out or len(out) < maxb):
             start = bounds[i]
+            if start >= cap:
+                break  # open-transaction data: above the LSO
             end = bounds[i + 1] if i + 1 < len(bounds) else len(log)
-            entries = [(ts, None, v) for ts, v in log[start:end]]
-            batch = encode_record_batch(
-                entries,
-                base_offset=start,
-                codec=codec_id(self.fetch_codec),
-            )
+            m = meta[i] if i < len(meta) else _PLAIN_META
+            if m["control"] is not None:
+                batch = encode_control_batch(
+                    start,
+                    m["pid"],
+                    m["epoch"],
+                    commit=(m["control"] == "commit"),
+                    ts_ms=log[start][0],
+                )
+            else:
+                entries = [(ts, None, v) for ts, v in log[start:end]]
+                batch = encode_record_batch(
+                    entries,
+                    base_offset=start,
+                    codec=codec_id(self.fetch_codec),
+                    producer_id=m["pid"],
+                    producer_epoch=m["epoch"],
+                    base_sequence=m["base_seq"],
+                    transactional=m["txn"],
+                )
             if self.mangle_batch is not None:
                 batch = self.mangle_batch(batch)
             if corrupt:
@@ -364,15 +525,18 @@ class FakeBroker:
                 b[-1] ^= 0x04  # payload bit: breaks the batch CRC32C
                 batch = bytes(b)
             out += batch
+            served_end = end
             i += 1
-        return out
+        return out, served_end
 
     # -- produce ----------------------------------------------------------
     def _produce(
-        self, r: Reader, w: Writer, version: int, forced_err: int = 0
+        self, r: Reader, w: Writer, version: int, forced_err: int = 0,
+        fence: bool = False, abort_txn: bool = False,
     ) -> None:
+        txn_id = None
         if version >= 3:
-            r.string()  # transactional_id
+            txn_id = r.string()  # transactional_id
         r.i16(), r.i32()  # acks, timeout
         nt = r.i32()
         w.i32(nt)
@@ -385,25 +549,297 @@ class FakeBroker:
                 rset = r.bytes_() or b""
                 if forced_err:
                     # transient refusal: NOTHING is appended — the
-                    # client's retry re-sends the whole batch
+                    # client's retry re-sends the whole batch (same
+                    # base_sequence, so the idempotent path dedupes)
                     w.i32(pid).i16(forced_err).i64(-1)
                     if version >= 2:
                         w.i64(-1)
                     continue
                 try:
+                    # magic sits at byte 16 in BOTH wire formats;
+                    # only v2 batches carry producer metadata
+                    is_v2 = len(rset) > 16 and rset[16] >= 2
+                    bm = decode_batch_meta(rset) if is_v2 else None
                     msgs = decode_record_set(rset)
                     err = 0
                 except CorruptBatchError:
-                    msgs, err = [], ERR_CORRUPT_MESSAGE
+                    bm, msgs, err = None, [], ERR_CORRUPT_MESSAGE
                 with self._lock:
-                    log = self.logs.setdefault((t, pid), [])
-                    base = len(log)
-                    if msgs:
-                        self.bounds.setdefault((t, pid), []).append(base)
-                    for _off, ts, _k, v in msgs:
-                        log.append((ts or 0, v))
+                    if fence and bm is not None and bm["producer_id"] >= 0:
+                        self._fence_pid_locked(bm["producer_id"])
+                    if abort_txn and txn_id is not None:
+                        self._abort_ongoing_locked(txn_id)
+                    base = len(self.logs.setdefault((t, pid), []))
+                    if err == 0 and bm is not None:
+                        err, base = self._validate_append_locked(
+                            t, pid, txn_id, bm, msgs
+                        )
+                    elif err == 0 and msgs:
+                        # batch-less entries (legacy v0 payloads in a
+                        # v3 request don't occur; defensive)
+                        self._append_locked(t, pid, msgs, _PLAIN_META)
                 w.i32(pid).i16(err).i64(base)
                 if version >= 2:
                     w.i64(-1)  # log_append_time
         if version >= 1:
             w.i32(0)  # throttle_time_ms
+
+    def _append_locked(self, t, pid, msgs, meta: dict) -> int:
+        """Append one decoded batch as one bound; -> base offset."""
+        log = self.logs.setdefault((t, pid), [])
+        base = len(log)
+        if msgs:
+            self.bounds.setdefault((t, pid), []).append(base)
+            self.batch_meta.setdefault((t, pid), []).append(dict(meta))
+            for _off, ts, _k, v in msgs:
+                log.append((ts or 0, v))
+        return base
+
+    def _validate_append_locked(
+        self, t, pid, txn_id, bm: dict, msgs
+    ) -> Tuple[int, int]:
+        """KIP-98 produce-side validation -> (error_code, base_offset).
+
+        Epoch fencing first (a zombie's data must never land), then
+        sequence idempotence (expected next appends; a re-send of the
+        LAST appended batch acks as DUPLICATE_SEQUENCE_NUMBER with its
+        original base offset — success client-side; anything else is
+        OUT_OF_ORDER), then transaction membership (data for a
+        transaction that is not ongoing on this partition is
+        INVALID_TXN_STATE)."""
+        ppid = bm["producer_id"]
+        epoch = bm["producer_epoch"]
+        base_seq = bm["base_sequence"]
+        key = (t, pid)
+        if ppid < 0:
+            # non-idempotent classic batch
+            if bm["transactional"]:
+                return ERR_INVALID_TXN_STATE, -1
+            return 0, self._append_locked(t, pid, msgs, _PLAIN_META)
+        cur = self._pid_epoch.get(ppid)
+        if cur is None:
+            return ERR_INVALID_PID_MAPPING, -1
+        if epoch != cur:
+            return ERR_INVALID_EPOCH, -1
+        entry = self._txns.get(txn_id) if txn_id is not None else None
+        if bm["transactional"]:
+            if (
+                entry is None
+                or entry["pid"] != ppid
+                or entry["state"] != "ongoing"
+                or key not in entry["partitions"]
+            ):
+                return ERR_INVALID_TXN_STATE, -1
+        st = self._seqs.setdefault(key, {}).get(ppid)
+        if st is not None and st[3] == epoch:
+            next_seq, last_base_seq, last_base_off, _ = st
+            if base_seq == last_base_seq:
+                # the retry-after-append shape: already holding this
+                # batch, ack it without a second append
+                return ERR_DUPLICATE_SEQ, last_base_off
+            if base_seq != next_seq:
+                return ERR_OUT_OF_ORDER_SEQ, -1
+        else:
+            # new producer session on this partition: sequences
+            # restart at 0 (the epoch scopes them)
+            if base_seq != 0:
+                return ERR_OUT_OF_ORDER_SEQ, -1
+        meta = {
+            "pid": ppid,
+            "epoch": epoch,
+            "base_seq": base_seq,
+            "txn": bm["transactional"],
+            "control": None,
+        }
+        base = self._append_locked(t, pid, msgs, meta)
+        self._seqs[key][ppid] = (
+            base_seq + len(msgs), base_seq, base, epoch
+        )
+        if bm["transactional"]:
+            self._open_txn.setdefault(key, {}).setdefault(ppid, base)
+        return 0, base
+
+    # -- transaction coordinator ------------------------------------------
+    def _fence_pid_locked(self, ppid: int) -> None:
+        """Server-side epoch bump: the current holder of ``ppid``
+        becomes a zombie (its next request answers 47)."""
+        if ppid in self._pid_epoch:
+            self._pid_epoch[ppid] += 1
+            for entry in self._txns.values():
+                if entry["pid"] == ppid:
+                    entry["epoch"] = self._pid_epoch[ppid]
+
+    def _abort_ongoing_locked(self, txn_id: str) -> None:
+        """Abort ``txn_id``'s ongoing transaction (markers written,
+        aborted index updated) — the transaction-timeout shape."""
+        entry = self._txns.get(txn_id)
+        if entry is not None and entry["state"] == "ongoing":
+            self._complete_txn_locked(entry, commit=False)
+
+    def _complete_txn_locked(self, entry: dict, commit: bool) -> None:
+        """Write a COMMIT/ABORT control batch to every partition the
+        transaction registered, update the aborted index, close the
+        transaction server-side."""
+        verdict = "commit" if commit else "abort"
+        for key in sorted(entry["partitions"]):
+            log = self.logs.setdefault(key, [])
+            marker_off = len(log)
+            self.bounds.setdefault(key, []).append(marker_off)
+            self.batch_meta.setdefault(key, []).append({
+                "pid": entry["pid"],
+                "epoch": entry["epoch"],
+                "base_seq": -1,
+                "txn": True,
+                "control": verdict,
+            })
+            log.append((0, b""))  # the marker occupies one offset
+            first = self._open_txn.get(key, {}).pop(entry["pid"], None)
+            if not commit and first is not None:
+                self.aborted.setdefault(key, []).append(
+                    (entry["pid"], first, marker_off)
+                )
+        entry["state"] = "empty"
+        entry["partitions"] = set()
+
+    def _init_producer_id(
+        self, r: Reader, w: Writer, forced_err: int = 0
+    ) -> None:
+        txn_id = r.string()
+        r.i32()  # transaction_timeout_ms (never enforced here)
+        if forced_err:
+            w.i32(0).i16(forced_err).i64(-1).i16(-1)
+            return
+        with self._lock:
+            if txn_id is None:
+                # idempotence-only producer: fresh pid, epoch 0
+                ppid, epoch = self._next_pid, 0
+                self._next_pid += 1
+            else:
+                entry = self._txns.get(txn_id)
+                if entry is None:
+                    entry = {
+                        "pid": self._next_pid,
+                        "epoch": 0,
+                        "state": "empty",
+                        "partitions": set(),
+                    }
+                    self._next_pid += 1
+                    self._txns[txn_id] = entry
+                else:
+                    # the fencing moment: older holders of this id
+                    # are zombies from here on, and whatever they
+                    # left open is aborted
+                    if entry["state"] == "ongoing":
+                        self._complete_txn_locked(entry, commit=False)
+                    entry["epoch"] += 1
+                ppid, epoch = entry["pid"], entry["epoch"]
+            self._pid_epoch[ppid] = epoch
+        w.i32(0).i16(0).i64(ppid).i16(epoch)
+
+    def _add_partitions_to_txn(
+        self, r: Reader, w: Writer, forced_err: int = 0,
+        fence: bool = False, abort_txn: bool = False,
+    ) -> None:
+        txn_id = r.string()
+        ppid = r.i64()
+        epoch = r.i16()
+        topics = []
+        for _ in range(r.i32()):
+            t = r.string()
+            parts = [r.i32() for _ in range(r.i32())]
+            topics.append((t, parts))
+        with self._lock:
+            if fence:
+                self._fence_pid_locked(ppid)
+            if abort_txn and txn_id is not None:
+                self._abort_ongoing_locked(txn_id)
+            entry = self._txns.get(txn_id)
+            if forced_err:
+                err = forced_err
+            elif entry is None or entry["pid"] != ppid:
+                err = ERR_INVALID_PID_MAPPING
+            elif epoch != entry["epoch"]:
+                err = ERR_INVALID_EPOCH
+            else:
+                err = 0
+                entry["state"] = "ongoing"
+                for t, parts in topics:
+                    for p in parts:
+                        entry["partitions"].add((t, p))
+                        self.logs.setdefault((t, p), [])
+        w.i32(0).i32(len(topics))
+        for t, parts in topics:
+            w.string(t).i32(len(parts))
+            for p in parts:
+                w.i32(p).i16(err)
+
+    def _end_txn(
+        self, r: Reader, w: Writer, forced_err: int = 0,
+        fence: bool = False, abort_txn: bool = False,
+    ) -> None:
+        txn_id = r.string()
+        ppid = r.i64()
+        epoch = r.i16()
+        commit = bool(r.i8())
+        with self._lock:
+            if fence:
+                self._fence_pid_locked(ppid)
+            if abort_txn and txn_id is not None:
+                self._abort_ongoing_locked(txn_id)
+            entry = self._txns.get(txn_id)
+            if forced_err:
+                err = forced_err
+            elif entry is None or entry["pid"] != ppid:
+                err = ERR_INVALID_PID_MAPPING
+            elif epoch != entry["epoch"]:
+                err = ERR_INVALID_EPOCH
+            elif entry["state"] != "ongoing":
+                # nothing open: for a RESUMED commit this is the
+                # already-completed signal (see runtime/kafka.py)
+                err = ERR_INVALID_TXN_STATE
+            else:
+                err = 0
+                self._complete_txn_locked(entry, commit=commit)
+        w.i32(0).i16(err)
+
+
+def read_topic(
+    bootstrap: str,
+    topic: str,
+    partition: int = 0,
+    committed: bool = True,
+) -> List[bytes]:
+    """Drain one partition through the REAL client and return its data
+    record values — the external observer the exactly-once claims are
+    asserted against. ``committed=True`` consumes read_committed
+    (isolation 1: capped at the LSO, aborted transactions filtered by
+    the client from the wire index); ``committed=False`` consumes
+    read_uncommitted, where aborted/open transactional data is still
+    visible. Control-batch and aborted-record offsets advance without
+    contributing values. Assumes a quiescent broker (post-run): stops
+    at the first fetch that makes no progress."""
+    from flink_siddhi_tpu.runtime.kafka import KafkaClient
+
+    host, _, port = bootstrap.partition(":")
+    client = KafkaClient(host, int(port or 9092))
+    try:
+        off = client.list_offsets(topic, [partition], -2)[partition]
+        values: List[bytes] = []
+        while True:
+            res = client.fetch(
+                topic, {partition: off},
+                isolation=1 if committed else 0,
+            )
+            _hw, records, _raw = res[partition]
+            progressed = False
+            for o, _ts, _k, v in records:
+                if o < off:
+                    continue  # whole-batch resend below the position
+                if v is not None:
+                    values.append(v)
+                off = o + 1
+                progressed = True
+            if not progressed:
+                return values
+    finally:
+        client.close()

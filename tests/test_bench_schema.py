@@ -1027,6 +1027,143 @@ def test_recovery_requires_a_real_crash_and_clean_tmp():
     assert any("stale_tmp_swept" in e for e in errors)
 
 
+# -- v10: transactional sub-block of the recovery block --------------------
+
+
+def _txn_block(**over):
+    txn = {
+        "events": 8_192,
+        "crash_pulls": [3],
+        "kill_mid_checkpoint": True,
+        "kill_mid_transaction": True,
+        "crashes": 3,
+        "restarts": 3,
+        "recovery_time_ms": 101.4,
+        "rows_emitted": 8_192,
+        "read_committed_duplicates": 0,
+        "read_committed_lost": 0,
+        "exactly_once": True,
+        "read_uncommitted_rows": 9_001,
+        "aborted_rows_invisible": True,
+        "elapsed_s": 4.2,
+    }
+    txn.update(over)
+    return txn
+
+
+def _v10_doc(**over):
+    doc = _v9_doc()
+    doc["schema_version"] = 10
+    doc.update(over)
+    return doc
+
+
+def test_valid_v10_doc_passes():
+    """v10 without --fault is fine (the block stays optional), and
+    with the full recovery + transactional pair it validates."""
+    errors = []
+    CHECK.validate_doc(_v10_doc(), errors, "doc")
+    assert errors == []
+    errors = []
+    CHECK.validate_doc(
+        _v10_doc(
+            recovery=_recovery_block(transactional=_txn_block())
+        ),
+        errors, "doc",
+    )
+    assert errors == []
+
+
+def test_v10_recovery_requires_transactional_subblock():
+    """From v10, a recovery block that only diffed INTERNAL results is
+    an incomplete exactly-once claim — the external read-committed
+    boundary must be measured."""
+    doc = _v10_doc(recovery=_recovery_block())
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "transactional sub-block" in e and "read-committed" in e
+        for e in errors
+    )
+
+
+def test_v9_era_recovery_exempt_but_present_txn_block_validated():
+    """Pre-v10 lines need no transactional sub-block, but one that IS
+    present is held to its contract (the disorder/control exemption
+    shape)."""
+    errors = []
+    CHECK.validate_doc(
+        _v9_doc(recovery=_recovery_block()), errors, "doc"
+    )
+    assert errors == []
+    doc = _v9_doc(
+        recovery=_recovery_block(
+            transactional=_txn_block(read_committed_duplicates=2)
+        )
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("read_committed_duplicates" in e for e in errors)
+
+
+def test_txn_subblock_external_duplicates_or_losses_fail():
+    for key in ("read_committed_duplicates", "read_committed_lost"):
+        doc = _v10_doc(
+            recovery=_recovery_block(
+                transactional=_txn_block(**{key: 1})
+            )
+        )
+        errors = []
+        CHECK.validate_doc(doc, errors, "doc")
+        assert any(
+            key in e and "external boundary" in e for e in errors
+        ), key
+
+
+def test_txn_subblock_must_be_a_real_measurement():
+    """recovery_time_ms must be finite-positive, the
+    kill-mid-transaction must actually have fired, and the aborted
+    debris must have stayed invisible — otherwise the block measured
+    nothing (or worse, leaked)."""
+    for bad in (None, 0, -1.0, float("nan")):
+        doc = _v10_doc(
+            recovery=_recovery_block(
+                transactional=_txn_block(recovery_time_ms=bad)
+            )
+        )
+        errors = []
+        CHECK.validate_doc(doc, errors, "doc")
+        assert any(
+            "transactional" in e and "recovery_time_ms" in e
+            for e in errors
+        ), bad
+    doc = _v10_doc(
+        recovery=_recovery_block(
+            transactional=_txn_block(kill_mid_transaction=False)
+        )
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("kill_mid_transaction" in e for e in errors)
+    doc = _v10_doc(
+        recovery=_recovery_block(
+            transactional=_txn_block(aborted_rows_invisible=False)
+        )
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("aborted_rows_invisible" in e for e in errors)
+    doc = _v10_doc(
+        recovery=_recovery_block(transactional=_txn_block(crashes=0))
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "transactional" in e and "measures nothing" in e
+        for e in errors
+    )
+
+
 def test_fault_block_live_and_gate_accepts():
     """The live --fault contract: bench._fault_recovery_block runs the
     supervised crash schedule (two pull-kills + one
@@ -1062,21 +1199,40 @@ def test_fault_block_live_and_gate_accepts():
     assert block["lost_rows"] == 0
     assert block["exactly_once"] is True
     assert block["stale_tmp_swept"] is True
+    # v10: the transactional leg rode the same producer run — its
+    # exactly-once numbers are EXTERNAL (read-committed topic vs
+    # oracle) and the kill-mid-transaction really fired
+    txn = block["transactional"]
+    assert txn["kill_mid_transaction"] is True
+    assert txn["crashes"] >= 2
+    assert math.isfinite(txn["recovery_time_ms"])
+    assert txn["recovery_time_ms"] > 0
+    assert txn["read_committed_duplicates"] == 0
+    assert txn["read_committed_lost"] == 0
+    assert txn["exactly_once"] is True
+    assert txn["read_uncommitted_rows"] > txn["rows_emitted"]
+    assert txn["aborted_rows_invisible"] is True
     errors = []
     CHECK.validate_doc(_v4_doc(recovery=block), errors, "doc")
     assert errors == []
+    # and attached to a v10 line it satisfies the REQUIRED contract
+    errors = []
+    CHECK.validate_doc(_v10_doc(recovery=block), errors, "doc")
+    assert errors == []
 
 
-def test_dryrun_emits_schema_complete_v9(tmp_path):
+def test_dryrun_emits_schema_complete_v10(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
     replay, short paced phase) exercises resident + streaming + sink,
     the out-of-process prober, the small-skew disorder sweep, the
     control-plane sustained-load run (with the v8 per-plan
     attribution block), AND the v9 measured limiting-leg verdict per
-    mode, and its JSON line passes the v9 schema gate — in the tier-1
-    lane, under its timeout. (The --fault recovery block has its own
-    in-process live test below, so this subprocess stays at its
-    historical cost.)"""
+    mode, and its JSON line passes the v10 schema gate — in the
+    tier-1 lane, under its timeout. (The --fault recovery block —
+    which v10 gates the transactional sub-block inside of — has its
+    own live subprocess test above, so this one stays at its
+    historical cost; the v10 gate on THIS line only requires that a
+    recovery block, when present, carries the sub-block.)"""
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
@@ -1125,7 +1281,7 @@ def test_dryrun_emits_schema_complete_v9(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 9
+    assert doc["schema_version"] == 10
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]

@@ -31,11 +31,25 @@ from flink_siddhi_tpu.connectors.kafka.records import (
     encode_message_set,
     encode_record_batch,
 )
+from flink_siddhi_tpu.connectors.kafka.errors import (
+    BrokerErrorResponse,
+    ProducerFencedError,
+    is_retryable,
+)
 from flink_siddhi_tpu.connectors.kafka.protocol import (
+    API_END_TXN,
     API_FETCH,
+    API_INIT_PRODUCER_ID,
     API_PRODUCE,
     ProtocolError,
+    Reader,
+    Writer,
     negotiate,
+)
+from flink_siddhi_tpu.connectors.kafka.txn import (
+    decode_add_partitions_response,
+    decode_end_txn_response,
+    decode_init_producer_id_response,
 )
 from flink_siddhi_tpu.connectors.kafka.varint import (
     VarintError,
@@ -45,7 +59,7 @@ from flink_siddhi_tpu.connectors.kafka.varint import (
     encode_varlong,
 )
 from flink_siddhi_tpu.runtime.kafka import KafkaClient, KafkaError
-from tests.fake_kafka import FakeBroker
+from tests.fake_kafka import FakeBroker, read_topic
 
 
 # -- varints ---------------------------------------------------------------
@@ -400,3 +414,314 @@ def test_broker_rejects_corrupt_produced_batch():
         client.close()
     finally:
         broker.close()
+
+# -- KIP-98 transactions: codecs, coordinator, fencing, visibility ----------
+
+def test_txn_response_codecs_and_error_mapping():
+    """Pure wire codecs: happy-path decode plus the error taxonomy —
+    47 surfaces as ProducerFencedError (fatal), 51 stays retryable
+    (CONCURRENT_TRANSACTIONS), 48 is the resume-commit signal."""
+    r = Reader(Writer().i32(0).i16(0).i64(900).i16(7).done())
+    assert decode_init_producer_id_response(r) == (900, 7)
+    r = Reader(Writer().i32(0).i16(47).i64(-1).i16(-1).done())
+    with pytest.raises(ProducerFencedError) as ei:
+        decode_init_producer_id_response(r)
+    assert ei.value.code == 47 and not is_retryable(ei.value)
+    # AddPartitions: the first per-partition error surfaces, located
+    w = Writer().i32(0).i32(1).string("t").i32(2)
+    w.i32(0).i16(0).i32(1).i16(51)
+    with pytest.raises(BrokerErrorResponse, match=r"t\[1\]") as ei:
+        decode_add_partitions_response(Reader(w.done()))
+    assert ei.value.code == 51 and is_retryable(ei.value)
+    with pytest.raises(BrokerErrorResponse) as ei:
+        decode_end_txn_response(Reader(Writer().i32(0).i16(48).done()))
+    assert ei.value.code == 48 and not is_retryable(ei.value)
+
+
+def test_init_producer_id_fencing_matrix():
+    """Re-running InitProducerId on one transactional id keeps the
+    producer id but bumps the epoch; every transactional api then
+    refuses the older epoch with 47 (ProducerFencedError, fatal)."""
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t")
+        client = KafkaClient(broker.host, broker.port)
+        pid, e0 = client.init_producer_id("tx-a")
+        pid2, e1 = client.init_producer_id("tx-a")
+        assert pid2 == pid and e1 == e0 + 1
+        other, oe = client.init_producer_id("tx-b")
+        assert other != pid and oe == 0  # distinct id, fresh mapping
+        with pytest.raises(ProducerFencedError):
+            client.add_partitions_to_txn("tx-a", pid, e0, [("t", 0)])
+        client.add_partitions_to_txn("tx-a", pid, e1, [("t", 0)])
+        with pytest.raises(ProducerFencedError):
+            client.produce(
+                "t", 0, [b"zombie"], transactional_id="tx-a",
+                producer_id=pid, producer_epoch=e0,
+                base_sequence=0, transactional=True,
+            )
+        with pytest.raises(ProducerFencedError):
+            client.end_txn("tx-a", pid, e0, commit=True)
+        # the zombie's data never landed
+        assert broker.logs[("t", 0)] == []
+        # unknown producer id: INVALID_PRODUCER_ID_MAPPING, fatal
+        with pytest.raises(BrokerErrorResponse) as ei:
+            client.end_txn("tx-a", 424242, e1, commit=True)
+        assert ei.value.code == 49 and not is_retryable(ei.value)
+        client.close()
+    finally:
+        broker.close()
+
+
+def test_transactional_visibility_and_control_batch_placement():
+    """Open transaction: invisible read_committed, visible
+    read_uncommitted. EndTxn(commit) writes the control batch at the
+    offset AFTER the data (hw includes it; consumers get a null-value
+    record there so positions advance), and a second EndTxn answers
+    INVALID_TXN_STATE — the resume-commit 'already done' signal."""
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t")
+        client = KafkaClient(broker.host, broker.port)
+        pid, ep = client.init_producer_id("tx")
+        client.add_partitions_to_txn("tx", pid, ep, [("t", 0)])
+        client.produce(
+            "t", 0, [b"a", b"b"], transactional_id="tx",
+            producer_id=pid, producer_epoch=ep,
+            base_sequence=0, transactional=True,
+        )
+        assert read_topic(broker.bootstrap, "t", committed=True) == []
+        assert read_topic(broker.bootstrap, "t", committed=False) == [
+            b"a", b"b",
+        ]
+        client.end_txn("tx", pid, ep, commit=True)
+        assert read_topic(broker.bootstrap, "t", committed=True) == [
+            b"a", b"b",
+        ]
+        hw, records, _ = client.fetch("t", {0: 0})[0]
+        assert hw == 3  # two data offsets + the commit marker
+        assert [(o, v) for o, _ts, _k, v in records] == [
+            (0, b"a"), (1, b"b"), (2, None),
+        ]
+        with pytest.raises(BrokerErrorResponse) as ei:
+            client.end_txn("tx", pid, ep, commit=True)
+        assert ei.value.code == 48
+        client.close()
+    finally:
+        broker.close()
+
+
+def test_aborted_transaction_stays_invisible_forever():
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t")
+        client = KafkaClient(broker.host, broker.port)
+        pid, ep = client.init_producer_id("tx")
+        client.add_partitions_to_txn("tx", pid, ep, [("t", 0)])
+        client.produce(
+            "t", 0, [b"discarded"], transactional_id="tx",
+            producer_id=pid, producer_epoch=ep,
+            base_sequence=0, transactional=True,
+        )
+        client.end_txn("tx", pid, ep, commit=False)
+        assert read_topic(broker.bootstrap, "t", committed=True) == []
+        # a later committed transaction interleaves cleanly: only ITS
+        # rows surface read_committed, both surface read_uncommitted
+        pid, ep = client.init_producer_id("tx")
+        client.add_partitions_to_txn("tx", pid, ep, [("t", 0)])
+        client.produce(
+            "t", 0, [b"kept"], transactional_id="tx",
+            producer_id=pid, producer_epoch=ep,
+            base_sequence=0, transactional=True,
+        )
+        client.end_txn("tx", pid, ep, commit=True)
+        assert read_topic(broker.bootstrap, "t", committed=True) == [
+            b"kept",
+        ]
+        assert read_topic(broker.bootstrap, "t", committed=False) == [
+            b"discarded", b"kept",
+        ]
+        client.close()
+    finally:
+        broker.close()
+
+
+def test_fetch_wire_carries_aborted_transactions_index():
+    """Raw v4 read_committed Fetch: the last_stable_offset and the
+    (producer_id, first_offset) aborted-transactions index are on the
+    wire — the KIP-98 contract the client-side filter consumes."""
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t")
+        client = KafkaClient(broker.host, broker.port)
+        pid, ep = client.init_producer_id("tx")
+        client.add_partitions_to_txn("tx", pid, ep, [("t", 0)])
+        client.produce(
+            "t", 0, [b"dead"], transactional_id="tx",
+            producer_id=pid, producer_epoch=ep,
+            base_sequence=0, transactional=True,
+        )
+        client.end_txn("tx", pid, ep, commit=False)
+        client.api_versions()  # pin the modern dialect
+        w = Writer()
+        w.i32(-1).i32(0).i32(0)  # replica, max_wait, min_bytes
+        w.i32(1 << 20).i8(1)  # max_bytes, isolation=read_committed
+        w.i32(1).string("t").i32(1)
+        w.i32(0).i64(0).i32(1 << 20)
+        r = client._call(API_FETCH, 4, w.done())
+        r.i32()  # throttle
+        assert r.i32() == 1 and r.string() == "t" and r.i32() == 1
+        part, err, hw = r.i32(), r.i16(), r.i64()
+        lso = r.i64()
+        aborted = [(r.i64(), r.i64()) for _ in range(r.i32())]
+        assert (part, err) == (0, 0)
+        assert hw == 2 and lso == 2  # data + marker, all decided
+        assert aborted == [(pid, 0)]
+        client.close()
+    finally:
+        broker.close()
+
+
+def test_idempotent_produce_dedupes_and_rejects_gaps():
+    """Produce-side idempotence without a transaction: a re-send of
+    the last appended batch acks with its ORIGINAL base offset and
+    appends nothing (DUPLICATE_SEQUENCE_NUMBER, success client-side);
+    a sequence gap is OUT_OF_ORDER (45, fatal); a fresh producer
+    session must restart sequences at 0."""
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t")
+        client = KafkaClient(broker.host, broker.port)
+        pid, ep = client.init_producer_id(None)  # idempotence-only
+        kw = dict(producer_id=pid, producer_epoch=ep)
+        assert client.produce("t", 0, [b"a", b"b"],
+                              base_sequence=0, **kw) == 0
+        # the wire-retry shape: identical re-send, same base back
+        assert client.produce("t", 0, [b"a", b"b"],
+                              base_sequence=0, **kw) == 0
+        assert [v for _, v in broker.logs[("t", 0)]] == [b"a", b"b"]
+        with pytest.raises(BrokerErrorResponse) as ei:
+            client.produce("t", 0, [b"gap"], base_sequence=5, **kw)
+        assert ei.value.code == 45 and not is_retryable(ei.value)
+        assert client.produce("t", 0, [b"c"],
+                              base_sequence=2, **kw) == 2
+        # new session on the same partition: epoch scopes sequences
+        pid2, ep2 = client.init_producer_id(None)
+        with pytest.raises(BrokerErrorResponse) as ei:
+            client.produce("t", 0, [b"x"], base_sequence=3,
+                           producer_id=pid2, producer_epoch=ep2)
+        assert ei.value.code == 45
+        assert client.produce("t", 0, [b"x"], base_sequence=0,
+                              producer_id=pid2,
+                              producer_epoch=ep2) == 3
+        client.close()
+    finally:
+        broker.close()
+
+
+def test_fault_hook_fence_action_turns_holder_into_zombie():
+    """The seeded-fault 'fence' action (opt-in, never in the default
+    FaultSchedule draw): the broker bumps the requester's epoch
+    server-side, so the request itself answers 47 — the shape of a
+    competing restart racing the running producer. Re-running
+    InitProducerId recovers with a fresh epoch."""
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t")
+        armed = {"on": False}
+
+        def hook(api, seq):
+            if armed["on"] and api == API_PRODUCE:
+                armed["on"] = False
+                return "fence"
+            return None
+
+        broker.fault_hook = hook
+        client = KafkaClient(broker.host, broker.port)
+        pid, ep = client.init_producer_id("tx")
+        client.add_partitions_to_txn("tx", pid, ep, [("t", 0)])
+        armed["on"] = True
+        with pytest.raises(ProducerFencedError):
+            client.produce(
+                "t", 0, [b"z"], transactional_id="tx",
+                producer_id=pid, producer_epoch=ep,
+                base_sequence=0, transactional=True,
+            )
+        assert broker.logs[("t", 0)] == []  # fenced data never lands
+        pid2, ep2 = client.init_producer_id("tx")
+        assert pid2 == pid and ep2 > ep
+        client.add_partitions_to_txn("tx", pid2, ep2, [("t", 0)])
+        client.produce(
+            "t", 0, [b"ok"], transactional_id="tx",
+            producer_id=pid2, producer_epoch=ep2,
+            base_sequence=0, transactional=True,
+        )
+        client.end_txn("tx", pid2, ep2, commit=True)
+        assert read_topic(broker.bootstrap, "t", committed=True) == [
+            b"ok",
+        ]
+        client.close()
+    finally:
+        broker.close()
+
+
+def test_fault_hook_abort_txn_action_is_the_timeout_shape():
+    """The 'abort_txn' action aborts the requester's ongoing
+    transaction server-side before serving — the transaction-timeout
+    shape real brokers add. The commit then answers 48 (nothing open)
+    and the rows stay invisible read_committed: exactly the ambiguity
+    docs/fault_tolerance.md documents for resumed commits."""
+    broker = FakeBroker()
+    try:
+        broker.create_topic("t")
+        armed = {"on": False}
+
+        def hook(api, seq):
+            if armed["on"] and api == API_END_TXN:
+                armed["on"] = False
+                return "abort_txn"
+            return None
+
+        broker.fault_hook = hook
+        client = KafkaClient(broker.host, broker.port)
+        pid, ep = client.init_producer_id("tx")
+        client.add_partitions_to_txn("tx", pid, ep, [("t", 0)])
+        client.produce(
+            "t", 0, [b"timed-out"], transactional_id="tx",
+            producer_id=pid, producer_epoch=ep,
+            base_sequence=0, transactional=True,
+        )
+        armed["on"] = True
+        with pytest.raises(BrokerErrorResponse) as ei:
+            client.end_txn("tx", pid, ep, commit=True)
+        assert ei.value.code == 48
+        assert read_topic(broker.bootstrap, "t", committed=True) == []
+        assert read_topic(broker.bootstrap, "t", committed=False) == [
+            b"timed-out",
+        ]
+        client.close()
+    finally:
+        broker.close()
+
+
+def test_transactional_apis_negotiation_and_legacy_refusal():
+    """The modern fake broker advertises apis 22/24/26 at v0; a
+    legacy broker does not, and because negotiate() blanket-falls-back
+    to v0 for OMITTED apis, the transactional path must refuse loudly
+    via its own preflight instead of trusting the fallback."""
+    broker = FakeBroker()
+    try:
+        client = KafkaClient(broker.host, broker.port)
+        picks = client.api_versions()
+        assert picks[API_INIT_PRODUCER_ID] == 0
+        client.close()
+    finally:
+        broker.close()
+    legacy = FakeBroker(legacy=True)
+    try:
+        client = KafkaClient(legacy.host, legacy.port)
+        with pytest.raises(KafkaError, match="advertise"):
+            client.init_producer_id("tx")
+        client.close()
+    finally:
+        legacy.close()

@@ -727,6 +727,22 @@ def test_error_taxonomy():
     assert not is_retryable(ValueError("x"))
     assert is_connection_error(BrokerIOError("x"))
     assert not is_connection_error(BrokerErrorResponse("x", code=6))
+    # KIP-98 transaction codes: fencing is FATAL (a newer incarnation
+    # owns the id — retrying forever would mask a split-brain), a
+    # coordinator mid-transition is retryable, sequence/state/mapping
+    # violations are fatal correctness signals
+    from flink_siddhi_tpu.connectors.kafka.errors import (
+        ProducerFencedError,
+        broker_error,
+    )
+
+    fenced = broker_error("x", 47, "produce")
+    assert isinstance(fenced, ProducerFencedError)
+    assert not is_retryable(fenced)
+    assert is_retryable(broker_error("x", 51))  # CONCURRENT_TXNS
+    assert not is_retryable(broker_error("x", 45))  # OUT_OF_ORDER_SEQ
+    assert not is_retryable(broker_error("x", 48))  # INVALID_TXN_STATE
+    assert not is_retryable(broker_error("x", 49))  # INVALID_PID_MAPPING
 
 
 # -- checkpoint safelist (the loud-rejection satellite rides here too) ------
@@ -751,3 +767,164 @@ def test_checkpoint_load_rejects_arbitrary_classes(tmp_path):
     )
     out = ckpt_mod.safe_load_snapshot(_io.BytesIO(ok))
     assert out["b"] == 1.5 and list(out["a"]) == [0, 1, 2]
+
+
+# -- kill zoo: transactional sink (kill-mid-transaction, zombies) -----------
+
+# Two crash plans against the transactional KafkaSink; both include a
+# kill-mid-CHECKPOINT (a doomed prepared transaction restore must
+# abort) and a kill-mid-TRANSACTION (after the snapshot committed,
+# before EndTxn — restore must RESUME the commit):
+#   * plan A dies at commit 1 (second epoch already checkpointed on a
+#     later run), so the resumed supervisor replays the resume-commit
+#     path twice across restarts — the second resume hits the broker's
+#     INVALID_TXN_STATE "already committed" answer and tolerates it;
+#   * plan B dies at commit 2 AND at pull 3 before the first
+#     checkpoint ever lands — the scratch rebuild re-runs
+#     InitProducerId with no snapshot, fencing the dead run's epoch
+#     and aborting its data-bearing orphan.
+_TXN_PLANS = [
+    ("resume-commit", dict(at_pulls=(4,), at_checkpoints=(2,), at_commits=(1,))),
+    ("scratch-zombie", dict(at_pulls=(3,), at_checkpoints=(2,), at_commits=(2,))),
+]
+
+
+@pytest.mark.parametrize(
+    "plan_kw", [kw for _, kw in _TXN_PLANS],
+    ids=[name for name, _ in _TXN_PLANS],
+)
+def test_supervised_transactional_sink_exactly_once(tmp_path, plan_kw):
+    """The tentpole acceptance: process deaths mid-checkpoint, mid-
+    transaction (between the durable snapshot and EndTxn), and between
+    checkpoints — and the EXTERNAL read-committed topic still equals
+    the unfaulted oracle with zero duplicates and zero losses, while
+    read_uncommitted sees the aborted debris the dead runs left."""
+    from flink_siddhi_tpu.runtime.kafka import KafkaSink
+    from tests.fake_kafka import read_topic
+
+    n = 96
+    broker = FakeBroker()
+    try:
+        broker.create_topic("out")
+        schema = _schema()
+        crash = CrashPlan(**plan_kw)
+
+        def factory():
+            src = ListSource(
+                "S", schema, _record_tuples(n), ts_field="timestamp",
+            )
+            job = Job(
+                [compile_plan(CQL, {"S": schema})], [src],
+                batch_size=16, retain_results=False,
+            )
+            job.add_sink(
+                "out",
+                KafkaSink(
+                    broker.bootstrap, "out", ["id", "t", "c"],
+                    stream_id="out", transactional_id="tx",
+                    flush_every=8,
+                ),
+            )
+            return wrap_job(job, crash)
+
+        sup = Supervisor(
+            factory, str(tmp_path / "ckpt"),
+            checkpoint_every_cycles=3, keep_checkpoints=3,
+            max_restarts=10, restart_window_s=3600.0,
+        )
+        sup.run()
+        # every scheduled death actually fired
+        assert crash.crashes == sum(
+            len(plan_kw[k]) for k in plan_kw
+        )
+        # internal account matches the oracle (the old contract) ...
+        oracle = _oracle_rows(n)
+        assert sup.results_with_ts("out") == oracle
+
+        # ... and so does the EXTERNAL read-committed topic: the new
+        # contract. Multisets of full rows — order within the topic is
+        # append order, so compare content-exactly, not sequence.
+        import collections
+
+        expect = collections.Counter(
+            (ts, row[0], row[1], row[2]) for ts, row in oracle
+        )
+        rc = [
+            json.loads(v)
+            for v in read_topic(broker.bootstrap, "out", committed=True)
+        ]
+        got = collections.Counter(
+            (d["ts"], d["id"], d["t"], d["c"]) for d in rc
+        )
+        assert sum((got - expect).values()) == 0  # duplicates
+        assert sum((expect - got).values()) == 0  # losses
+        # the dead runs really wrote into transactions that were then
+        # aborted: read_uncommitted must see strictly more rows
+        ru = read_topic(broker.bootstrap, "out", committed=False)
+        assert len(ru) > len(rc)
+        # checkpoint debris swept (same invariant as the plain zoo)
+        assert glob.glob(str(tmp_path / "ckpt" / "*.tmp.*")) == []
+        # observability: health names the sink's transactional state,
+        # the journal carries the txn lifecycle
+        h = sup.health()
+        (txs,) = h["transactional_sinks"]
+        assert txs["stream"] == "out"
+        assert txs["transactional_id"] == "tx"
+        assert txs["commits"] >= 1 and txs["pending"] is False
+        kinds = sup.job.flightrec.counts_by_kind()
+        assert any(k.startswith("txn.") for k in kinds)
+    finally:
+        broker.close()
+
+
+def test_zombie_producer_fenced_and_rows_invisible():
+    """Split-brain: a paused incarnation keeps producing while a
+    restarted one re-initialises the same transactional id. The
+    broker's epoch fence turns the zombie's next produce into a FATAL
+    ProducerFencedError, its open transaction is aborted, and none of
+    its rows ever reach a read-committed consumer."""
+    from flink_siddhi_tpu.connectors.kafka.errors import (
+        ProducerFencedError,
+    )
+    from flink_siddhi_tpu.runtime.kafka import KafkaSink
+    from tests.fake_kafka import read_topic
+
+    broker = FakeBroker()
+    try:
+        broker.create_topic("out")
+        old = KafkaSink(
+            broker.bootstrap, "out", ["id"], stream_id="out",
+            transactional_id="tx", flush_every=1,
+        )
+        old(1000, [1])  # opens epoch-0's transaction, row in flight
+        # the "restart": a new incarnation adopts the checkpointed
+        # state (here: the pristine one) and eagerly re-fences
+        new = KafkaSink(
+            broker.bootstrap, "out", ["id"], stream_id="out",
+            transactional_id="tx", flush_every=1,
+        )
+        new.load_state_dict({"epoch_n": 0, "produced": 0})
+        # the zombie's next emit dies on the fence, permanently
+        with pytest.raises(ProducerFencedError):
+            old(1010, [2])
+        assert old.txn_stats()["fenced"] >= 1
+        # the survivor commits its epoch; only ITS row is visible
+        new(1020, [3])
+        new.prepare_commit()
+        new.commit_transaction()
+        rc = [
+            json.loads(v)
+            for v in read_topic(broker.bootstrap, "out", committed=True)
+        ]
+        assert [(d["ts"], d["id"]) for d in rc] == [(1020, 3)]
+        # the zombie's orphan really reached the log — aborted, not
+        # lost in the client: read_uncommitted shows it
+        ru = [
+            json.loads(v)
+            for v in read_topic(broker.bootstrap, "out", committed=False)
+        ]
+        assert (1000, 1) in [(d["ts"], d["id"]) for d in ru]
+        new.close()
+        old.close()
+    finally:
+        broker.close()
