@@ -5,7 +5,7 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line (``schema_version: 10``). One invocation measures
+Prints ONE JSON line (``schema_version: 11``). One invocation measures
 THREE execution modes and emits all of them in the same document, so a
 regression in any path stays a tracked number:
 
@@ -146,6 +146,26 @@ is then diffed against the unfaulted oracle, and
 0 with a finite measured ``recovery_time_ms`` (the gate rejects
 anything else). BENCH_FAULT_TXN_EVENTS / BENCH_FAULT_TXN_BATCH size
 it.
+
+Schema v11 (serving-observatory round) adds ``--serve``: a SEPARATE
+serving-only JSON line (no mode sections) from one process serving a
+mixed multi-tenant query stack — filters, patterns, windows, and a
+multiquery stack admitted through the live control plane REST — over
+shared Kafka ingest (the in-repo fake broker) with supervisor
+checkpoints, DisorderSchedule arrival, a mid-run broker fault window,
+admit/retire churn, and a mid-run storm tenant all ON. The open-loop
+offered rate is paced against the wall clock; ``--serve`` binary
+searches it for the max sustainable aggregate load, ``--serve
+--dryrun`` runs ONE fixed-load pass (the tier-1 lane). EVERY verdict
+in the ``serving`` block — sustained ev/s, per-tenant p99 spread, the
+storm-isolation ratio, the SLO violation account reconciled exactly
+against the flight-recorder journal, the named limiting leg — is read
+back off the PUBLIC observability surface (``/api/v1/metrics
+/prometheus`` scrapes, ``/api/v1/slo``, ``/api/v1/flightrecorder``,
+``/health``), never from Job internals, and re-derived by
+scripts/check_bench_schema.py. BENCH_SERVE_RATE / BENCH_SERVE_SECONDS
+/ BENCH_SERVE_TENANTS size it; docs/observability.md documents the
+fields.
 
 Honest wall-clock accounting: every mode section carries a
 ``stage_breakdown`` computed from the telemetry subsystem
@@ -1652,6 +1672,11 @@ def main():
             config, int(os.environ.get("BENCH_BASELINE_EVENTS", 1_000_000))
         )
         return
+    if "--serve" in sys.argv:
+        # the serving observatory is its own document kind: a
+        # serving-only v11 line, separate from the mode sections
+        run_serve(dryrun)
+        return
     want_modes = [
         m
         for m in os.environ.get(
@@ -2316,6 +2341,1029 @@ def _latency_phase(config, rate, dryrun=False):
     hist = LatencyHistogram()
     hist.record_many_seconds(samples or [t for t, _ in lat])
     return hist, phases, probe
+
+
+# -- schema v11: the serving observatory (--serve) ---------------------------
+
+SERVE_PROBE_ID = 999  # background ids stay < n_ids (50); probes are disjoint
+_SERVE_STORM_ID = 7  # the storm tenant's filter id (skewed mid-run)
+
+
+def _http(port, method, path, body=None, timeout=5.0):
+    """One REST round trip -> (status, parsed JSON or raw text)."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://127.0.0.1:{port}{path}"
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read().decode()
+            code = resp.status
+    except urllib.error.HTTPError as e:
+        raw = e.read().decode()
+        code = e.code
+    try:
+        return code, json.loads(raw)
+    except ValueError:
+        return code, raw
+
+
+_PROM_LINE = None  # compiled lazily (re is imported at module top anyway)
+
+
+def _prom_parse(text):
+    """Prometheus text format -> [(family, {label: value}, float)].
+    The bench's own scraper: every serving verdict is re-derived from
+    these samples, never from Job internals."""
+    import re
+
+    global _PROM_LINE
+    if _PROM_LINE is None:
+        _PROM_LINE = re.compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+(\S+)$'
+        )
+    lab_re = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_LINE.match(line)
+        if not m:
+            continue
+        try:
+            v = float(m.group(4))
+        except ValueError:
+            continue
+        labels = {
+            k: bytes(s, "utf-8").decode("unicode_escape")
+            for k, s in lab_re.findall(m.group(3) or "")
+        }
+        out.append((m.group(1), labels, v))
+    return out
+
+
+def _prom_pick(samples, family, want=None, forbid=()):
+    """First sample of ``family`` whose labels include ``want`` and
+    carry none of the ``forbid`` keys (job-level vs scoped series)."""
+    want = want or {}
+    for name, labels, v in samples:
+        if name != family:
+            continue
+        if any(labels.get(k) != str(w) for k, w in want.items()):
+            continue
+        if any(k in labels for k in forbid):
+            continue
+        return v
+    return None
+
+
+def _serve_mix(n_tenants, n_ids):
+    """The multi-tenant serving mix: one query per tenant cycling
+    filter / pattern / window shapes, plus a second filter variant for
+    the storm tenant (a multiquery stack — admitted as an AOT cache
+    hit, not a fresh compile). Tenant ``t0`` is the storm tenant: its
+    filter id is the one the mid-run skew floods."""
+    mix = []
+    for t in range(n_tenants):
+        tenant = f"t{t}"
+        a, b = (t * 11 + 3) % n_ids, (t * 7 + 1) % n_ids
+        shape = ("filter", "pattern", "window")[t % 3]
+        if t == 0:
+            shape, a = "filter", _SERVE_STORM_ID
+        if shape == "filter":
+            cql = f"from S[id == {a}] select id, price insert into out"
+        elif shape == "pattern":
+            # a short ``within`` keeps the open-partial set (and so the
+            # match rate — every open s1 pairs with every s2 inside the
+            # window) bounded at serving rates; the warm phase reaches
+            # this steady state before the measured clock starts
+            cql = (
+                f"from every s1 = S[id == {a}] -> s2 = S[id == {b}] "
+                "within 1 sec select s1.timestamp as t1, "
+                "s2.timestamp as t2 insert into out"
+            )
+        else:
+            cql = (
+                "from S#window.length(256) select id, "
+                "sum(price) as total group by id insert into out"
+            )
+        mix.append((tenant, cql, shape))
+    mix.append((
+        "t0",
+        f"from S[id == {n_ids // 2}] select id, price insert into out",
+        "filter",
+    ))
+    return mix
+
+
+def _serve_pass(rate, seconds, dryrun):
+    """ONE open-loop pass of the serving observatory at the given
+    offered aggregate rate. Returns the serving measurement dict; its
+    ``sustainable.verdict`` is what the binary search bisects on.
+
+    Everything the verdict needs is read back through the PUBLIC
+    observability surface of a live supervised job — the REST routes
+    and the OpenMetrics exposition — never through Job internals:
+
+    * sustained ev/s: deltas of ``fst_processed_events_total`` across
+      scrapes of ``GET /api/v1/metrics/prometheus``;
+    * freshness: the SLO watchdog's own measured
+      ``fst_slo_measured{objective="freshness_s"}`` gauge per scrape
+      (instantaneous watermark lag, as the watchdog saw it);
+    * per-tenant p99: ``fst_tenant_drain_seconds{quantile="0.99"}``;
+    * SLO account: ``GET /api/v1/slo`` reconciled exactly against the
+      ``GET /api/v1/flightrecorder`` journal;
+    * limiting leg: the v9 attribution fold over the stage ledger in
+      ``GET /api/v1/metrics``;
+    * liveness: ``GET /health`` per scrape.
+
+    The pass runs with every production hazard ON: supervisor
+    checkpoints, DisorderSchedule arrival (skew + dups + stragglers),
+    a mid-run broker fault window, admit/disable/enable/retire churn,
+    a hostile admission refused by rule id, and a mid-run storm that
+    floods the storm tenant's filter (the isolation verdict compares
+    the OTHER tenants' p99 before/after)."""
+    import shutil
+    import tempfile
+    import threading
+
+    from flink_siddhi_tpu.analysis.admit import STRICT_BUDGETS
+    from flink_siddhi_tpu.app.service import (
+        ControlQueueSource,
+        QueryControlService,
+    )
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.connectors.kafka.protocol import API_FETCH
+    from flink_siddhi_tpu.control.plane import AdmissionGate
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.runtime.faultinject import DisorderSchedule
+    from flink_siddhi_tpu.runtime.kafka import KafkaSource
+    from flink_siddhi_tpu.runtime.sources import (
+        BoundedDisorderWatermark,
+        SocketLineSource,
+    )
+    from flink_siddhi_tpu.runtime.supervisor import Supervisor
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+    from flink_siddhi_tpu.telemetry.prober import SideChannelProber
+    from flink_siddhi_tpu.telemetry.slo import SLOPolicy
+    from tests.fake_kafka import FakeBroker
+
+    n_ids = 50
+    n_tenants = int(
+        os.environ.get("BENCH_SERVE_TENANTS", 4 if dryrun else 8)
+    )
+    batch = int(
+        os.environ.get("BENCH_SERVE_BATCH", 1_024 if dryrun else 8_192)
+    )
+    skew_ms = 250
+    lag_budget_s = float(
+        os.environ.get("BENCH_SERVE_LAG_BUDGET_S", 2.5)
+    )
+    loss_budget = float(
+        os.environ.get("BENCH_SERVE_LOSS_BUDGET", 0.005)
+    )
+    probe_tol = float(
+        os.environ.get("BENCH_SERVE_PROBE_TOL", 4.0 if dryrun else 3.0)
+    )
+    probe_slack_ms = 500.0 if dryrun else 200.0
+    gate_ratio = float(
+        os.environ.get("BENCH_SERVE_ISOLATION_RATIO", 4.0)
+    )
+    slo_p99_ms = float(
+        os.environ.get("BENCH_SERVE_SLO_P99_MS", 250.0)
+    )
+    schema = StreamSchema(
+        [
+            ("id", AttributeType.INT),
+            ("price", AttributeType.DOUBLE),
+            ("timestamp", AttributeType.LONG),
+        ]
+    )
+    mix = _serve_mix(n_tenants, n_ids)
+
+    # serving-sized accumulator budget: the default 256MB budget pads
+    # every plan's device output buffer to the 2^23-column clamp, and
+    # the fresh zeroed accumulator each drain swap materializes is a
+    # ~100MB fill PER DRAIN per plan — on a CPU backend that alone
+    # saturates the run loop. 8MB still leaves ~100x headroom over the
+    # worst per-drain emission burst, and overflow stays a counted,
+    # loud verdict input (fst_*_overflow), not silent loss. ONE config
+    # for every serve plan — differing configs would defeat AOT
+    # executable sharing (compiler/config.py).
+    from flink_siddhi_tpu.compiler.config import EngineConfig
+
+    serve_config = EngineConfig(acc_budget_bytes=8 * 1024 * 1024)
+
+    def compiler(cql, pid):
+        return compile_plan(
+            cql, {"S": schema}, plan_id=pid, config=serve_config
+        )
+
+    broker = FakeBroker("127.0.0.1")
+    broker.create_topic("serve", partitions=2)
+    ctrl = ControlQueueSource()
+    sock = SocketLineSource("S", schema, port=0, ts_field="timestamp")
+    sink = _CountingColumnarSink()
+    # the prober is constructed only once its payload timestamps can be
+    # current (event-time: a stale probe ts would be LATE-dropped at
+    # the gate); the factory's sink forwards through this holder
+    probe_holder = {"sink": None}
+
+    def probe_sink(abs_ts, row):
+        fn = probe_holder["sink"]
+        if fn is not None:
+            fn(abs_ts, row)
+
+    live = {}
+    warm_done = {"v": False}
+
+    def factory():
+        ksrc = KafkaSource(
+            "S", schema, broker.bootstrap, "serve", fmt="json",
+            ts_field="timestamp",
+            watermark=BoundedDisorderWatermark(skew_ms),
+        )
+        job = Job(
+            [], [ksrc, sock], batch_size=batch, time_mode="event",
+            control_sources=[ctrl], plan_compiler=compiler,
+            retain_results=False,
+        )
+        job.telemetry.enabled = True
+        # the trace sampler turns on only once the warm phase is done:
+        # warm-era samples (first-use tape-shape compiles) would own
+        # the cumulative trace p99 the probe verdict compares against
+        job.tracer.sample_every = (
+            16 if warm_done["v"] else (1 << 30)
+        )
+        job.admission_budgets = STRICT_BUDGETS
+        # the mostly-idle probe socket must not pin the min watermark,
+        # and a fault-starved fetch must not stall the gate for long
+        job.idle_timeout_ms = 300.0
+        job.late_policy = "drop"
+        job.drain_interval_ms = 60.0
+        # open-loop overload sheds loudly instead of growing unbounded
+        job.max_pending_events = max(64 * batch, int(2 * rate))
+        job.shed_policy = "drop_oldest"
+        for tenant in {t for t, _c, _s in mix}:
+            job.slo.set_policy(
+                SLOPolicy(
+                    tenant=tenant, p99_ms=slo_p99_ms,
+                    freshness_s=lag_budget_s, loss_ratio=loss_budget,
+                    windows_s=(2.0, 10.0),
+                )
+            )
+        job.add_sink("out", sink)
+        job.add_sink("probe_out", probe_sink)
+        live["kafka"] = ksrc
+        live["job"] = job
+        return job
+
+    ckpt = tempfile.mkdtemp(prefix="bench_serve_ckpt_")
+    sup = Supervisor(
+        factory, os.path.join(ckpt, "serve"),
+        checkpoint_every_cycles=100_000, checkpoint_interval_s=1.0,
+        mode="streaming",
+    )
+    service = QueryControlService(
+        ctrl, supervisor=sup,
+        admission=AdmissionGate(compiler, STRICT_BUDGETS),
+    ).start()
+    port = service.port
+    sup_thread = threading.Thread(target=sup.run, daemon=True)
+    sup_thread.start()
+    report = None
+    try:
+        # -- prelude: advance the event-time watermark past the control
+        # events' wall-clock timestamps, so admission applies (and the
+        # per-shape first compiles happen) OFF the measured schedule
+        rng = np.random.default_rng(11)
+        pre_n = 512
+        pre_t0 = int(time.time() * 1000)
+        pre_lines = [
+            b'{"id": %d, "price": %.2f, "timestamp": %d}'
+            % (int(i % n_ids), float(i % 97), pre_t0 + i * 2)
+            for i in range(pre_n)
+        ]
+        broker.append("serve", 0, pre_lines[: pre_n // 2])
+        broker.append("serve", 1, pre_lines[pre_n // 2:])
+
+        def horizon(ts_ms):
+            """One event past ``ts_ms + skew`` per partition: advances
+            the bounded watermark just beyond ``ts_ms`` so a phase's
+            skew-held tail releases NOW, not at the idle timeout."""
+            line = (
+                b'{"id": 0, "price": 0.0, "timestamp": %d}'
+                % (int(ts_ms) + skew_ms + 1)
+            )
+            broker.append("serve", 0, [line])
+            broker.append("serve", 1, [line])
+            return 2
+
+        offered_extra = horizon(pre_t0 + 2 * pre_n)
+
+        plan_ids = {}
+        for tenant, cql, _shape in mix:
+            code, resp = _http(
+                port, "POST", "/api/v1/queries",
+                {"cql": cql, "tenant": tenant},
+            )
+            if code != 201:
+                raise RuntimeError(f"admit failed ({code}): {resp}")
+            plan_ids.setdefault(tenant, []).append(resp["id"])
+        probe_cql = (
+            f"from S[id == {SERVE_PROBE_ID}] "
+            "select price, timestamp insert into probe_out"
+        )
+        code, resp = _http(
+            port, "POST", "/api/v1/queries",
+            {"cql": probe_cql, "tenant": "probe"},
+        )
+        if code != 201:
+            raise RuntimeError(f"probe admit failed ({code}): {resp}")
+        probe_pid = resp["id"]
+        # the hostile tenant: unbounded pattern residency, refused at
+        # the REST boundary by rule id under the strict budgets
+        code, hostile = _http(
+            port, "POST", "/api/v1/queries",
+            {
+                "cql": (
+                    "from every s1 = S[id == 0] -> s2 = S[id == 1] "
+                    "select s1.timestamp as t1 insert into out"
+                ),
+                "tenant": "hostile",
+            },
+        )
+        hostile_rules = (
+            hostile.get("rules", []) if code == 422 else
+            [f"NOT_REFUSED(code={code})"]
+        )
+
+        # the measured schedule's churn admit uses EXACTLY this text:
+        # the warm rehearsal below admits + retires it first, so the
+        # mid-measurement re-admit is an AOT-cache hit ("the same query
+        # re-admitted" — control/aotcache.py), not a fresh compile
+        # freezing the run loop inside the measured window
+        churn_cql = "from S[id == 42] select id, price insert into out"
+
+        def fault_hook(api, seq):
+            return "error" if api == API_FETCH and seq % 3 == 0 else None
+
+        want_live = {p for ids in plan_ids.values() for p in ids}
+        want_live.add(probe_pid)
+        deadline = time.perf_counter() + (90.0 if dryrun else 240.0)
+        while time.perf_counter() < deadline:
+            code, listing = _http(port, "GET", "/api/v1/queries")
+            if code == 200 and isinstance(listing, dict):
+                up = {
+                    q["id"]
+                    for q in listing.get("queries", [])
+                    if q.get("enabled")
+                }
+                if want_live <= up:
+                    break
+            time.sleep(0.25)
+        else:
+            raise RuntimeError("admitted plans never went live")
+        # the churn victim: one of the pattern tenant's plans, cycled
+        # disable->enable mid-storm (and rehearsed during warm)
+        victim_pid = plan_ids["t1"][0]
+        # compile every bucketed drain-pack width up front (the
+        # documented latency-sensitive-pipeline step): a first pack
+        # compile at a new width mid-measurement stalls the fetch
+        # thread, backpressures the run loop, and poisons every
+        # tenant's p99 — warm-up, not a verdict read
+        live["job"].prewarm_drains()
+
+        # -- warm: pace ~2.5s of traffic at the MEASURED rate so every
+        # steady-state shape the schedule will hit is compiled OFF the
+        # measured clock (same discipline as the latency phase's
+        # off-clock warm batches); then wait until it drains. The warm
+        # traffic is a MINIATURE of the measured schedule — each
+        # first-use compile it skips would otherwise freeze the run
+        # loop ~0.3-1s mid-measurement and poison every tenant's
+        # cumulative p99 (the isolation verdict cannot tell a compile
+        # stall from a noisy neighbour):
+        # * disorder-shuffled through the same DisorderSchedule shape
+        #   (the reorder ring's delta-encoded tape kinds differ from
+        #   the ordered prelude's);
+        # * a storm-skewed slice (the storm tenant's emission widths)
+        #   and a sprinkle of probe-id events (the probe plan's drain
+        #   path) — price 0.0 never decodes as a nonce;
+        # * a broker fault window (the fetch-retry path, plus the
+        #   post-recovery backlog burst that fills the largest release
+        #   bucket);
+        # * a full admit/disable/enable/retire churn rehearsal with
+        #   the schedule's exact churn CQL.
+        n_warm = max(int(rate * 2.5), 256)
+        warm_t0 = int(time.time() * 1000)
+        warm_ids = rng.integers(0, n_ids, size=n_warm)
+        wseg = warm_ids[n_warm // 2: (3 * n_warm) // 4]
+        wseg[rng.random(len(wseg)) < 0.7] = _SERVE_STORM_ID
+        warm_ids[n_warm // 2: (3 * n_warm) // 4] = wseg
+        warm_ids[:: max(n_warm // 8, 1)] = SERVE_PROBE_ID
+        warm_ts = warm_t0 + (
+            np.arange(n_warm, dtype=np.int64) * 1000
+        ) // max(int(rate), 1)
+        # same shuffle chunk as the measured schedule: the reorder
+        # ring's delta-encoded tape kind follows the disorder DEPTH
+        # (a 256-event shuffle yields int8 deltas, a 2048-event one
+        # int16 — a kind first seen mid-measurement is a fresh
+        # compile). No stragglers: the 2.5s stream is too short for
+        # the release threshold, and the late path is host-side only
+        warm_dis = DisorderSchedule(
+            seed=3, skew_ms=skew_ms, dup_rate=0.002, dup_burst=2,
+            late_count=0,
+        )
+        worder, _wd, _wl = warm_dis.arrival(warm_ts, chunk=2_048)
+        w_ids, w_ts = warm_ids[worder], warm_ts[worder]
+        n_wsent = len(worder)
+        warm_lines = [
+            b'{"id": %d, "price": %.2f, "timestamp": %d}'
+            % (int(w_ids[j]), float(j % 89), int(w_ts[j]))
+            for j in range(n_wsent)
+        ]
+        t_w = time.perf_counter()
+        j = 0
+        warm_pid = None
+        warm_ops = set()
+        while j < n_wsent:
+            due = min(
+                n_wsent, int((time.perf_counter() - t_w) * rate) + 1
+            )
+            if due <= j:
+                time.sleep(0.01)
+                continue
+            broker.append("serve", j % 2, warm_lines[j:due])
+            j = due
+            frac = j / n_wsent
+            # same window as the measured run (post-phase, 0.70-0.85):
+            # the warm pass rehearses the fault-recovery release
+            # bucket at the exact position it will occur when measured
+            if 0.70 <= frac < 0.85:
+                if broker.fault_hook is None:
+                    broker.fault_hook = fault_hook
+            elif broker.fault_hook is not None:
+                broker.fault_hook = None
+            # churn rehearsal: fired while warm traffic keeps the data
+            # watermark moving, so each control event applies promptly
+            if frac >= 0.30 and "admit" not in warm_ops:
+                warm_ops.add("admit")
+                code, resp = _http(
+                    port, "POST", "/api/v1/queries",
+                    {"cql": churn_cql, "tenant": "churn"},
+                )
+                if code == 201:
+                    warm_pid = resp["id"]
+            if frac >= 0.50 and "disable" not in warm_ops:
+                warm_ops.add("disable")
+                _http(port, "POST",
+                      f"/api/v1/queries/{victim_pid}/disable")
+            if frac >= 0.70 and "enable" not in warm_ops:
+                warm_ops.add("enable")
+                _http(port, "POST",
+                      f"/api/v1/queries/{victim_pid}/enable")
+            if frac >= 0.85 and warm_pid is not None \
+                    and "retire" not in warm_ops:
+                warm_ops.add("retire")
+                _http(port, "DELETE", f"/api/v1/queries/{warm_pid}")
+        broker.fault_hook = None
+        if warm_pid is not None and "retire" not in warm_ops:
+            _http(port, "DELETE", f"/api/v1/queries/{warm_pid}")
+        # flush the warm tail: without this the last ``skew_ms`` of
+        # warm traffic sits gated until the idle timeout and the stall
+        # bleeds into the measured window
+        offered_extra += horizon(int(warm_ts.max()))
+        warm_deadline = time.perf_counter() + 40.0
+        warm_target = pre_n + n_wsent + offered_extra - 16
+        while time.perf_counter() < warm_deadline:
+            code, text = _http(
+                port, "GET", "/api/v1/metrics/prometheus", timeout=5.0
+            )
+            if code == 200 and isinstance(text, str):
+                proc = _prom_pick(
+                    _prom_parse(text), "fst_processed_events_total",
+                    forbid=("plan", "tenant"),
+                )
+                if proc is not None and proc >= warm_target:
+                    break
+            time.sleep(0.25)
+        warm_done["v"] = True
+        live["job"].tracer.sample_every = 16
+
+        # -- the measured open-loop schedule -------------------------
+        n_bg = int(rate * seconds)
+        ids = rng.integers(0, n_ids, size=n_bg).astype(np.int64)
+        s0, s1 = n_bg // 3, 2 * n_bg // 3
+        seg = ids[s0:s1]
+        seg[rng.random(s1 - s0) < 0.7] = _SERVE_STORM_ID
+        ids[s0:s1] = seg
+        prices = np.round(rng.random(n_bg) * 90.0, 2)
+        t0_ms = int(time.time() * 1000)
+        ts = t0_ms + (
+            np.arange(n_bg, dtype=np.int64) * 1000
+        ) // max(int(rate), 1)
+        disorder = DisorderSchedule(
+            seed=7, skew_ms=skew_ms, dup_rate=0.002, dup_burst=2,
+            late_count=min(100, n_bg // 400),
+            late_release_ms=2 * skew_ms,
+        )
+        order, dup_log, late_log = disorder.arrival(ts, chunk=2_048)
+        a_ids, a_pr, a_ts = ids[order], prices[order], ts[order]
+        arrival = [
+            b'{"id": %d, "price": %.2f, "timestamp": %d}'
+            % (int(a_ids[j]), float(a_pr[j]), int(a_ts[j]))
+            for j in range(len(order))
+        ]
+        offered = pre_n + n_wsent + len(arrival) + offered_extra + 2
+
+        state = {"phase": "pre"}
+
+        def produce():
+            t_start = time.perf_counter()
+            i, n, part = 0, len(arrival), 0
+            fault_on = False
+            while i < n:
+                due = min(n, int((time.perf_counter() - t_start) * rate) + 1)
+                if due <= i:
+                    time.sleep(0.005)
+                    continue
+                broker.append("serve", part, arrival[i:due])
+                part ^= 1
+                i = due
+                frac = i / n
+                # broker faults live in the POST window, not the storm
+                # window: each hazard owns one phase (pre = clean,
+                # storm = burst isolation, post = faults + churn), so
+                # the end-of-storm isolation read isn't polluted by
+                # fault-recovery backlog — an all-tenant cost that
+                # would masquerade as cross-tenant interference
+                if 0.70 <= frac < 0.85:
+                    if not fault_on:
+                        broker.fault_hook = fault_hook
+                        fault_on = True
+                elif fault_on:
+                    broker.fault_hook = None
+                    fault_on = False
+                state["phase"] = (
+                    "storm" if 1 / 3 <= frac < 2 / 3
+                    else ("post" if frac >= 2 / 3 else "pre")
+                )
+            broker.fault_hook = None
+            horizon(int(a_ts.max()))  # flush the measured tail
+            state["phase"] = "done"
+
+        probe_period = 0.06
+        # probes stop >=1s before the producer so the schedule-end
+        # horizon cannot race a probe still in flight
+        n_probes = max(int((seconds - 1.0) / probe_period), 30)
+        # 600ms of event-time headroom absorbs the prober child's spawn
+        # latency: a probe sent late relative to its stamped ts must
+        # still be ahead of the watermark on arrival or it is shed as
+        # late and counts as lost
+        probe_base = int(time.time() * 1000) + 600
+        probe_step = max(int(probe_period * 1000), 1)
+        payloads = [
+            '{"id": %d, "price": %.1f, "timestamp": %d}\n'
+            % (SERVE_PROBE_ID, PROBE_MAGIC,
+               probe_base + i * probe_step)
+            for i in range(n_probes)
+        ]
+
+        def nonce_of(row):
+            # the nonce rides the TIMESTAMP column: prices cross the
+            # device as float32 (no x64), which quantizes PROBE_MAGIC+i
+            # to 64-ulp steps and collapses distinct nonces. Timestamps
+            # survive exactly, int32-wrapped — the mod-2^32 delta from
+            # probe_base recovers i regardless of the wrap
+            d = (int(row[1]) - probe_base) % (1 << 32)
+            if d % probe_step or d // probe_step >= n_probes:
+                return None
+            return d // probe_step
+
+        probe_timeout = 25.0 if dryrun else 45.0
+        prober = SideChannelProber(
+            sock.host, sock.port, payloads,
+            period_s=probe_period, timeout_s=probe_timeout,
+        )
+        probe_holder["sink"] = prober.make_sink(nonce_of)
+
+        producer = threading.Thread(target=produce, daemon=True)
+        producer.start()
+        prober.start()
+
+        # -- the scrape loop: every verdict input, off the wire ------
+        scrapes = []
+        scrape_failures = 0
+        pre_iso = None
+        churn = {"disabled": 0, "enabled": 0, "admitted": 0,
+                 "retired": 0}
+        churn_pid = None
+        storm_scrapes = post_scrapes = 0
+        stable = 0
+        drain_deadline = time.perf_counter() + seconds + 60.0
+
+        def scrape():
+            nonlocal scrape_failures
+            hcode, _h = _http(port, "GET", "/api/v1/health", timeout=5.0)
+            pcode, text = _http(
+                port, "GET", "/api/v1/metrics/prometheus", timeout=5.0
+            )
+            if pcode != 200 or not isinstance(text, str):
+                scrape_failures += 1
+                return None
+            samples = _prom_parse(text)
+            tenant_p99 = {}
+            fresh = None
+            for name, labels, v in samples:
+                if (
+                    name == "fst_tenant_drain_seconds"
+                    and labels.get("quantile") == "0.99"
+                ):
+                    tenant_p99[labels.get("tenant")] = v * 1e3
+                elif (
+                    name == "fst_slo_measured"
+                    and labels.get("objective") == "freshness_s"
+                ):
+                    fresh = max(fresh or 0.0, v)
+            return {
+                "t": time.perf_counter(),
+                "phase": state["phase"],
+                "health": hcode,
+                "processed": _prom_pick(
+                    samples, "fst_processed_events_total",
+                    forbid=("plan", "tenant"),
+                ),
+                "freshness_s": fresh,
+                "tenant_p99_ms": tenant_p99,
+            }
+
+        while True:
+            s = scrape()
+            if s is not None:
+                scrapes.append(s)
+                if s["phase"] == "storm":
+                    storm_scrapes += 1
+                    if pre_iso is None:
+                        # the last look BEFORE the storm began
+                        prev = scrapes[-2] if len(scrapes) > 1 else s
+                        pre_iso = dict(prev["tenant_p99_ms"])
+                    if storm_scrapes == 2:
+                        _http(port, "POST",
+                              f"/api/v1/queries/{victim_pid}/disable")
+                        churn["disabled"] += 1
+                    elif storm_scrapes == 5:
+                        _http(port, "POST",
+                              f"/api/v1/queries/{victim_pid}/enable")
+                        churn["enabled"] += 1
+                elif s["phase"] == "post":
+                    post_scrapes += 1
+                    if post_scrapes == 1:
+                        code, resp = _http(
+                            port, "POST", "/api/v1/queries",
+                            {"cql": churn_cql, "tenant": "churn"},
+                        )
+                        if code == 201:
+                            churn_pid = resp["id"]
+                            churn["admitted"] += 1
+                    elif post_scrapes == 4 and churn_pid is not None:
+                        _http(port, "DELETE",
+                              f"/api/v1/queries/{churn_pid}")
+                        churn["retired"] += 1
+                elif s["phase"] == "done":
+                    prev = scrapes[-2]["processed"] if len(scrapes) > 1 \
+                        else None
+                    if s["processed"] is not None and \
+                            s["processed"] == prev:
+                        stable += 1
+                    else:
+                        stable = 0
+                    if stable >= 3:
+                        break
+            if time.perf_counter() > drain_deadline:
+                break
+            time.sleep(0.35)
+        producer.join(timeout=10.0)
+        if os.environ.get("BENCH_SERVE_DEBUG"):
+            for s in scrapes:
+                print(
+                    f"scrape t={s['t']:.1f} phase={s['phase']} "
+                    f"health={s['health']} proc={s['processed']} "
+                    f"fresh={s['freshness_s']} "
+                    f"p99={ {k: round(v, 1) for k, v in sorted(s['tenant_p99_ms'].items())} }",
+                    file=sys.stderr,
+                )
+
+        # -- stop: close the ingest surfaces; the supervised loop ends
+        live["kafka"].close()
+        sock.close()
+        ctrl.close()
+        sup_thread.join(timeout=120.0)
+        report = prober.result(timeout=probe_timeout + 10.0)
+
+        # -- the post-run reads: same public surface, now quiescent --
+        _hc, health = _http(port, "GET", "/api/v1/health")
+        _pc, prom_text = _http(port, "GET", "/api/v1/metrics/prometheus")
+        _mc, metrics = _http(port, "GET", "/api/v1/metrics")
+        _sc, slo = _http(port, "GET", "/api/v1/slo")
+        _fv, frec_v = _http(
+            port, "GET",
+            "/api/v1/flightrecorder?kind=slo.violation&limit=2048",
+        )
+        _fr, frec_r = _http(
+            port, "GET",
+            "/api/v1/flightrecorder?kind=slo.recovered&limit=2048",
+        )
+        final = _prom_parse(prom_text if isinstance(prom_text, str)
+                            else "")
+    finally:
+        try:
+            service.stop()
+        finally:
+            broker.close()
+            shutil.rmtree(ckpt, ignore_errors=True)
+
+    # -- fold the scraped series into the serving verdicts -----------
+    steady = [
+        s for s in scrapes
+        if s["phase"] in ("pre", "storm", "post")
+        and s["processed"] is not None
+    ]
+    sustained = None
+    if len(steady) >= 2 and steady[-1]["t"] > steady[0]["t"]:
+        sustained = (
+            (steady[-1]["processed"] - steady[0]["processed"])
+            / (steady[-1]["t"] - steady[0]["t"])
+        )
+    fresh_steady = sorted(
+        s["freshness_s"] for s in steady
+        if s["freshness_s"] is not None
+    )
+    lag_p90 = (
+        fresh_steady[min(int(0.9 * len(fresh_steady)),
+                         len(fresh_steady) - 1)]
+        if fresh_steady else None
+    )
+    late_dropped = _prom_pick(
+        final, "fst_late_dropped_total", forbid=("plan", "tenant")
+    ) or 0
+    shed = _prom_pick(
+        final, "fst_faults_shed_events_total",
+        forbid=("plan", "tenant"),
+    ) or 0
+    processed_final = _prom_pick(
+        final, "fst_processed_events_total", forbid=("plan", "tenant")
+    )
+    loss_ratio = (late_dropped + shed) / max(offered, 1)
+    kafka_retries = sum(
+        v for name, labels, v in final
+        if name.startswith("fst_faults_kafka")
+    )
+
+    tenants_order = [f"t{t}" for t in range(n_tenants)]
+    post_iso = {}
+    for name, labels, v in final:
+        if (
+            name == "fst_tenant_drain_seconds"
+            and labels.get("quantile") == "0.99"
+        ):
+            post_iso[labels.get("tenant")] = v * 1e3
+    per_tenant_p99 = {
+        t: round(post_iso[t], 3) for t in tenants_order if t in post_iso
+    }
+    spread = None
+    if per_tenant_p99 and min(per_tenant_p99.values()) > 0:
+        spread = round(
+            max(per_tenant_p99.values()) / min(per_tenant_p99.values()),
+            3,
+        )
+    # the isolation verdict compares the LAST storm-phase scrape (the
+    # cumulative snapshot at end-of-storm) against the last pre-storm
+    # one: that brackets exactly the storm window. The final histogram
+    # read (post_iso above, kept for per_tenant_p99_ms) also folds in
+    # the post-phase churn admit — a separate hazard with its own
+    # churn/preclear accounting — and letting that stall masquerade as
+    # storm impact would indict the wrong mechanism.
+    storm_iso = {}
+    for s in scrapes:
+        if s["phase"] == "storm" and s["tenant_p99_ms"]:
+            storm_iso = dict(s["tenant_p99_ms"])
+    victims = {}
+    max_ratio = None
+    for t in tenants_order:
+        if t == "t0" or not pre_iso:
+            continue
+        pre_ms = pre_iso.get(t)
+        post_ms = (storm_iso or post_iso).get(t)
+        if pre_ms is None or post_ms is None or pre_ms <= 0:
+            continue
+        ratio = round(post_ms / pre_ms, 3)
+        victims[t] = {
+            "pre_ms": round(pre_ms, 3),
+            "post_ms": round(post_ms, 3),
+            "ratio": ratio,
+        }
+        max_ratio = ratio if max_ratio is None else max(max_ratio, ratio)
+    isolation = {
+        "storm_tenant": "t0",
+        "window": "storm" if storm_iso else "final",
+        "gate_ratio": gate_ratio,
+        "victims": victims,
+        "max_ratio": max_ratio,
+        "verdict": (
+            "pass" if victims and max_ratio is not None
+            and max_ratio <= gate_ratio else "fail"
+        ),
+    }
+
+    # SLO account: watchdog tallies vs the flight-recorder journal,
+    # both read over REST; counts must reconcile EXACTLY (a collapsed
+    # burst entry counts 1 + its fold — same arithmetic as
+    # FlightRecorder.counts_by_kind)
+    slo = slo if isinstance(slo, dict) else {}
+
+    def _journal_count(payload):
+        evs = (payload or {}).get("events", []) \
+            if isinstance(payload, dict) else []
+        return sum(1 + int(e.get("collapsed", 0)) for e in evs)
+
+    jv, jr = _journal_count(frec_v), _journal_count(frec_r)
+    slo_block = {
+        "policies": slo.get("policies"),
+        "violations_total": slo.get("violations_total"),
+        "recoveries_total": slo.get("recoveries_total"),
+        "journal_violations": jv,
+        "journal_recoveries": jr,
+        "reconciled": (
+            slo.get("violations_total") == jv
+            and slo.get("recoveries_total") == jr
+        ),
+        "active_violations": slo.get("active_violations"),
+        "worst_burning_tenant": slo.get("worst_burning_tenant"),
+    }
+
+    probe_p99 = report.percentile_ms(99) if report else None
+    trace_p99 = _prom_pick(
+        final, "fst_trace_e2e_seconds", want={"quantile": "0.99"},
+        forbid=("plan", "tenant"),
+    )
+    trace_p99_ms = trace_p99 * 1e3 if trace_p99 is not None else None
+    probe_ok = (
+        report is not None
+        and probe_p99 is not None
+        and trace_p99_ms is not None
+        and report.n_received >= 0.7 * report.n_sent
+        and probe_p99 <= probe_tol * trace_p99_ms + probe_slack_ms
+    )
+    lag_ok = lag_p90 is not None and lag_p90 <= lag_budget_s
+    loss_ok = loss_ratio <= loss_budget
+    health_ok = all(s["health"] == 200 for s in scrapes) and bool(scrapes)
+    restarts = (health or {}).get("restarts") \
+        if isinstance(health, dict) else None
+    sustainable = {
+        "lag_p90_s": round(lag_p90, 4) if lag_p90 is not None else None,
+        "lag_budget_s": lag_budget_s,
+        "lag_ok": lag_ok,
+        "loss_ratio": round(loss_ratio, 6),
+        "loss_budget": loss_budget,
+        "loss_ok": loss_ok,
+        "probe_p99_ms": probe_p99,
+        "telemetry_p99_ms": (
+            round(trace_p99_ms, 3) if trace_p99_ms is not None else None
+        ),
+        "probe_tolerance": probe_tol,
+        "probe_slack_ms": probe_slack_ms,
+        "probe_ok": probe_ok,
+        "health_ok": health_ok,
+        "verdict": bool(lag_ok and loss_ok and probe_ok and health_ok),
+    }
+
+    from flink_siddhi_tpu.telemetry.attribution import limiting_leg
+
+    tel = (metrics or {}).get("telemetry") or {} \
+        if isinstance(metrics, dict) else {}
+    leg = limiting_leg(
+        tel.get("stages") or {}, None, mode="streaming",
+        histograms=tel.get("histograms") or {},
+    )
+
+    shapes = {}
+    for _t, _c, shape in mix:
+        shapes[shape] = shapes.get(shape, 0) + 1
+    return {
+        "dryrun": bool(dryrun),
+        "tenants": n_tenants,
+        "queries_admitted": (
+            sum(len(ids) for ids in plan_ids.values()) + 1
+        ),
+        "mix": shapes,
+        "offered_rate_ev_s": float(rate),
+        "offered_events": int(offered),
+        "duration_s": float(seconds),
+        "batch": batch,
+        "sustained_events_per_sec": (
+            round(sustained, 1) if sustained is not None else None
+        ),
+        "processed_events": (
+            int(processed_final) if processed_final is not None else None
+        ),
+        "scrapes": {
+            "count": len(scrapes),
+            "failures": scrape_failures,
+            "cadence_s": 0.35,
+            "source": "rest",
+        },
+        "per_tenant_p99_ms": per_tenant_p99,
+        "p99_spread": spread,
+        "isolation": isolation,
+        "slo": slo_block,
+        "sustainable": sustainable,
+        "limiting_leg": leg,
+        "churn": {**churn, "hostile_refused_rules": hostile_rules},
+        "faults": {
+            "kafka_retries": int(kafka_retries),
+            "dups_injected": int(len(dup_log)),
+            "late_injected": int(len(late_log)),
+        },
+        "restarts": restarts,
+        "checkpoints": (
+            (health or {}).get("checkpoints")
+            if isinstance(health, dict) else None
+        ),
+        "probe": {
+            "report": report.to_dict() if report else None,
+        },
+    }
+
+
+def run_serve(dryrun):
+    """``--serve``: the serving observatory. Dryrun = ONE fixed-load
+    pass (the tier-1 lane); full = binary search on the open-loop
+    offered rate for the max sustainable aggregate load. Prints ONE
+    serving-only JSON line (schema v11)."""
+    base_rate = float(
+        os.environ.get("BENCH_SERVE_RATE", 1_200 if dryrun else 40_000)
+    )
+    seconds = float(
+        os.environ.get("BENCH_SERVE_SECONDS", 6.0 if dryrun else 20.0)
+    )
+    rates_tried = []
+    if dryrun:
+        block = _serve_pass(base_rate, seconds, dryrun)
+        rates_tried.append(
+            [base_rate, block["sustainable"]["verdict"]]
+        )
+        best = block
+        sustained_rate = base_rate if block["sustainable"]["verdict"] \
+            else 0.0
+        search_mode = "fixed"
+    else:
+        max_passes = int(os.environ.get("BENCH_SERVE_PASSES", 6))
+        lo, hi = 0.0, None
+        r = base_rate
+        best = None
+        block = None
+        for _ in range(max_passes):
+            block = _serve_pass(r, seconds, dryrun)
+            ok = block["sustainable"]["verdict"]
+            rates_tried.append([r, ok])
+            if ok:
+                lo, best = r, block
+            else:
+                hi = r
+            if hi is None:
+                r *= 2
+            elif lo == 0.0:
+                r = hi / 2
+            elif hi / lo <= 1.25:
+                break
+            else:
+                r = (lo + hi) / 2
+        if best is None:
+            best = block
+        sustained_rate = lo
+        search_mode = "binary"
+    best["search"] = {
+        "mode": search_mode,
+        "rates_tried": rates_tried,
+        "sustained_rate_ev_s": sustained_rate,
+    }
+    value = best.get("sustained_events_per_sec")
+    out = {
+        "metric": (
+            f"events/sec (serving mix, {best['tenants']} tenants, "
+            "open-loop)"
+        ),
+        "value": value if value is not None else 0.0,
+        "unit": "events/sec",
+        "schema_version": _schema_version(),
+        "serving": best,
+    }
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -20,7 +20,13 @@ Routes (JSON in/out):
                                            journal (telemetry/
                                            flightrec.py), filterable:
                                            ?kind=control&plan=q1&
-                                           since_seq=42&limit=100
+                                           tenant=t0&since_seq=42&
+                                           limit=100
+    GET    /api/v1/slo                   -> SLO watchdog snapshot
+                                           (telemetry/slo.py):
+                                           per-tenant compliance, burn
+                                           rates, journal-reconciled
+                                           violation account
     GET    /api/v1/health                -> supervisor liveness: alive +
                                            last-checkpoint age + restart
                                            count (Supervisor.health();
@@ -193,15 +199,11 @@ class QueryControlService:
                 if parts == ["api", "v1", "flightrecorder"]:
                     # the flight-recorder journal (telemetry/
                     # flightrec.py), filterable by kind / plan /
-                    # since-seq — the black-box poll a post-incident
-                    # investigation starts from. Lock-guarded snapshot:
-                    # safe off the run-loop thread.
-                    job = service.job
-                    if job is None and service.supervisor is not None:
-                        # supervised pipeline: the CURRENT job's
-                        # journal (Supervisor.job is a GIL-atomic
-                        # read; None mid-restart)
-                        job = service.supervisor.job
+                    # tenant / since-seq — the black-box poll a
+                    # post-incident investigation starts from.
+                    # Lock-guarded snapshot: safe off the run-loop
+                    # thread.
+                    job = service._live_job()
                     fr = getattr(job, "flightrec", None)
                     if fr is None:
                         return self._reply(
@@ -226,6 +228,7 @@ class QueryControlService:
                         events = fr.events(
                             kind=_one("kind"),
                             plan=_one("plan"),
+                            tenant=_one("tenant"),
                             since_seq=(
                                 int(since) if since is not None else None
                             ),
@@ -278,39 +281,60 @@ class QueryControlService:
                             "control": _json_safe(
                                 service.job.control_status()
                             ),
+                            # SLO watchdog compact view (telemetry/
+                            # slo.py): worst-burning tenant + active
+                            # violation count, same block the
+                            # supervised payload carries
+                            "slo": _json_safe(
+                                service.job.slo.health_summary()
+                                if getattr(
+                                    service.job, "slo", None
+                                )
+                                else None
+                            ),
                         })
                     return self._reply(
                         200, {"alive": True, "supervised": False}
                     )
+                if parts == ["api", "v1", "slo"]:
+                    # the SLO watchdog's full snapshot (telemetry/
+                    # slo.py): per-tenant compliance, burn rates, and
+                    # the journal-reconciled violation account
+                    job = service._live_job()
+                    slo = getattr(job, "slo", None)
+                    if slo is None:
+                        return self._reply(200, {})
+                    return self._reply(200, _json_safe(slo.snapshot()))
                 if parts == ["api", "v1", "metrics", "prometheus"]:
                     # OpenMetrics exposition (docs/observability.md):
                     # the scraping story without a bespoke JSON client.
                     # Same host-side snapshot as /metrics below.
                     from ..telemetry.openmetrics import CONTENT_TYPE
 
-                    if service.job is None:
+                    job = service._live_job()
+                    if job is None:
                         return self._reply_text(
                             200, "# no job attached\n", CONTENT_TYPE
                         )
                     return self._reply_text(
-                        200, service.job.openmetrics(), CONTENT_TYPE
+                        200, job.openmetrics(), CONTENT_TYPE
                     )
                 if parts == ["api", "v1", "metrics"]:
-                    if service.job is None:
+                    job = service._live_job()
+                    if job is None:
                         return self._reply(200, {})
                     # metrics(drain=False): host-side registry snapshot
                     # only — never touches the device from this thread
                     # (response schema: docs/observability.md)
                     return self._reply(
-                        200, _json_safe(service.job.metrics())
+                        200, _json_safe(job.metrics())
                     )
                 if parts == ["api", "v1", "traces"]:
                     # per-event trace sampling view (telemetry/tracing):
                     # sample rate, counters, the end-to-end histogram,
                     # and the ring of recently-completed traces
-                    if service.job is None:
-                        return self._reply(200, {})
-                    tracer = getattr(service.job, "tracer", None)
+                    job = service._live_job()
+                    tracer = getattr(job, "tracer", None)
                     if tracer is None:
                         return self._reply(200, {})
                     return self._reply(
@@ -331,10 +355,9 @@ class QueryControlService:
                 # one poll shows the whole fleet: id + tenant + enabled
                 # + fold host/slot per entry (previously bare ids, so
                 # fleet state took N+1 requests)
+                job = service._live_job()
                 listing = (
-                    service.job.query_listing()
-                    if service.job is not None
-                    else []
+                    job.query_listing() if job is not None else []
                 )
                 self._reply(200, {"queries": _json_safe(listing)})
 
@@ -416,6 +439,18 @@ class QueryControlService:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
 
+    def _live_job(self):
+        """The job every GET route reads: the explicitly-attached one,
+        else the supervised pipeline's CURRENT job (``Supervisor.job``
+        is a GIL-atomic read; None mid-restart). The fallback makes the
+        whole observability surface — metrics, prometheus, traces,
+        queries, flight recorder, SLO — scrapeable on a supervised
+        pipeline without re-wiring the service at every restart."""
+        job = self.job
+        if job is None and self.supervisor is not None:
+            job = self.supervisor.job
+        return job
+
     def _admit(self, cql: str, plan_id: str, tenant=None):
         """Run the admission gate at the REST boundary. Returns
         ``(summary, None)`` on pass (summary None when no gate is
@@ -436,8 +471,9 @@ class QueryControlService:
             rules, findings = e.rules, e.findings
         except Exception as e:  # noqa: BLE001 — unparsable CQL etc.
             rules, findings = ["CQL000"], [f"{type(e).__name__}: {e}"]
-        if self.job is not None:
-            self.job._record_rejection(
+        job = self._live_job()
+        if job is not None:
+            job._record_rejection(
                 plan_id, rules, findings, tenant, source="service"
             )
         return None, {
@@ -449,7 +485,7 @@ class QueryControlService:
 
     def _query_status(self, plan_id: str):
         """(code, payload) for GET /api/v1/queries/<id>."""
-        job = self.job
+        job = self._live_job()
         if job is None:
             return 404, {"error": "no job attached"}
         folded = job._folded.get(plan_id)

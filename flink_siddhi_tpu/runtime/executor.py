@@ -32,6 +32,7 @@ from ..telemetry import MetricsRegistry
 from ..telemetry import compile_events
 from ..telemetry.attribution import limiting_leg as _attr_limiting_leg
 from ..telemetry.flightrec import FlightRecorder
+from ..telemetry.slo import SLOWatchdog
 from ..telemetry.tracing import TraceSampler
 from .sources import Source
 from .tape import bucket_size, build_wire_tape
@@ -699,6 +700,13 @@ class Job:
         # Set sample_every=0 to disable sampling independently of the
         # rest of the registry.
         self.tracer = TraceSampler(self.telemetry)
+        # SLO watchdog (telemetry/slo.py): per-tenant objectives
+        # evaluated at micro-batch epoch boundaries from the scoped
+        # registries, violations journaled into the flight recorder.
+        # Always constructed; without policies (job.slo.set_policy)
+        # every evaluate() call returns immediately.
+        # fst:ephemeral burn/violation tallies; the durable account is the checkpointed journal
+        self.slo = SLOWatchdog(self)
         # graceful degradation: bound the host reorder/pending backlog.
         # None = unbounded (historical behavior). With a bound, an
         # overload degrades by POLICY instead of OOMing the host:
@@ -1487,6 +1495,23 @@ class Job:
                 )
                 return True
 
+            def _precleared(plan_id: str) -> bool:
+                """True when the carried service-gate verdict is a
+                PASS that includes the deep tier's footprint numbers
+                (state_bytes + acc_bytes): the gate already ran the
+                full admission pipeline on this exact CQL, so the
+                apply-time re-check can skip the redundant deep
+                eval_shape pass. Events without a carried verdict (a
+                raw control topic, a pre-gate checkpointed event) keep
+                the full defense-in-depth path."""
+                v = verdicts.get(plan_id)
+                return bool(
+                    v is not None
+                    and v.get("admitted", False)
+                    and v.get("state_bytes") is not None
+                    and v.get("acc_bytes") is not None
+                )
+
             def _note_admission(plan_id: str, plan) -> None:
                 """Tenant + admitted-footprint bookkeeping for an
                 accepted add/update: BEFORE add_plan, so the runtime's
@@ -1509,7 +1534,10 @@ class Job:
             for plan_id, cql in ev.added_plans.items():
                 if _rejected(plan_id):
                     continue
-                plan = self._compile_admitted(plan_id, cql, tenant)
+                plan = self._compile_admitted(
+                    plan_id, cql, tenant,
+                    precleared=_precleared(plan_id),
+                )
                 if plan is None:
                     continue
                 _note_admission(plan_id, plan)
@@ -1518,7 +1546,10 @@ class Job:
             for plan_id, cql in ev.updated_plans.items():
                 if _rejected(plan_id):
                     continue  # the running plan stays as-is
-                plan = self._compile_admitted(plan_id, cql, tenant)
+                plan = self._compile_admitted(
+                    plan_id, cql, tenant,
+                    precleared=_precleared(plan_id),
+                )
                 if plan is None:
                     continue  # refused update: the running plan stays
                 self.remove_plan(plan_id)
@@ -1533,7 +1564,11 @@ class Job:
             raise TypeError(f"unknown control event {type(ev)!r}")
 
     def _compile_admitted(
-        self, plan_id: str, cql: str, tenant: Optional[str] = None
+        self,
+        plan_id: str,
+        cql: str,
+        tenant: Optional[str] = None,
+        precleared: bool = False,
     ):
         """APPLY-time admission (docs/control_plane.md): compile the
         candidate, run plancheck's static tier and the admission
@@ -1541,7 +1576,18 @@ class Job:
         plan — or None after counting + recording the refusal. Defense
         in depth behind the service-boundary gate: an event injected
         past the REST layer (a raw control topic, a checkpointed
-        pre-gate event) is still judged before it touches the stack."""
+        pre-gate event) is still judged before it touches the stack.
+
+        ``precleared=True`` means the event carried a PASSING
+        service-gate verdict with the deep tier's footprint numbers:
+        the deep ``eval_shape`` + budget re-verdict is skipped on the
+        run loop (the gate already ran both on this exact CQL
+        off-loop; the carried state/acc bytes feed the footprint
+        meter instead). The static verify + cost-hook tier —
+        microseconds — still runs, so a forged verdict cannot smuggle
+        an invalid plan past apply time. Observable as the
+        ``control.preclear`` counter + journal kind.
+        """
         from ..analysis.admit import AdmissionError, analyze_plan
         from ..analysis.plancheck import PlanCheckError, verify_plan
 
@@ -1557,11 +1603,24 @@ class Job:
             if not issues:
                 # deep tier (eval_shape footprint + signature) only
                 # under a configured budget — the static cost-hook
-                # tier is microseconds and always runs
+                # tier is microseconds and always runs. budgets=None
+                # on a precleared add: analyze_plan's budget verdict
+                # IMPLIES the deep tier (a budget can't be checked
+                # against an uncomputed footprint), and the gate
+                # already rendered both on this exact CQL off-loop —
+                # its carried bytes feed the footprint meter instead.
+                budgets = self.admission_budgets
+                if precleared and budgets is not None:
+                    budgets = None
+                    self._inc_control("control.preclear")
+                    self._frec(
+                        "control.preclear", plan=plan_id,
+                        tenant=tenant,
+                    )
                 report = analyze_plan(
                     plan,
-                    budgets=self.admission_budgets,
-                    deep=self.admission_budgets is not None,
+                    budgets=budgets,
+                    deep=budgets is not None,
                 )
                 rules += [i.rule for i in report.findings]
                 rendered += [i.render() for i in report.findings]
@@ -2530,6 +2589,11 @@ class Job:
             # no-overflow horizon, without a host sync
             self.drain_outputs(wait=False)
             self._cycles_since_drain = 0
+        # SLO evaluation at the epoch boundary, AFTER this cycle's
+        # drains so the merged drain histograms the objectives read
+        # include the freshest completed work (rate-limited inside;
+        # immediate no-op without policies)
+        self.slo.evaluate()
         return total
 
     def _poll_rate_limiters(self) -> None:
@@ -3623,6 +3687,10 @@ class Job:
                 "seq": self.flightrec.seq,
                 "by_kind": self.flightrec.counts_by_kind(),
             },
+            # SLO watchdog view (telemetry/slo.py): per-tenant
+            # compliance, burn rates, and the journal-reconciled
+            # violation account (GET /api/v1/slo serves it standalone)
+            "slo": self.slo.snapshot(),
             # stage-attributed wall clock, latency histograms (drain.*
             # legs at least; jobs under bench add more), counters —
             # an atomic registry snapshot, safe off-thread

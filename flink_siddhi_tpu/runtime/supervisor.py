@@ -448,6 +448,14 @@ class Supervisor:
             # event — a scrape explains the last restart without
             # journal spelunking
             "last_restart": self._last_restart,
+            # SLO watchdog compact view (telemetry/slo.py): worst-
+            # burning tenant + active violation count — a probe alerts
+            # on a burning tenant without the full /api/v1/slo snapshot
+            "slo": (
+                job.slo.health_summary()
+                if job is not None and getattr(job, "slo", None)
+                else None
+            ),
             "crash_dump_path": self.crash_dump_path,
             "processed_events": (
                 int(job.processed_events) if job is not None else None

@@ -19,6 +19,7 @@ from .histogram import LatencyHistogram
 from .openmetrics import render_openmetrics
 from .prober import ProbeReport, SideChannelProber
 from .registry import Counter, MetricsRegistry
+from .slo import SLOPolicy, SLOWatchdog
 from .spans import NULL_SPAN, StageTimes
 from .tracing import TraceSampler
 
@@ -70,6 +71,8 @@ __all__ = [
     "MetricsRegistry",
     "NULL_SPAN",
     "ProbeReport",
+    "SLOPolicy",
+    "SLOWatchdog",
     "SideChannelProber",
     "StageTimes",
     "TOP_LEVEL_STAGES",

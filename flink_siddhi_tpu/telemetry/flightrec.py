@@ -24,8 +24,9 @@ record with
 
 Bounded and burst-safe: the journal is a fixed-capacity ring (oldest
 evicted), and high-frequency fault kinds (shed/late/stall/
-backpressure) are RATE-COLLAPSED — a repeat of the same (kind, plan)
-within ``collapse_window_s`` folds into the previous entry
+backpressure/SLO breach) are RATE-COLLAPSED — a repeat of the same
+(kind, plan, tenant) within ``collapse_window_s`` folds into the
+previous entry
 (``collapsed`` += 1, counts accumulated, ``t_last`` updated) instead
 of appending, so a sustained overload occupies O(1) journal slots per
 second while the exact totals stay in the counters.
@@ -58,8 +59,8 @@ from typing import Dict, List, Optional
 DEFAULT_CAPACITY = 2048
 
 # kinds that may legitimately fire every cycle under sustained
-# overload — these collapse by (kind, plan) inside the window; every
-# other kind is a discrete transition and always appends
+# overload — these collapse by (kind, plan, tenant) inside the window;
+# every other kind is a discrete transition and always appends
 COLLAPSIBLE_KINDS = frozenset(
     {
         "fault.shed",
@@ -78,6 +79,12 @@ COLLAPSIBLE_KINDS = frozenset(
         # abort storm cannot evict the checkpoint/restart history;
         # commits/fences are discrete transitions and always append
         "txn.abort",
+        # the SLO watchdog (telemetry/slo.py) journals one violation
+        # per evaluation while a tenant is out of compliance — a
+        # sustained breach collapses per tenant, the evaluation count
+        # rides in ``collapsed``; slo.recovered is the discrete
+        # transition and always appends
+        "slo.violation",
     }
 )
 
@@ -96,11 +103,13 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=max(int(capacity), 16))
         self._seq = 0
-        # (kind, plan) -> the latest journal entry of that key, for
-        # rate collapse. Entries evicted from the ring may linger here
-        # briefly; they fall out at the next append of their key (and
-        # an update to an evicted entry is invisible but harmless —
-        # the exact totals live in the counters, not the journal).
+        # (kind, plan, tenant) -> the latest journal entry of that
+        # key, for rate collapse — tenant in the key so one tenant's
+        # SLO burst cannot fold into another's. Entries evicted from
+        # the ring may linger here briefly; they fall out at the next
+        # append of their key (and an update to an evicted entry is
+        # invisible but harmless — the exact totals live in the
+        # counters, not the journal).
         self._last_by_key: Dict[tuple, dict] = {}
 
     @property
@@ -122,13 +131,14 @@ class FlightRecorder:
         **data,
     ) -> Optional[int]:
         """Append one event (or fold it into the previous one of the
-        same (kind, plan) when the kind is collapsible and the repeat
-        lands inside the collapse window). Returns the event's seq, or
-        None when telemetry is disabled / the event collapsed."""
+        same (kind, plan, tenant) when the kind is collapsible and the
+        repeat lands inside the collapse window). Returns the event's
+        seq, or None when telemetry is disabled / the event
+        collapsed."""
         if not self.enabled:
             return None
         now = time.monotonic()
-        key = (kind, plan)
+        key = (kind, plan, tenant)
         with self._lock:
             if kind in COLLAPSIBLE_KINDS:
                 prev = self._last_by_key.get(key)
@@ -172,9 +182,12 @@ class FlightRecorder:
         plan: Optional[str] = None,
         since_seq: Optional[int] = None,
         limit: Optional[int] = None,
+        tenant: Optional[str] = None,
     ) -> List[dict]:
         """Filtered snapshot, oldest first. ``kind`` matches exactly or
         by dotted prefix (``kind="control"`` matches ``control.admit``);
+        ``plan`` / ``tenant`` match the entry's scope labels exactly
+        (an entry without the label never matches a set filter);
         ``since_seq`` returns events with seq STRICTLY greater (the
         REST poll-cursor contract). ``limit`` keeps the newest N
         for a plain tail view — but with ``since_seq`` set it keeps
@@ -193,6 +206,8 @@ class FlightRecorder:
             ]
         if plan is not None:
             evs = [e for e in evs if e.get("plan") == plan]
+        if tenant is not None:
+            evs = [e for e in evs if e.get("tenant") == tenant]
         if limit is not None and limit >= 0:
             # explicit slice-by-length: evs[-0:] would be the WHOLE
             # list, so limit=0 must short-circuit to empty

@@ -21,7 +21,11 @@ Mapping (docs/observability.md has the field reference):
 * the **tenant rollup** block (``metrics()["tenants"]``) additionally
   emits pre-merged ``fst_tenant_*`` series so a scraper that cannot
   aggregate still sees per-tenant numbers whose histograms were merged
-  bucket-exactly (not averaged from quantiles).
+  bucket-exactly (not averaged from quantiles);
+* the **SLO watchdog** block (``metrics()["slo"]``; telemetry/slo.py)
+  emits ``fst_slo_*``: violation/recovery tallies, per-tenant
+  compliance and burn rates (labeled by window), and declared vs
+  measured objective values.
 
 Metric and label names are sanitized to the Prometheus charset; label
 values are escaped per the exposition format. Non-finite and
@@ -258,4 +262,51 @@ def render_openmetrics(metrics: Dict) -> str:
             hist = ent.get(key)
             if isinstance(hist, dict):
                 w.summary(metric_name(fam), labels, hist)
+    _emit_slo(w, metrics.get("slo"))
     return w.render()
+
+
+def _emit_slo(w: _Writer, slo) -> None:
+    """The SLO watchdog block (``metrics()["slo"]``; telemetry/slo.py)
+    as ``fst_slo_*`` series: job-level tallies plus per-tenant
+    compliance, burn rates (labeled by window), and the declared vs
+    measured objective values."""
+    if not isinstance(slo, dict):
+        return
+    w.sample(metric_name("slo_policies"), "gauge", None,
+             slo.get("policies"))
+    w.sample(metric_name("slo_active_violations"), "gauge", None,
+             slo.get("active_violations"))
+    for key in ("violations", "recoveries", "evaluations"):
+        w.sample(
+            metric_name(f"slo_{key}", "_total"), "counter", None,
+            slo.get(f"{key}_total", slo.get(key)),
+        )
+    for tenant, ent in (slo.get("tenants") or {}).items():
+        if not isinstance(ent, dict):
+            continue
+        labels = {"tenant": str(tenant)}
+        w.sample(
+            metric_name("slo_compliant"), "gauge", labels,
+            1 if ent.get("compliant") else 0,
+        )
+        for key in ("violations", "recoveries", "evaluations"):
+            w.sample(
+                metric_name(f"slo_tenant_{key}", "_total"),
+                "counter", labels, ent.get(key),
+            )
+        for window, rate in (ent.get("burn_rates") or {}).items():
+            w.sample(
+                metric_name("slo_burn_rate"), "gauge",
+                {**labels, "window": str(window)}, rate,
+            )
+        for name, val in (ent.get("objectives") or {}).items():
+            w.sample(
+                metric_name("slo_objective"), "gauge",
+                {**labels, "objective": str(name)}, val,
+            )
+        for name, val in (ent.get("measured") or {}).items():
+            w.sample(
+                metric_name("slo_measured"), "gauge",
+                {**labels, "objective": str(name)}, val,
+            )

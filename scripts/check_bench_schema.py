@@ -141,6 +141,35 @@ crash, ``exactly_once: true``, and ``aborted_rows_invisible: true``
 consumer never saw it). Pre-v10 lines are exempt from requiring the
 sub-block; a present one is validated in any version.
 
+Schema v11 (serving-observatory round, bench.py ``--serve``,
+``schema_version: 11``) adds the ``serving`` contract — the open-loop
+multi-tenant serving line, every verdict read off the public
+observability surface:
+
+* ``sustained_events_per_sec`` finite positive, and the ``search``
+  block's ``sustained_rate_ev_s`` finite positive with a non-empty
+  ``rates_tried`` ledger;
+* the ``isolation`` verdict is RE-DERIVED: every victim's ratio must
+  match a recompute from its published pre/post p99s, the declared
+  ``max_ratio`` must be the max of the victims' ratios, the verdict
+  must follow from ``max_ratio`` vs ``gate_ratio`` — and a ``fail``
+  verdict fails the line (a storm tenant that blows through victims'
+  tails is a failed claim, not a benchmark);
+* the ``slo`` account must RECONCILE EXACTLY: watchdog counter totals
+  == flight-recorder journal replay counts, and ``reconciled`` true;
+* the ``sustainable`` verdict is re-derived from its own published
+  inputs (lag vs budget, loss vs budget, prober-vs-telemetry p99
+  within tolerance, health) and must be true;
+* the ``limiting_leg`` block is held to the same re-derivation gate
+  as schema v9 (coverage >= 95%, named leg is the argmax);
+* the ``churn`` block must show live admit/retire/disable/enable all
+  >= 1 and a hostile refusal naming an ADM/PLC rule id.
+
+A ``--serve`` line carries ``serving`` INSTEAD of ``modes``: the
+replay-mode contracts (v2 stage_breakdown through v10 recovery) do not
+apply to it. Pre-v11 files need not carry the block; a present one is
+validated in any version.
+
 Usage:
     python scripts/check_bench_schema.py [FILES...]
     python scripts/check_bench_schema.py --require-stages FILES...
@@ -1057,6 +1086,265 @@ def validate_recovery(
         )
 
 
+def validate_serving(srv, errors: List[str], where: str) -> None:
+    """The schema-v11 ``serving`` block: the open-loop multi-tenant
+    serving claims, every one re-derived from the numbers published
+    next to it so a declared verdict cannot lie."""
+    where = f"{where}:serving"
+    if not isinstance(srv, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    ev_s = srv.get("sustained_events_per_sec")
+    if not _finite(ev_s) or ev_s <= 0:
+        errors.append(
+            f"{where}: sustained_events_per_sec missing/non-positive "
+            f"({ev_s!r}) — the sustained rate must be a measured number"
+        )
+    nt = srv.get("tenants")
+    if not isinstance(nt, int) or isinstance(nt, bool) or nt < 2:
+        errors.append(
+            f"{where}: tenants missing/non-int/<2 ({nt!r}) — a "
+            "single-tenant run cannot claim isolation"
+        )
+    # -- the search ledger ------------------------------------------
+    search = srv.get("search")
+    if not isinstance(search, dict):
+        errors.append(f"{where}: search block missing")
+    else:
+        sr = search.get("sustained_rate_ev_s")
+        if not _finite(sr) or sr <= 0:
+            errors.append(
+                f"{where}: search.sustained_rate_ev_s "
+                f"missing/non-positive ({sr!r})"
+            )
+        tried = search.get("rates_tried")
+        if not isinstance(tried, list) or not tried:
+            errors.append(
+                f"{where}: search.rates_tried missing/empty — the "
+                "rate ladder must be a published ledger"
+            )
+    # -- per-tenant tails -------------------------------------------
+    pt = srv.get("per_tenant_p99_ms")
+    if not isinstance(pt, dict) or not pt:
+        errors.append(f"{where}: per_tenant_p99_ms missing/empty")
+    else:
+        bad = [t for t, v in pt.items() if not _finite(v) or v <= 0]
+        if bad:
+            errors.append(
+                f"{where}: per_tenant_p99_ms non-finite/non-positive "
+                f"for {sorted(bad)}"
+            )
+    # -- the isolation verdict, re-derived --------------------------
+    iso = srv.get("isolation")
+    if not isinstance(iso, dict):
+        errors.append(f"{where}: isolation block missing")
+    else:
+        iwhere = f"{where}:isolation"
+        gate = iso.get("gate_ratio")
+        victims = iso.get("victims")
+        if not _finite(gate) or gate <= 0:
+            errors.append(
+                f"{iwhere}: gate_ratio missing/non-positive ({gate!r})"
+            )
+        if not isinstance(victims, dict) or not victims:
+            errors.append(
+                f"{iwhere}: victims missing/empty — the storm run "
+                "must publish per-victim pre/post tails"
+            )
+        else:
+            recomputed_max = None
+            for t, ent in victims.items():
+                vwhere = f"{iwhere}:victims[{t!r}]"
+                if not isinstance(ent, dict):
+                    errors.append(f"{vwhere}: not an object")
+                    continue
+                pre, post = ent.get("pre_ms"), ent.get("post_ms")
+                ratio = ent.get("ratio")
+                if (
+                    not _finite(pre) or pre <= 0
+                    or not _finite(post) or post <= 0
+                    or not _finite(ratio)
+                ):
+                    errors.append(
+                        f"{vwhere}: pre_ms/post_ms/ratio "
+                        "missing/non-positive"
+                    )
+                    continue
+                rr = post / pre
+                if abs(rr - ratio) > 0.02 * max(rr, 1.0):
+                    errors.append(
+                        f"{vwhere}: declared ratio {ratio} != "
+                        f"recomputed {rr:.3f} from post_ms/pre_ms"
+                    )
+                recomputed_max = (
+                    rr if recomputed_max is None
+                    else max(recomputed_max, rr)
+                )
+            mr = iso.get("max_ratio")
+            if recomputed_max is not None:
+                if not _finite(mr) or (
+                    abs(mr - recomputed_max)
+                    > 0.02 * max(recomputed_max, 1.0)
+                ):
+                    errors.append(
+                        f"{iwhere}: declared max_ratio {mr!r} != "
+                        f"recomputed {recomputed_max:.3f} from victims"
+                    )
+                elif _finite(gate):
+                    derived = (
+                        "pass" if recomputed_max <= gate else "fail"
+                    )
+                    if iso.get("verdict") != derived:
+                        errors.append(
+                            f"{iwhere}: verdict "
+                            f"{iso.get('verdict')!r} contradicts its "
+                            f"own numbers (max_ratio "
+                            f"{recomputed_max:.3f} vs gate {gate})"
+                        )
+        if iso.get("verdict") != "pass":
+            errors.append(
+                f"{iwhere}: verdict {iso.get('verdict')!r} — the "
+                "storm tenant blew victims' p99 beyond the gate"
+            )
+    # -- the SLO account, reconciled exactly ------------------------
+    slo = srv.get("slo")
+    if not isinstance(slo, dict):
+        errors.append(f"{where}: slo block missing")
+    else:
+        swhere = f"{where}:slo"
+        for key in (
+            "violations_total", "recoveries_total",
+            "journal_violations", "journal_recoveries",
+        ):
+            v = slo.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(
+                    f"{swhere}: {key} missing/non-int ({v!r})"
+                )
+        if slo.get("violations_total") != slo.get("journal_violations"):
+            errors.append(
+                f"{swhere}: violations_total "
+                f"{slo.get('violations_total')!r} != journal replay "
+                f"{slo.get('journal_violations')!r} — the watchdog's "
+                "account drifted from the flight-recorder journal"
+            )
+        if slo.get("recoveries_total") != slo.get("journal_recoveries"):
+            errors.append(
+                f"{swhere}: recoveries_total "
+                f"{slo.get('recoveries_total')!r} != journal replay "
+                f"{slo.get('journal_recoveries')!r}"
+            )
+        if slo.get("reconciled") is not True:
+            errors.append(f"{swhere}: reconciled must be true")
+    # -- the sustainability verdict, re-derived ---------------------
+    sus = srv.get("sustainable")
+    if not isinstance(sus, dict):
+        errors.append(f"{where}: sustainable block missing")
+    else:
+        uwhere = f"{where}:sustainable"
+        checks = {}
+        lag, lagb = sus.get("lag_p90_s"), sus.get("lag_budget_s")
+        if _finite(lag) and _finite(lagb):
+            checks["lag_ok"] = lag <= lagb
+        loss, lossb = sus.get("loss_ratio"), sus.get("loss_budget")
+        if _finite(loss) and _finite(lossb):
+            checks["loss_ok"] = loss <= lossb
+        pp, tp = sus.get("probe_p99_ms"), sus.get("telemetry_p99_ms")
+        tol, slack = (
+            sus.get("probe_tolerance"), sus.get("probe_slack_ms"),
+        )
+        if all(_finite(v) for v in (pp, tp, tol, slack)):
+            checks["probe_ok"] = pp <= tol * tp + slack
+            INFO.append(
+                f"{uwhere}: prober p99 {pp}ms vs telemetry p99 "
+                f"{tp}ms under serving load"
+            )
+        missing = [
+            k for k in ("lag_ok", "loss_ok", "probe_ok")
+            if k not in checks
+        ]
+        if missing:
+            errors.append(
+                f"{uwhere}: cannot re-derive {missing} — the inputs "
+                "(measured value + budget) must be published"
+            )
+        for key, want in checks.items():
+            if sus.get(key) is not want:
+                errors.append(
+                    f"{uwhere}: declared {key}={sus.get(key)!r} "
+                    f"contradicts its own inputs (re-derived {want})"
+                )
+        if not isinstance(sus.get("health_ok"), bool):
+            errors.append(f"{uwhere}: health_ok missing/non-bool")
+        derived = (
+            all(checks.values())
+            and not missing
+            and sus.get("health_ok") is True
+        )
+        if sus.get("verdict") is not True:
+            errors.append(
+                f"{uwhere}: verdict must be true — the published "
+                "sustained rate was not actually sustained"
+            )
+        elif not derived:
+            errors.append(
+                f"{uwhere}: verdict true contradicts its own inputs"
+            )
+    # -- the limiting leg, same re-derivation gate as v9 ------------
+    ll = srv.get("limiting_leg")
+    if ll is None:
+        errors.append(
+            f"{where}: limiting_leg block missing (the serving line "
+            "must name its measured bottleneck)"
+        )
+    else:
+        validate_limiting_leg(ll, errors, where)
+    # -- live churn under load --------------------------------------
+    churn = srv.get("churn")
+    if not isinstance(churn, dict):
+        errors.append(f"{where}: churn block missing")
+    else:
+        cwhere = f"{where}:churn"
+        for key in ("admitted", "retired", "disabled", "enabled"):
+            v = churn.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                errors.append(
+                    f"{cwhere}: {key}={v!r} — the churn really must "
+                    "have happened mid-measurement"
+                )
+        rules = churn.get("hostile_refused_rules")
+        if not (
+            isinstance(rules, list)
+            and rules
+            and all(
+                isinstance(r, str)
+                and (r.startswith("ADM") or r.startswith("PLC"))
+                for r in rules
+            )
+        ):
+            errors.append(
+                f"{cwhere}: hostile_refused_rules={rules!r} — the "
+                "hostile admit must be refused with exact rule ids"
+            )
+    # -- the scrape ledger ------------------------------------------
+    sc = srv.get("scrapes")
+    if not isinstance(sc, dict):
+        errors.append(f"{where}: scrapes block missing")
+    else:
+        n = sc.get("count")
+        if not isinstance(n, int) or isinstance(n, bool) or n < 3:
+            errors.append(
+                f"{where}: scrapes.count={n!r} — the verdicts need a "
+                "scraped series, not a single look"
+            )
+        if sc.get("source") != "rest":
+            errors.append(
+                f"{where}: scrapes.source={sc.get('source')!r} — "
+                "serving verdicts must be read off the public REST "
+                "surface"
+            )
+
+
 def validate_doc(
     doc, errors: List[str], where: str, require_stages: bool = False
 ) -> None:
@@ -1082,6 +1370,20 @@ def validate_doc(
         if key in doc and not isinstance(doc[key], _NUM):
             errors.append(f"{where}: {key} non-numeric")
     version = doc.get("schema_version", 1)
+    if "serving" in doc:
+        validate_serving(doc["serving"], errors, where)
+        if not isinstance(doc.get("modes"), dict):
+            # a --serve line carries serving INSTEAD of modes: its
+            # limiting_leg/latency claims live inside the serving
+            # block, so the replay-mode contracts (v2 stage_breakdown
+            # through v10 recovery-requirement) do not apply — but an
+            # optional recovery block present on it is still held to
+            # its contract
+            if "recovery" in doc:
+                validate_recovery(
+                    doc["recovery"], errors, where, version
+                )
+            return
     if "stage_breakdown" in doc:
         validate_stage_breakdown(doc["stage_breakdown"], errors, where)
     elif version >= 2 or require_stages:

@@ -14,6 +14,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -1164,6 +1166,276 @@ def test_txn_subblock_must_be_a_real_measurement():
     )
 
 
+# -- schema v11: the serving observatory ------------------------------------
+
+
+def _serving_blk(**over):
+    """A self-consistent serving block modeled on a real
+    ``bench.py --serve --dryrun`` line (victim ratios, max_ratio and
+    every verdict re-derivable from the numbers published next to
+    them)."""
+    blk = {
+        "tenants": 4,
+        "offered_events_per_sec": 1200.0,
+        "sustained_events_per_sec": 1176.6,
+        "seconds": 6.0,
+        "search": {
+            "mode": "fixed",
+            "rates_tried": [[1200.0, True]],
+            "sustained_rate_ev_s": 1200.0,
+        },
+        "per_tenant_p99_ms": {
+            "t0": 61.2, "t1": 58.6, "t2": 69.8, "t3": 55.2,
+        },
+        "isolation": {
+            "storm_tenant": "t0",
+            "window": "storm",
+            "gate_ratio": 4.0,
+            "victims": {
+                "t1": {"pre_ms": 49.3, "post_ms": 58.6,
+                       "ratio": 1.189},
+                "t2": {"pre_ms": 50.0, "post_ms": 69.8,
+                       "ratio": 1.396},
+                "t3": {"pre_ms": 48.0, "post_ms": 55.2,
+                       "ratio": 1.15},
+            },
+            "max_ratio": 1.396,
+            "verdict": "pass",
+        },
+        "slo": {
+            "policies": 4,
+            "violations_total": 45,
+            "recoveries_total": 4,
+            "journal_violations": 45,
+            "journal_recoveries": 4,
+            "reconciled": True,
+            "active_violations": 4,
+            "worst_burning_tenant": "t0",
+        },
+        "sustainable": {
+            "lag_p90_s": 0.674,
+            "lag_budget_s": 2.5,
+            "lag_ok": True,
+            "loss_ratio": 0.0017,
+            "loss_budget": 0.005,
+            "loss_ok": True,
+            "probe_p99_ms": 1519.3,
+            "telemetry_p99_ms": 946.2,
+            "probe_tolerance": 4.0,
+            "probe_slack_ms": 500.0,
+            "probe_ok": True,
+            "health_ok": True,
+            "verdict": True,
+        },
+        "limiting_leg": _limiting_leg_blk(mode="serve"),
+        "churn": {
+            "admitted": 1, "retired": 1, "disabled": 1, "enabled": 1,
+            "hostile_refused_rules": ["ADM110"],
+        },
+        "scrapes": {
+            "count": 21, "failures": 0, "cadence_s": 0.35,
+            "source": "rest",
+        },
+    }
+    blk.update(over)
+    return blk
+
+
+def _v11_doc(**over):
+    doc = {
+        "metric": "events/sec (serving mix, 4 tenants, open-loop)",
+        "value": 1176.6,
+        "unit": "events/sec",
+        "schema_version": 11,
+        "serving": _serving_blk(),
+    }
+    doc.update(over)
+    return doc
+
+
+def test_valid_v11_serving_only_doc_passes():
+    """A --serve line carries ``serving`` INSTEAD of ``modes``: the
+    replay-mode contracts (stage_breakdown through the v10 recovery
+    requirement) must NOT fire against it — errors == [] proves the
+    early-return, not just the serving gate."""
+    errors = []
+    CHECK.validate_doc(_v11_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v11_isolation_ratios_rederived():
+    # a declared victim ratio that disagrees with its own pre/post
+    doc = _v11_doc()
+    doc["serving"]["isolation"]["victims"]["t2"]["ratio"] = 1.05
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("!= recomputed" in e and "t2" in e for e in errors)
+    # a declared max_ratio that is not the max of its victims
+    doc = _v11_doc()
+    doc["serving"]["isolation"]["max_ratio"] = 1.15
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("max_ratio" in e and "recomputed" in e for e in errors)
+
+
+def test_v11_isolation_verdict_cannot_lie_and_fail_fails():
+    # verdict "pass" contradicting a gate the numbers blow through
+    doc = _v11_doc()
+    doc["serving"]["isolation"]["gate_ratio"] = 1.2
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("contradicts its own numbers" in e for e in errors)
+    # an HONEST fail verdict still fails the line — the serving claim
+    # requires isolation to hold, not merely to be reported
+    doc = _v11_doc()
+    doc["serving"]["isolation"]["gate_ratio"] = 1.2
+    doc["serving"]["isolation"]["verdict"] = "fail"
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "verdict 'fail'" in e and "blew victims" in e for e in errors
+    )
+
+
+def test_v11_slo_account_must_reconcile_with_journal():
+    # watchdog counters drifting from the flight-recorder replay
+    doc = _v11_doc()
+    doc["serving"]["slo"]["journal_violations"] = 44
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "journal replay" in e and "drifted" in e for e in errors
+    )
+    doc = _v11_doc()
+    doc["serving"]["slo"]["reconciled"] = False
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("reconciled must be true" in e for e in errors)
+
+
+def test_v11_sustainable_verdict_rederived_from_inputs():
+    # a declared lag_ok=True contradicting the published lag vs budget
+    doc = _v11_doc()
+    doc["serving"]["sustainable"]["lag_p90_s"] = 3.1
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "lag_ok" in e and "contradicts its own inputs" in e
+        for e in errors
+    )
+    # verdict false = not sustained = the line's headline is a lie
+    doc = _v11_doc()
+    doc["serving"]["sustainable"]["verdict"] = False
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("verdict must be true" in e for e in errors)
+    # missing inputs: the check cannot be re-derived, so it fails
+    doc = _v11_doc()
+    del doc["serving"]["sustainable"]["probe_p99_ms"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("cannot re-derive" in e and "probe_ok" in e
+               for e in errors)
+
+
+def test_v11_churn_really_happened_with_rule_ids():
+    doc = _v11_doc()
+    doc["serving"]["churn"]["admitted"] = 0
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "admitted=0" in e and "really must have happened" in e
+        for e in errors
+    )
+    doc = _v11_doc()
+    doc["serving"]["churn"]["hostile_refused_rules"] = []
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("hostile_refused_rules" in e for e in errors)
+    doc = _v11_doc()
+    doc["serving"]["churn"]["hostile_refused_rules"] = ["nope"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("exact rule ids" in e for e in errors)
+
+
+def test_v11_requires_limiting_leg_and_rest_scrapes():
+    doc = _v11_doc()
+    del doc["serving"]["limiting_leg"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "limiting_leg block missing" in e and "bottleneck" in e
+        for e in errors
+    )
+    doc = _v11_doc()
+    doc["serving"]["scrapes"]["source"] = "in-process"
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("public REST surface" in e for e in errors)
+    doc = _v11_doc()
+    doc["serving"]["scrapes"]["count"] = 2
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("scraped series" in e for e in errors)
+
+
+def test_v11_search_ledger_required():
+    doc = _v11_doc()
+    doc["serving"]["search"]["rates_tried"] = []
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("rates_tried" in e and "ledger" in e for e in errors)
+    doc = _v11_doc()
+    doc["serving"]["search"]["sustained_rate_ev_s"] = 0.0
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("sustained_rate_ev_s" in e for e in errors)
+
+
+def test_v10_era_docs_unaffected_by_v11_gate():
+    """Replay-mode lines need no serving block, but one attached to a
+    modes-carrying line is held to its contract AND the replay
+    contracts still apply (no early-return when modes is present) —
+    same exemption shape as disorder/control/attribution."""
+    errors = []
+    CHECK.validate_doc(_v10_doc(), errors, "doc")
+    assert errors == []
+    doc = _v10_doc()
+    doc["serving"] = _serving_blk()
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []
+    doc = _v10_doc()
+    doc["serving"] = _serving_blk()
+    doc["serving"]["slo"]["reconciled"] = False
+    del doc["modes"]["streaming"]["limiting_leg"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("reconciled must be true" in e for e in errors)
+    assert any(
+        "modes.streaming: limiting_leg block missing" in e
+        for e in errors
+    )
+
+
+def test_v11_serving_line_recovery_block_still_gated():
+    """The early-return exempts a --serve line from the replay
+    contracts, NOT from the recovery contract: an attached recovery
+    block is still validated (at v11 that includes the transactional
+    sub-block requirement)."""
+    doc = _v11_doc(
+        recovery=_recovery_block(transactional=_txn_block())
+    )
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []
+    doc = _v11_doc(recovery=_recovery_block(transactional=None))
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("transactional" in e for e in errors)
+
+
 def test_fault_block_live_and_gate_accepts():
     """The live --fault contract: bench._fault_recovery_block runs the
     supervised crash schedule (two pull-kills + one
@@ -1221,18 +1493,18 @@ def test_fault_block_live_and_gate_accepts():
     assert errors == []
 
 
-def test_dryrun_emits_schema_complete_v10(tmp_path):
+def test_dryrun_emits_schema_complete_v11(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
     replay, short paced phase) exercises resident + streaming + sink,
     the out-of-process prober, the small-skew disorder sweep, the
     control-plane sustained-load run (with the v8 per-plan
     attribution block), AND the v9 measured limiting-leg verdict per
-    mode, and its JSON line passes the v10 schema gate — in the
-    tier-1 lane, under its timeout. (The --fault recovery block —
-    which v10 gates the transactional sub-block inside of — has its
-    own live subprocess test above, so this one stays at its
-    historical cost; the v10 gate on THIS line only requires that a
-    recovery block, when present, carries the sub-block.)"""
+    mode, and its JSON line passes the schema gate — in the tier-1
+    lane, under its timeout. (The --fault recovery block — which v10
+    gates the transactional sub-block inside of — has its own live
+    subprocess test above, and the v11 serving line has its own
+    --serve --dryrun test below; this replay line stays at its
+    historical cost and simply stamps the current schema version.)"""
     env = dict(os.environ)
     env.update(
         JAX_PLATFORMS="cpu",
@@ -1281,7 +1553,7 @@ def test_dryrun_emits_schema_complete_v10(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 10
+    assert doc["schema_version"] == 11
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]
@@ -1373,6 +1645,126 @@ def test_dryrun_emits_schema_complete_v10(tmp_path):
         math.isfinite(ent.get("utilization", float("nan")))
         for ent in att["footprint"].values()
     )
+
+
+def test_serve_dryrun_emits_valid_v11_serving_line(tmp_path):
+    """The live --serve contract: ``bench.py --serve --dryrun`` runs
+    ONE fixed-load open-loop pass of the full serving observatory —
+    mixed-tenant stack over shared ingest, disorder, mid-run broker
+    faults, admit/retire churn, the noisy-neighbor storm, the
+    out-of-process prober, the SLO watchdog — with every verdict read
+    off the public REST surface, and its serving-only JSON line
+    passes the v11 schema gate in the tier-1 lane."""
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu")
+    out = tmp_path / "BENCH_serve_dryrun.json"
+    for attempt in (1, 2):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--serve", "--dryrun"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=560,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out.write_text(proc.stdout)
+        errors = CHECK.validate_file(str(out))
+        # ONE retry, only when every failure is a serving-block
+        # verdict: the isolation ratios and sustainability gates are
+        # hardware measurements of tail latency on a shared 2-core
+        # host — a second independent window distinguishes "the
+        # observatory regressed" (fails twice) from "the box was
+        # busy" (passes clean)
+        if attempt == 1 and errors and all(
+            ":serving" in e for e in errors
+        ):
+            continue
+        break
+    assert errors == []
+    doc = [
+        json.loads(l)
+        for l in proc.stdout.splitlines()
+        if l.strip().startswith("{")
+    ][-1]
+    assert doc["schema_version"] == 11
+    srv = doc["serving"]
+    # the headline number is the measured aggregate, sustained
+    assert doc["value"] == srv["sustained_events_per_sec"] > 0
+    assert srv["tenants"] >= 2
+    assert srv["search"]["mode"] == "fixed"
+    assert srv["search"]["rates_tried"] == [
+        [srv["search"]["sustained_rate_ev_s"], True]
+    ]
+    # every tenant published a finite positive tail
+    assert len(srv["per_tenant_p99_ms"]) == srv["tenants"]
+    assert all(
+        math.isfinite(v) and v > 0
+        for v in srv["per_tenant_p99_ms"].values()
+    )
+    # the verdicts the gate re-derived really came out green
+    assert srv["isolation"]["verdict"] == "pass"
+    assert srv["sustainable"]["verdict"] is True
+    assert srv["slo"]["reconciled"] is True
+    assert srv["slo"]["policies"] >= srv["tenants"]
+    # churn really happened mid-measurement, hostile refused by rule
+    churn = srv["churn"]
+    assert all(
+        churn[k] >= 1
+        for k in ("admitted", "retired", "disabled", "enabled")
+    )
+    assert churn["hostile_refused_rules"]
+    # the prober ran out of process under serving load
+    sus = srv["sustainable"]
+    assert math.isfinite(sus["probe_p99_ms"])
+    assert math.isfinite(sus["telemetry_p99_ms"])
+    # the verdicts were read off the REST plane, as a series
+    assert srv["scrapes"]["source"] == "rest"
+    assert srv["scrapes"]["count"] >= 3
+    assert srv["scrapes"]["failures"] == 0
+    # the serving line names its measured bottleneck
+    assert srv["limiting_leg"]["limiting_leg"] in srv[
+        "limiting_leg"
+    ]["legs"]
+
+
+@pytest.mark.slow
+def test_serve_full_binary_search_publishes_rate_ladder(tmp_path):
+    """The full (non-dryrun) --serve mode: binary search on the
+    open-loop offered rate. Scaled down via the BENCH_SERVE_* knobs
+    so it terminates in minutes, but the search itself is real: the
+    published ledger must show more than one rate tried, the mode
+    must be "binary", and the sustained rate must be the highest
+    rate whose pass verdict was true."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        # dryrun-scale passes: the disorder schedule needs rate *
+        # seconds events to span several 2048-event chunks so its
+        # stragglers have room to release before the stream ends
+        BENCH_SERVE_RATE="1200",
+        BENCH_SERVE_SECONDS="6.0",
+        BENCH_SERVE_PASSES="3",
+        BENCH_SERVE_TENANTS="4",
+    )
+    out = tmp_path / "BENCH_serve.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve"],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out.write_text(proc.stdout)
+    assert CHECK.validate_file(str(out)) == []
+    doc = [
+        json.loads(l)
+        for l in proc.stdout.splitlines()
+        if l.strip().startswith("{")
+    ][-1]
+    search = doc["serving"]["search"]
+    assert search["mode"] == "binary"
+    assert len(search["rates_tried"]) > 1
+    passed = [r for r, ok in search["rates_tried"] if ok]
+    assert passed, search["rates_tried"]
+    assert search["sustained_rate_ev_s"] == max(passed)
 
 
 def test_repo_bench_files_validate():
