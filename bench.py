@@ -5,7 +5,7 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line (``schema_version: 11``). One invocation measures
+Prints ONE JSON line (``schema_version: 12``). One invocation measures
 THREE execution modes and emits all of them in the same document, so a
 regression in any path stays a tracked number:
 
@@ -166,6 +166,22 @@ back off the PUBLIC observability surface (``/api/v1/metrics
 scripts/check_bench_schema.py. BENCH_SERVE_RATE / BENCH_SERVE_SECONDS
 / BENCH_SERVE_TENANTS size it; docs/observability.md documents the
 fields.
+
+Schema v12 (serving-fleet round) adds ``--fleet``: a SEPARATE
+fleet-only JSON line measuring cold-start-to-first-row for a replica
+process booting WITH vs WITHOUT the persistent warm-start compile
+store (fleet/warmstore.py). One replica subprocess boots cold behind
+the key-hash router, admits BENCH_FLEET_TENANTS constants-only tenant
+variants through the fan-out control plane, serves rows, then is
+rolling-restarted: the successor restores the supervisor checkpoint
+and warms every executable from the store. The ``fleet`` block records
+both boots' first-row clocks, the successor's ZERO new-lowering count,
+the warm-store hit/miss/persist counters, and the commit-log
+exactly-once account across the handoff (duplicate epochs, rows lost
+vs the lineage counter — both must be 0);
+scripts/check_bench_schema.py rejects a warm boot that does not beat
+the cold one. BENCH_FLEET_TENANTS / BENCH_FLEET_EVENTS size it;
+docs/fleet.md documents the protocol.
 
 Honest wall-clock accounting: every mode section carries a
 ``stage_breakdown`` computed from the telemetry subsystem
@@ -1676,6 +1692,11 @@ def main():
         # the serving observatory is its own document kind: a
         # serving-only v11 line, separate from the mode sections
         run_serve(dryrun)
+        return
+    if "--fleet" in sys.argv:
+        # the serving-fleet cold-vs-warm bootstrap account is its own
+        # document kind too: a fleet-only v12 line
+        run_fleet(dryrun)
         return
     want_modes = [
         m
@@ -3363,6 +3384,226 @@ def run_serve(dryrun):
         "schema_version": _schema_version(),
         "serving": best,
     }
+    print(json.dumps(out))
+
+
+# -- schema v12: the serving fleet (--fleet) ---------------------------------
+
+
+def _fleet_chain_cql(a, b):
+    return (
+        f"from every s1 = S[id == {a}] -> s2 = S[id == {b}] "
+        "within 60 sec "
+        "select s1.timestamp as t1, s2.timestamp as t2 "
+        "insert into out"
+    )
+
+
+def _fleet_spawn(spec):
+    """One replica subprocess; returns (proc, ready dict) once the
+    process prints its ready line (ports are OS-assigned)."""
+    import subprocess
+    import tempfile
+
+    fd, path = tempfile.mkstemp(
+        prefix=f"fleet_spec_{spec['replica_id']}_", suffix=".json"
+    )
+    with os.fdopen(fd, "w") as f:
+        json.dump(spec, f)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "flink_siddhi_tpu.fleet.replica", path],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        env=env, cwd=REPO, text=True,
+    )
+    line = proc.stdout.readline()
+    try:
+        ready = json.loads(line)
+    except ValueError:
+        proc.kill()
+        raise RuntimeError(
+            f"replica did not come up: {line!r} "
+            f"/ {proc.stderr.read()[-2000:]}"
+        )
+    return proc, ready
+
+
+def _fleet_wait_first_row(port, timeout_s):
+    """Poll the replica's PUBLIC /health until its fleet boot block
+    reports a first emitted row; returns the boot dict."""
+    deadline = time.monotonic() + timeout_s
+    boot = {}
+    while time.monotonic() < deadline:
+        status, health = _http(port, "GET", "/api/v1/health", timeout=10.0)
+        if status == 200 and isinstance(health, dict):
+            boot = (health.get("fleet") or {}).get("boot") or {}
+            if "first_row_s" in boot:
+                return boot
+        time.sleep(0.1)
+    return boot
+
+
+def _fleet_feed(router, n, start):
+    import socket as _socket
+
+    conn = _socket.create_connection(
+        ("127.0.0.1", router.ingest_port), timeout=10
+    )
+    try:
+        payload = b"".join(
+            json.dumps({
+                "id": (start + i) % 4,
+                "price": float(start + i),
+                "timestamp": 1_000_000 + start + i,
+            }).encode() + b"\n"
+            for i in range(n)
+        )
+        conn.sendall(payload)
+    finally:
+        conn.close()
+
+
+def _fleet_boot_account(exit_doc, boot):
+    """One boot's fleet-block entry, from the replica's exit account
+    (stdout JSON) + the /health-polled boot clock."""
+    store = (exit_doc.get("fleet") or {}).get("warm_store") or {}
+    return {
+        "first_row_s": boot.get("first_row_s"),
+        "ready_s": boot.get("ready_s"),
+        "compiles": exit_doc.get("compiles"),
+        "warm_hits": store.get("hits"),
+        "warm_misses": store.get("misses"),
+        "persists": store.get("persists"),
+        "store_errors": store.get("errors"),
+    }
+
+
+def run_fleet(dryrun):
+    """``--fleet``: cold-vs-warm replica bootstrap through a rolling
+    restart (module docstring, schema v12). Prints ONE fleet-only JSON
+    line."""
+    import shutil
+    import tempfile
+
+    from flink_siddhi_tpu.fleet.commitlog import read_committed
+    from flink_siddhi_tpu.fleet.router import FleetRouter
+
+    tenants = int(
+        os.environ.get("BENCH_FLEET_TENANTS", 8 if dryrun else 20)
+    )
+    n_events = int(
+        os.environ.get("BENCH_FLEET_EVENTS", 200 if dryrun else 2_000)
+    )
+    timeout_s = float(os.environ.get("BENCH_FLEET_TIMEOUT", 180.0))
+    t_wall = time.monotonic()
+    root = tempfile.mkdtemp(prefix="bench_fleet_")
+    commit_log = os.path.join(root, "slot0", "commit.log")
+
+    def spec_for(rid):
+        return {
+            "replica_id": rid,
+            "schema": [
+                ["id", "int"], ["price", "double"],
+                ["timestamp", "long"],
+            ],
+            "checkpoint_path": os.path.join(root, "slot0", "ckpt"),
+            "commit_log": commit_log,
+            "store_dir": os.path.join(root, "store"),
+            # wall-clock checkpoint cadence: the idle run loop spins
+            # fast, a cycle-count cadence would checkpoint thousands
+            # of empty epochs
+            "checkpoint_every_cycles": 1_000_000,
+            "checkpoint_interval_s": 0.5,
+            "batch_size": 256,
+        }
+
+    router = None
+    procs = []
+    try:
+        # -- cold boot: empty store, empty checkpoint ------------------
+        proc_cold, ready_cold = _fleet_spawn(spec_for("fleet-cold"))
+        procs.append(proc_cold)
+        router = FleetRouter([ready_cold], key_field="id")
+        for t in range(tenants):
+            router.admit(
+                _fleet_chain_cql(t % 4, (t + 1) % 4),
+                plan_id=f"fleet-q{t}", tenant=f"tenant-{t}",
+            )
+        _fleet_feed(router, n_events, start=0)
+        boot_cold = _fleet_wait_first_row(
+            ready_cold["api_port"], timeout_s
+        )
+        # -- rolling restart into the warm successor -------------------
+        router.pause(0)
+        router.drain(0)
+        proc_cold.wait(timeout=timeout_s)
+        exit_cold = json.loads(proc_cold.stdout.readline() or "{}")
+        proc_warm, ready_warm = _fleet_spawn(spec_for("fleet-warm"))
+        procs.append(proc_warm)
+        router.set_replica(0, ready_warm)
+        _fleet_feed(router, n_events, start=n_events)
+        boot_warm = _fleet_wait_first_row(
+            ready_warm["api_port"], timeout_s
+        )
+        router.pause(0)
+        router.drain(0)
+        proc_warm.wait(timeout=timeout_s)
+        exit_warm = json.loads(proc_warm.stdout.readline() or "{}")
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # exactly-once account across the handoff: the successor's
+    # committed_rows counter rides the checkpoint, so the LAST exit's
+    # counter is the whole lineage's — it must equal the log exactly
+    rows = read_committed(commit_log, "out")
+    raw_epochs = []
+    with open(commit_log, "r", encoding="utf-8") as f:
+        for line in f:
+            if line.strip():
+                raw_epochs.append(json.loads(line)["epoch"])
+    lineage_rows = sum(
+        s.get("committed_rows", 0) for s in exit_warm.get("commit", [])
+    )
+    committed = {
+        "rows": len(rows),
+        "epochs": len(set(raw_epochs)),
+        "duplicate_epochs": len(raw_epochs) - len(set(raw_epochs)),
+        "lost": lineage_rows - len(rows),
+    }
+    cold = _fleet_boot_account(exit_cold, boot_cold)
+    warm = _fleet_boot_account(exit_warm, boot_warm)
+    handoff = (exit_warm.get("fleet") or {}).get("last_handoff")
+    speedup = None
+    if cold.get("first_row_s") and warm.get("first_row_s"):
+        speedup = cold["first_row_s"] / warm["first_row_s"]
+    fleet = {
+        "tenants": tenants,
+        "events_per_boot": n_events,
+        "store_namespace": (
+            (exit_warm.get("fleet") or {}).get("warm_store") or {}
+        ).get("namespace"),
+        "cold": cold,
+        "warm": warm,
+        "cold_to_warm_speedup": speedup,
+        "handoff": handoff,
+        "committed": committed,
+        "wall_seconds": round(time.monotonic() - t_wall, 3),
+    }
+    out = {
+        "metric": (
+            f"cold-start to first row (warm store, {tenants} tenants)"
+        ),
+        "value": warm.get("first_row_s") or 0.0,
+        "unit": "seconds",
+        "schema_version": _schema_version(),
+        "fleet": fleet,
+    }
+    shutil.rmtree(root, ignore_errors=True)
     print(json.dumps(out))
 
 

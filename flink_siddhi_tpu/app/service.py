@@ -60,6 +60,7 @@ re-checks it at apply time (defense in depth)."""
 from __future__ import annotations
 
 import json
+import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import List, Optional, Tuple
@@ -144,12 +145,14 @@ class QueryControlService:
         validate=None,  # callable(cql) raising on bad queries
         supervisor=None,  # runtime.supervisor.Supervisor for /health
         admission=None,  # AdmissionGate: (cql, plan_id) -> summary
+        fleet_ops=None,  # {"drain": fn} hooks a replica process wires
     ) -> None:
         self.control = control
         self.job = job
         self.validate = validate
         self.supervisor = supervisor
         self.admission = admission
+        self.fleet_ops = fleet_ops
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -292,6 +295,19 @@ class QueryControlService:
                                 )
                                 else None
                             ),
+                            # serving-fleet block (fleet/,
+                            # docs/fleet.md): replica id/role, warm-
+                            # store counters, last handoff — None
+                            # outside a fleet (the supervised payload
+                            # carries the same block via
+                            # Supervisor.health())
+                            "fleet": _json_safe(
+                                service.job.fleet_status()
+                                if hasattr(
+                                    service.job, "fleet_status"
+                                )
+                                else None
+                            ),
                         })
                     return self._reply(
                         200, {"alive": True, "supervised": False}
@@ -363,6 +379,20 @@ class QueryControlService:
 
             # fst:thread-root name=service
             def do_POST(self):
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["api", "v1", "fleet", "drain"]:
+                    # rolling-restart handoff (docs/fleet.md): ask the
+                    # replica to finish at the next checkpoint
+                    # boundary — final checkpoint + warm-store persist
+                    # + commit-log epoch land before the process exits
+                    fn = (service.fleet_ops or {}).get("drain")
+                    if fn is None:
+                        return self._reply(
+                            404, {"error": "not a fleet replica"}
+                        )
+                    return self._reply(
+                        202, _json_safe(fn() or {"draining": True})
+                    )
                 tail = self._route()
                 if tail is None:
                     return self._reply(404, {"error": "not found"})
@@ -374,7 +404,20 @@ class QueryControlService:
                     err = service._check(cql)
                     if err:
                         return self._reply(400, {"error": err})
-                    plan_id = MetadataControlEvent.new_plan_id()
+                    # a client may supply the plan id (fleet router
+                    # fan-out: every replica must admit the SAME query
+                    # under the SAME id or per-replica status/retire
+                    # would diverge); otherwise the service mints one
+                    plan_id = body.get("id")
+                    if plan_id is not None and (
+                        not isinstance(plan_id, str)
+                        or not re.fullmatch(r"[\w.:-]{1,128}", plan_id)
+                    ):
+                        return self._reply(
+                            400, {"error": "invalid id"}
+                        )
+                    if plan_id is None:
+                        plan_id = MetadataControlEvent.new_plan_id()
                     summary, reject = service._admit(
                         cql, plan_id, tenant=body.get("tenant")
                     )

@@ -37,6 +37,15 @@ Eviction is bounded-size LRU; ``control.cache_hit`` /
 ``control.cache_miss`` / ``control.cache_evict`` counters land in the
 bound job's telemetry registry (surfaced by ``Job.metrics()`` and
 ``GET /api/v1/health``). docs/control_plane.md has the full contract.
+
+This cache is in-process. ``fleet/warmstore.py`` adds the persistent
+tier UNDER it: the same ``cache_key`` names an on-disk directory of
+AOT-serialized executables, so a fresh replica process warm-starts the
+whole shape class with zero lowerings (cross-process property tests in
+tests/test_fleet.py pin that the two tiers agree on keys — and that the
+soundness split above carries over verbatim: the disk tier shares
+bare-signature entries only for dyn-group hosts, and pins source text
+otherwise, because it inherits ``cache_key`` unchanged).
 """
 
 from __future__ import annotations

@@ -1,0 +1,39 @@
+"""Multi-process serving fleet (docs/fleet.md).
+
+The single-process engine already has every ingredient of a serving
+tier — supervised checkpoint/restore, a transactional-sink commit
+protocol, an in-process AOT executable cache keyed by the
+constants-masked ``plan_signature`` — but all of it dies with the
+process. This package lifts those axes across the process boundary:
+
+* :mod:`.warmstore` — the persistent warm-start compile store: AOT-
+  serialized XLA executables on disk under the PR 12 cache key, so a
+  fresh replica process serves every live plan with zero new lowerings;
+* :mod:`.commitlog` — a file-backed transactional sink riding the
+  supervisor's two-phase commit protocol, the fleet-level exactly-once
+  output account that survives replica handoffs;
+* :mod:`.bootstrap` — replica bootstrap: restore control-plane state
+  from the supervisor checkpoint, warm every executable from the
+  store, measure cold-start-to-first-row;
+* :mod:`.replica` — the replica process entry point
+  (``python -m flink_siddhi_tpu.fleet.replica spec.json``);
+* :mod:`.router` — the key-hash ingest router with control-plane
+  fan-out and merged ``/health`` + Prometheus views.
+"""
+
+from .bootstrap import FirstRowClock, ReplicaSupervisor
+from .commitlog import CommitLogSink, read_committed
+from .router import FleetRouter, hash_route, label_prometheus
+from .warmstore import WarmSlot, WarmStartStore
+
+__all__ = [
+    "CommitLogSink",
+    "FirstRowClock",
+    "FleetRouter",
+    "ReplicaSupervisor",
+    "WarmSlot",
+    "WarmStartStore",
+    "hash_route",
+    "label_prometheus",
+    "read_committed",
+]

@@ -1,0 +1,413 @@
+"""Key-hash router: one ingest + control front door over N replicas.
+
+The router is a thin process-level shim — it owns NO query state:
+
+- **ingest**: a TCP line listener (same wire format as
+  runtime/sources.py SocketLineSource); every JSON line is routed by
+  ``sha256(key)`` to one replica's ingest socket, so a key's events
+  always land on the same replica (deterministic, salt-free — Python's
+  ``hash()`` is process-salted and would split a key across restarts);
+- **control fan-out**: admits/enables/disables are POSTed to EVERY
+  replica under ONE shared plan id (the replica service honors a
+  client-supplied ``id``), so the control plane stays fleet-uniform;
+- **merged views**: ``GET /api/v1/health`` returns the per-replica
+  health blocks keyed by replica id; ``GET /api/v1/metrics/prometheus``
+  concatenates the replica expositions with a ``replica="..."`` label
+  injected into every ``fst_`` sample line.
+
+Rolling restart protocol (docs/fleet.md): ``pause(k)`` buffers k's
+partition in memory → ``drain(k)`` asks the old replica to finish at a
+checkpoint boundary → the ORCHESTRATOR waits for the old process to
+exit (its final checkpoint + warm-store persist must be durable) and
+boots the successor from the same checkpoint/store/commit-log →
+``set_replica(k, info)`` swaps the route entry and flushes the buffer.
+No tenant is dropped (control state rides the checkpoint) and no
+committed row is lost or duplicated (fleet/commitlog.py).
+
+Honest boundary: this is a single-host, loopback-TCP fleet — real
+networks add partitions and reordering this router does not model.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import socket
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+_PROM_SAMPLE = re.compile(
+    r"^(fst_[A-Za-z0-9_:]+)(\{[^}]*\})?( .+)$"
+)
+
+
+def hash_route(key, n: int) -> int:
+    """Deterministic key → replica index (see module docstring)."""
+    data = key if isinstance(key, bytes) else str(key).encode("utf-8")
+    return int.from_bytes(
+        hashlib.sha256(data).digest()[:8], "big"
+    ) % max(int(n), 1)
+
+
+def label_prometheus(text: str, replica_id: str) -> str:
+    """Inject ``replica="id"`` into every fst_ sample line of one
+    replica's exposition (comment/HELP/TYPE lines pass through)."""
+    esc = replica_id.replace("\\", "\\\\").replace('"', '\\"')
+    out = []
+    for line in text.splitlines():
+        m = _PROM_SAMPLE.match(line)
+        if m is None:
+            out.append(line)
+            continue
+        name, labels, rest = m.group(1), m.group(2), m.group(3)
+        if labels:
+            merged = labels[:-1] + f',replica="{esc}"}}'
+        else:
+            merged = f'{{replica="{esc}"}}'
+        out.append(f"{name}{merged}{rest}")
+    return "\n".join(out) + "\n"
+
+
+class _ReplicaLink:
+    """One replica's routing entry: HTTP base + a persistent ingest
+    socket (rebuilt on demand — a successor swaps the ports)."""
+
+    def __init__(self, info: Dict) -> None:
+        self.id = str(info.get("replica") or info["replica_id"])
+        self.host = str(info.get("host", "127.0.0.1"))
+        self.api_port = int(info["api_port"])
+        self.ingest_port = int(info["ingest_port"])
+        self.sent = 0
+        self._sock: Optional[socket.socket] = None
+
+    @property
+    def base(self) -> str:
+        return f"http://{self.host}:{self.api_port}/api/v1"
+
+    def send_line(self, line: bytes) -> None:
+        if self._sock is None:
+            self._sock = socket.create_connection(
+                (self.host, self.ingest_port), timeout=10
+            )
+        try:
+            self._sock.sendall(line)
+        except OSError:
+            # one reconnect: the previous holder of this route entry
+            # may have closed its listener between lines
+            self.close()
+            self._sock = socket.create_connection(
+                (self.host, self.ingest_port), timeout=10
+            )
+            self._sock.sendall(line)
+        self.sent += 1
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+class FleetRouter:
+    """Route ingest by key hash across replicas; fan control out to all
+    of them; merge their observability (see module docstring).
+
+    ``replicas`` is a list of ready dicts — ``{"replica_id",
+    "api_port", "ingest_port"}`` — exactly what a replica process
+    prints on boot. ``key_field`` names the JSON attribute routed on.
+    """
+
+    def __init__(
+        self,
+        replicas: List[Dict],
+        key_field: str = "id",
+        host: str = "127.0.0.1",
+        ingest_port: int = 0,
+        api_port: int = 0,
+        http_timeout: float = 30.0,
+    ) -> None:
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        self.key_field = key_field
+        self.http_timeout = float(http_timeout)
+        # fst:threadsafe lock-guarded: route table + pause buffers are swapped by the orchestrator thread while ingest reader threads route lines
+        self._lock = threading.Lock()
+        self._links = [_ReplicaLink(r) for r in replicas]
+        # index → buffered raw lines while that slot is being replaced
+        self._paused: Dict[int, List[bytes]] = {}
+        self._minted = 0
+        self.routed = 0
+        self.buffered = 0
+        self.bad_lines = 0
+        self.handoffs: List[Dict] = []
+        self._closed = False
+
+        self._listener = socket.socket(
+            socket.AF_INET, socket.SOCK_STREAM
+        )
+        self._listener.setsockopt(
+            socket.SOL_SOCKET, socket.SO_REUSEADDR, 1
+        )
+        self._listener.bind((host, int(ingest_port)))
+        self._listener.listen(32)
+        self.ingest_port = self._listener.getsockname()[1]
+        # fst:thread-root name=router-accept
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="router-accept",
+        )
+        self._accept_thread.start()
+
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/api/v1/health":
+                    self._reply(200, router.health())
+                elif path == "/api/v1/metrics/prometheus":
+                    body = router.prometheus().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header(
+                        "Content-Length", str(len(body))
+                    )
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    self._reply(400, {"error": "bad json"})
+                    return
+                if path == "/api/v1/queries":
+                    try:
+                        self._reply(201, router.admit(
+                            body.get("cql", ""),
+                            plan_id=body.get("id"),
+                            tenant=body.get("tenant"),
+                        ))
+                    except RuntimeError as e:
+                        self._reply(409, {"error": str(e)})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+        self._http = ThreadingHTTPServer((host, int(api_port)), Handler)
+        self._http.daemon_threads = True
+        self.api_port = self._http.server_port
+        # fst:thread-root name=router-http
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True,
+            name="router-http",
+        )
+        self._http_thread.start()
+
+    # -- ingest -------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            # fst:thread-root name=router-ingest
+            threading.Thread(
+                target=self._client_loop, args=(conn,), daemon=True,
+                name="router-ingest",
+            ).start()
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        buf = b""
+        try:
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        self.route_line(line + b"\n")
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def route_line(self, line: bytes) -> None:
+        """Hash the line's key field; forward (or buffer if that slot
+        is mid-handoff)."""
+        try:
+            key = json.loads(line)[self.key_field]
+        except (ValueError, KeyError, TypeError):
+            with self._lock:
+                self.bad_lines += 1
+            return
+        with self._lock:
+            idx = hash_route(key, len(self._links))
+            if idx in self._paused:
+                self._paused[idx].append(line)
+                self.buffered += 1
+                return
+            link = self._links[idx]
+            link.send_line(line)
+            self.routed += 1
+
+    # -- control fan-out ----------------------------------------------------
+    def _post(self, link: _ReplicaLink, path: str, body: Dict) -> Dict:
+        req = urllib.request.Request(
+            link.base + path,
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(
+            req, timeout=self.http_timeout
+        ) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def admit(self, cql: str, plan_id=None, tenant=None) -> Dict:
+        """Admit one query on EVERY replica under one shared plan id;
+        raises RuntimeError if any replica refuses (the caller retries
+        or deletes — admission is budget-checked per replica)."""
+        with self._lock:
+            if plan_id is None:
+                self._minted += 1
+                plan_id = f"fleet-q{self._minted}"
+            links = list(self._links)
+        body: Dict = {"cql": cql, "id": str(plan_id)}
+        if tenant is not None:
+            body["tenant"] = tenant
+        per: Dict[str, Dict] = {}
+        for link in links:
+            try:
+                per[link.id] = self._post(link, "/queries", body)
+            except (OSError, ValueError) as e:
+                raise RuntimeError(
+                    f"admit failed on replica {link.id}: {e}"
+                ) from e
+        return {"id": str(plan_id), "replicas": per}
+
+    def post_all(self, path: str, body: Optional[Dict] = None) -> Dict:
+        """Fan any control POST (enable/disable/delete) to the fleet."""
+        with self._lock:
+            links = list(self._links)
+        return {
+            link.id: self._post(link, path, body or {})
+            for link in links
+        }
+
+    # -- merged observability ----------------------------------------------
+    def _get(self, link: _ReplicaLink, path: str) -> bytes:
+        with urllib.request.urlopen(
+            link.base + path, timeout=self.http_timeout
+        ) as resp:
+            return resp.read()
+
+    def health(self) -> Dict:
+        with self._lock:
+            links = list(self._links)
+            router = {
+                "role": "router",
+                "replicas": [lk.id for lk in links],
+                "routed": self.routed,
+                "buffered": self.buffered,
+                "bad_lines": self.bad_lines,
+                "paused": sorted(self._paused),
+                "handoffs": list(self.handoffs),
+            }
+        per: Dict[str, object] = {}
+        for link in links:
+            try:
+                per[link.id] = json.loads(
+                    self._get(link, "/health")
+                )
+            except (OSError, ValueError) as e:
+                per[link.id] = {"alive": False, "error": str(e)}
+        return {"router": router, "replicas": per}
+
+    def prometheus(self) -> str:
+        with self._lock:
+            links = list(self._links)
+        parts = []
+        for link in links:
+            try:
+                text = self._get(
+                    link, "/metrics/prometheus"
+                ).decode("utf-8")
+            except (OSError, ValueError):
+                continue
+            parts.append(label_prometheus(text, link.id))
+        return "".join(parts)
+
+    # -- rolling restart ----------------------------------------------------
+    def pause(self, idx: int) -> None:
+        """Buffer slot ``idx``'s partition in memory (step one of a
+        handoff). Idempotent."""
+        with self._lock:
+            self._paused.setdefault(int(idx), [])
+
+    def drain(self, idx: int) -> Dict:
+        """Ask slot ``idx``'s replica to finish at a checkpoint
+        boundary (step two; pause first). Returns its drain ack — the
+        orchestrator then waits for the PROCESS to exit before booting
+        the successor."""
+        with self._lock:
+            link = self._links[int(idx)]
+        return self._post(link, "/fleet/drain", {})
+
+    def set_replica(self, idx: int, info: Dict) -> None:
+        """Swap in the successor and flush the buffered partition
+        (final step). The buffer flushes in arrival order, so the
+        partition's event order is preserved across the handoff."""
+        with self._lock:
+            idx = int(idx)
+            old = self._links[idx]
+            old.close()
+            link = _ReplicaLink(info)
+            self._links[idx] = link
+            lines = self._paused.pop(idx, [])
+            for line in lines:
+                link.send_line(line)
+                self.routed += 1
+            self.handoffs.append({
+                "slot": idx, "from": old.id, "to": link.id,
+                "flushed": len(lines),
+            })
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            for link in self._links:
+                link.close()
+        self._http.shutdown()
+        self._http.server_close()

@@ -193,6 +193,15 @@ def snapshot_job(job) -> Dict[str, Any]:
             }
             for sid, lim in getattr(job, "_rate_limiters", {}).items()
         },
+        # serving-fleet account (fleet/, docs/fleet.md): the commit-log
+        # epoch as of this snapshot and the last rolling-restart
+        # handoff — a successor replica resumes the fleet's epoch
+        # numbering and keeps the handoff visible in /health. Absent in
+        # pre-fleet checkpoints (restore defaults both).
+        "fleet": {
+            "epoch": int(getattr(job, "_fleet_epoch", 0)),
+            "last_handoff": getattr(job, "_last_handoff", None),
+        },
     }
 
 
@@ -227,6 +236,12 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
             job._max_event_ts = int(evt["max_event_ts"])
         job.late_events = int(evt.get("late_events", 0))
         job.late_dropped = int(evt.get("late_dropped", 0))
+
+    # serving-fleet account (backward-compatible: pre-fleet
+    # checkpoints leave the defaults — epoch 0, no handoff)
+    fleet = snap.get("fleet") or {}
+    job._fleet_epoch = int(fleet.get("epoch", 0))
+    job._last_handoff = fleet.get("last_handoff")
 
     # dynamically-added queries: replay them (same runtimes, same group
     # slots) BEFORE the plan-set compatibility check below. Tenant

@@ -607,6 +607,23 @@ class Job:
         # output rate limiting: stream_id -> limiter (from plan
         # ``output ... every ...`` clauses, applied at emission)
         self._rate_limiters: Dict[str, _OutputRateLimiter] = {}
+        # persistent warm-start compile store (fleet/warmstore.py):
+        # the disk tier under the AOT cache. None (default) leaves the
+        # single-process path untouched; bind_warm_store() wraps every
+        # cacheable bundle's executables in store-backed dispatchers.
+        # Initialized BEFORE the plan loop below: add_plan ->
+        # _create_runtime reads it for warm-store provenance.
+        self.warm_store = None
+        # fleet identity for /health + metrics (fleet block); None
+        # outside a replica process
+        # fst:ephemeral process identity: the successor replica is handed its own id/role by its spec, never by the checkpoint
+        self._replica_info = None
+        # commit-log epoch as of the last prepared checkpoint + the
+        # last rolling-restart handoff record — both ride the
+        # checkpoint's optional "fleet" block (runtime/checkpoint.py)
+        # so a successor replica resumes the fleet account
+        self._fleet_epoch = 0
+        self._last_handoff = None
         for p in plans:
             self.add_plan(p)
         # output_stream -> list[(ts, row_tuple)] and field names
@@ -1044,6 +1061,80 @@ class Job:
             out[pid] = ent
         return out
 
+    # -- serving fleet (fleet/warmstore.py, docs/fleet.md) ------------------
+    def bind_warm_store(self, store) -> None:
+        """Attach the persistent warm-start compile store. Must happen
+        before plans are created/restored — _create_runtime consults it
+        — so a replica factory binds it right after constructing the
+        job. Telemetry/flight-recorder wiring rides the job's own."""
+        self.warm_store = store
+        if store is not None:
+            store.bind_telemetry(self.telemetry)
+            store.bind_flightrec(self.flightrec)
+
+    def set_replica_info(
+        self, replica_id: str, role: str = "replica", boot=None,
+    ):
+        """``boot`` is a live dict the replica process owns (bootstrap
+        timings: restore_s, warm-start counters, first_row_s) — kept by
+        reference so later updates surface in /health."""
+        self._replica_info = {
+            "id": str(replica_id), "role": str(role),
+        }
+        if boot is not None:
+            self._replica_info["boot"] = boot
+
+    def record_handoff(self, **data) -> None:
+        """Journal a rolling-restart handoff (discrete flight-recorder
+        kind) and pin it in the fleet status/checkpoint block."""
+        info = self._replica_info or {}
+        self._last_handoff = {"replica": info.get("id"), **data}
+        self._frec("fleet.handoff", **self._last_handoff)
+
+    # fst:runloop-only (walks live runtimes; checkpoint-boundary cadence)
+    def persist_warm(self) -> Dict[str, object]:
+        """Serialize every live cacheable plan's executables into the
+        warm store (no-op without one). Called by the replica
+        supervisor at checkpoint boundaries — off the hot path, outside
+        any compile-attribution scope — so the store is caught up
+        whenever a successor might boot from it."""
+        store = self.warm_store
+        if store is None:
+            return {}
+        for pid, rt in list(self._plans.items()):
+            key = getattr(rt, "warm_key", None)
+            entry = getattr(rt, "warm_entry", None)
+            if key is None or entry is None:
+                continue
+            store.persist_entry(
+                key, entry, acc_example=rt.acc,
+                plan_id=pid, tenant=self.tenant_of(pid),
+            )
+        return store.stats()
+
+    def fleet_status(self) -> Optional[Dict[str, object]]:
+        """The /health + metrics ``fleet`` block: replica identity,
+        warm-store counters, commit-log epoch, last handoff. None when
+        the job is not part of a fleet (no store, no replica id) so
+        single-process payloads stay unchanged."""
+        if self.warm_store is None and self._replica_info is None:
+            return None
+        info = self._replica_info or {}
+        out: Dict[str, object] = {
+            "replica": info.get("id"),
+            "role": info.get("role"),
+            "warm_store": (
+                self.warm_store.stats()
+                if self.warm_store is not None else None
+            ),
+            "epoch": int(self._fleet_epoch),
+            "last_handoff": self._last_handoff,
+        }
+        boot = info.get("boot")
+        if boot:
+            out["boot"] = dict(boot)
+        return out
+
     def _create_runtime(
         self, plan: CompiledPlan, admit0=None, cacheable: bool = False,
         tenant: Optional[str] = None,
@@ -1126,6 +1217,17 @@ class Job:
             )
             if cacheable:
                 self.aot_cache.insert(key, entry)
+        if cacheable and key is not None and self.warm_store is not None:
+            # the disk tier (fleet/warmstore.py): wrap the bundle's jit
+            # wrappers in store-backed dispatchers and preload every
+            # executable already serialized for this key — a replica
+            # bootstrap reaches all-live with zero new lowerings.
+            # Idempotent on the in-memory-hit path (already wrapped).
+            entry = self.warm_store.wrap_entry(
+                key, entry,
+                plan_id=plan.plan_id,
+                tenant=tenant or self.tenant_of(plan.plan_id),
+            )
         rt = _PlanRuntime(
             plan=plan,
             states=plan.init_state(),
@@ -1142,6 +1244,10 @@ class Job:
         # drain pack programs ride the cache entry too: a cache-hit
         # admit's first drain must not pay a pack recompile
         rt.pack_jits = entry.pack_jits
+        # warm-store provenance: persist_warm() walks these to
+        # serialize this runtime's executables at checkpoint boundaries
+        rt.warm_key = key if self.warm_store is not None else None
+        rt.warm_entry = entry if self.warm_store is not None else None
         if admit0 is not None:
             rt.states = admit0(rt.states)
         lazy_keys = {
@@ -1355,7 +1461,18 @@ class Job:
                     self._fold_into(host_id, plan, slot, t)
         for pid, cql in dynamic_cql.items():
             if pid not in folded and pid not in self._plans:
-                self.add_plan(self._plan_compiler(cql, pid))
+                # standalone dynamic plans (non-chain: _wrap_dynamic fell
+                # through at admit time) were created cacheable at line
+                # ~888 (cacheable=dynamic) — replay them cacheable too,
+                # NOT via the dynamic add path (whose _try_fold could
+                # fold into a group re-formed above, diverging from the
+                # snapshot's runtime layout). Cacheability here is what
+                # lets a replica bootstrap warm these plans from the
+                # persistent store (fleet/warmstore.py, docs/fleet.md).
+                self._create_runtime(
+                    self._plan_compiler(cql, pid), None,
+                    cacheable=True, tenant=self.tenant_of(pid),
+                )
         for pid, on in enabled.items():
             if not on:
                 self.set_plan_enabled(pid, False)
@@ -3315,7 +3432,6 @@ class Job:
                 # paced load's visibility is ~2x interval while the
                 # histogram reports ~1x
                 rt.dirty_since = pending[0]["t"]
-            rt.tickets.append(self._make_ticket(rt.states))
             if tel.enabled:
                 # per-segment enqueue time (host side of the dispatch;
                 # the device wall hides behind the ticket). Recorded
@@ -3327,6 +3443,13 @@ class Job:
                 tel.record_seconds("dispatch.segment", dt)
                 tel.record_seconds("dispatch.enqueue", dt)
                 tel.inc("fusion.dispatches")
+        # ticket creation OUTSIDE the attribution scope: the one-shot
+        # helper jit (_make_ticket's _noop_jit) is process-wide harness
+        # plumbing shared by every plan — attributing its single
+        # lowering to whichever plan happened to dispatch first would
+        # misattribute it, and would break the fleet bootstrap's
+        # zero-new-lowerings pin (metrics()["compiles"], docs/fleet.md)
+        rt.tickets.append(self._make_ticket(rt.states))
         for e in pending:
             for t in e["ts"]:
                 self.tracer.mark(t, "dispatch", presampled=True)
@@ -3380,13 +3503,15 @@ class Job:
                 tel.record_seconds(
                     "dispatch.enqueue", time.monotonic() - t0
                 )
-            # sliding-window backpressure: a tiny non-donated "ticket"
-            # is derived from the new state each cycle; completed
-            # tickets retire via is_ready polling (free), and only when
-            # the device is a full window behind does the host genuinely
-            # block. Holding tickets (fresh jit outputs) never blocks
-            # state-buffer donation.
-            rt.tickets.append(self._make_ticket(rt.states))
+        # sliding-window backpressure: a tiny non-donated "ticket" is
+        # derived from the new state each cycle; completed tickets
+        # retire via is_ready polling (free), and only when the device
+        # is a full window behind does the host genuinely block.
+        # Holding tickets (fresh jit outputs) never blocks state-buffer
+        # donation. Created OUTSIDE the attribution scope: the helper
+        # jit is process-wide plumbing, not a plan compile (see
+        # _stage_fused and the fleet zero-lowering pin, docs/fleet.md).
+        rt.tickets.append(self._make_ticket(rt.states))
         # sampled events' ingest->dispatch leg (dispatch is async: this
         # marks the point work for the event was HANDED to the device)
         for b in involved:
@@ -3671,6 +3796,10 @@ class Job:
             # permanent compile telemetry (telemetry/compile_events.py):
             # per-plan-signature lowering counts + duration histogram
             "compiles": self._compile_sink.snapshot(),
+            # serving-fleet view (fleet/, docs/fleet.md): replica
+            # identity, warm-store hit/miss/persist counters, commit
+            # epoch, last handoff — None outside a fleet
+            "fleet": self.fleet_status(),
             # measured limiting-leg attribution over the live stage
             # ledger (telemetry/attribution.py; shares against the
             # attributed total — bench states them against the mode's

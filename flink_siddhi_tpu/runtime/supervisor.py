@@ -474,6 +474,16 @@ class Supervisor:
             # whether a prepared commit is in flight — the external
             # exactly-once story in one scrape
             "transactional_sinks": self._txn_sink_stats(job),
+            # serving-fleet block (fleet/, docs/fleet.md): replica
+            # id/role, warm-store hit/miss/persist counters, commit
+            # epoch, last handoff — None outside a fleet, so
+            # single-process payloads are unchanged
+            "fleet": (
+                job.fleet_status()
+                if job is not None
+                and hasattr(job, "fleet_status")
+                else None
+            ),
             "telemetry": self.telemetry.snapshot(),
         }
 

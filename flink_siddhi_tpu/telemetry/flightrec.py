@@ -85,6 +85,15 @@ COLLAPSIBLE_KINDS = frozenset(
         # rides in ``collapsed``; slo.recovered is the discrete
         # transition and always appends
         "slo.violation",
+        # warm-start store traffic (fleet/warmstore.py): a replica
+        # bootstrap fires one hit per executable per plan and a busy
+        # checkpoint cadence persists on every boundary — collapsed so
+        # fleet churn cannot evict the control/restart history;
+        # fleet.handoff (the rolling-restart transition) is discrete
+        # and always appends
+        "fleet.warm_hit",
+        "fleet.warm_miss",
+        "fleet.persist",
     }
 )
 

@@ -263,7 +263,43 @@ def render_openmetrics(metrics: Dict) -> str:
             if isinstance(hist, dict):
                 w.summary(metric_name(fam), labels, hist)
     _emit_slo(w, metrics.get("slo"))
+    _emit_fleet(w, metrics.get("fleet"))
     return w.render()
+
+
+def _emit_fleet(w: _Writer, fleet) -> None:
+    """The serving-fleet block (``metrics()["fleet"]``; fleet/,
+    docs/fleet.md) as ``fst_fleet_*`` series: replica identity as an
+    info-style gauge, the warm-store hit/miss/persist/error counters,
+    the commit epoch, and whether/when the last rolling-restart
+    handoff happened. Absent outside a fleet — the single-process
+    exposition is byte-identical."""
+    if not isinstance(fleet, dict):
+        return
+    labels = {}
+    if fleet.get("replica") is not None:
+        labels["replica"] = str(fleet["replica"])
+    if fleet.get("role") is not None:
+        labels["role"] = str(fleet["role"])
+    w.sample(
+        metric_name("fleet_replica_info"), "gauge", labels or None, 1
+    )
+    store = fleet.get("warm_store")
+    if isinstance(store, dict):
+        for key in ("hits", "misses", "persists", "errors"):
+            w.sample(
+                metric_name(f"fleet_warm_store_{key}", "_total"),
+                "counter", labels or None, store.get(key),
+            )
+    w.sample(
+        metric_name("fleet_epoch"), "gauge", labels or None,
+        fleet.get("epoch"),
+    )
+    handoff = fleet.get("last_handoff")
+    w.sample(
+        metric_name("fleet_last_handoff"), "gauge", labels or None,
+        1 if isinstance(handoff, dict) else 0,
+    )
 
 
 def _emit_slo(w: _Writer, slo) -> None:

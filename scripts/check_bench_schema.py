@@ -170,6 +170,27 @@ replay-mode contracts (v2 stage_breakdown through v10 recovery) do not
 apply to it. Pre-v11 files need not carry the block; a present one is
 validated in any version.
 
+Schema v12 (serving-fleet round, bench.py ``--fleet``,
+``schema_version: 12``) adds the ``fleet`` contract — the cold-vs-warm
+replica bootstrap account across a rolling restart:
+
+* both the ``cold`` and ``warm`` boot blocks must publish a finite
+  positive ``first_row_s`` (cold-start-to-first-row, the headline);
+* the warm boot must BEAT the cold one: ``warm.first_row_s <
+  cold.first_row_s`` — a warm store that does not pay for itself is a
+  failed claim, not a benchmark;
+* the warm boot must be lowering-free: ``warm.compiles == 0`` and
+  ``warm.warm_misses == 0`` with ``warm.warm_hits >= 1``, while the
+  cold boot must have actually populated the store
+  (``cold.persists >= 1``);
+* the commit-log exactly-once account must be clean across the
+  handoff: ``committed.duplicate_epochs == 0`` and
+  ``committed.lost == 0`` with ``committed.rows >= 1``.
+
+A ``--fleet`` line carries ``fleet`` INSTEAD of ``modes`` (same shape
+as ``serving``). Pre-v12 files need not carry the block; a present one
+is validated in any version.
+
 Usage:
     python scripts/check_bench_schema.py [FILES...]
     python scripts/check_bench_schema.py --require-stages FILES...
@@ -1345,6 +1366,94 @@ def validate_serving(srv, errors: List[str], where: str) -> None:
             )
 
 
+def validate_fleet(flt, errors: List[str], where: str) -> None:
+    """The schema-v12 ``fleet`` block: the cold-vs-warm replica
+    bootstrap claims (module docstring) — warm must beat cold, the
+    warm boot must be lowering-free, and the commit-log exactly-once
+    account across the handoff must be clean."""
+    where = f"{where}:fleet"
+    if not isinstance(flt, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    nt = flt.get("tenants")
+    if not isinstance(nt, int) or isinstance(nt, bool) or nt < 2:
+        errors.append(
+            f"{where}: tenants missing/non-int/<2 ({nt!r}) — a "
+            "single-tenant boot cannot claim executable sharing"
+        )
+    boots = {}
+    for name in ("cold", "warm"):
+        blk = flt.get(name)
+        if not isinstance(blk, dict):
+            errors.append(f"{where}: {name} boot block missing")
+            continue
+        frs = blk.get("first_row_s")
+        if not _finite(frs) or frs <= 0:
+            errors.append(
+                f"{where}: {name}.first_row_s missing/non-positive "
+                f"({frs!r}) — cold-start-to-first-row is the headline"
+            )
+        boots[name] = blk
+    cold, warm = boots.get("cold"), boots.get("warm")
+    if cold and warm:
+        cf, wf = cold.get("first_row_s"), warm.get("first_row_s")
+        if _finite(cf) and _finite(wf) and not wf < cf:
+            errors.append(
+                f"{where}: warm.first_row_s ({wf}) must beat "
+                f"cold.first_row_s ({cf}) — a store that does not pay "
+                "for itself is a failed claim"
+            )
+    if warm:
+        if warm.get("compiles") != 0:
+            errors.append(
+                f"{where}: warm.compiles must be 0 "
+                f"({warm.get('compiles')!r}) — the warm boot must "
+                "lower nothing"
+            )
+        if warm.get("warm_misses") != 0:
+            errors.append(
+                f"{where}: warm.warm_misses must be 0 "
+                f"({warm.get('warm_misses')!r})"
+            )
+        hits = warm.get("warm_hits")
+        if not isinstance(hits, int) or isinstance(hits, bool) \
+                or hits < 1:
+            errors.append(
+                f"{where}: warm.warm_hits missing/<1 ({hits!r}) — a "
+                "warm boot that read nothing from the store proves "
+                "nothing"
+            )
+    if cold:
+        persists = cold.get("persists")
+        if not isinstance(persists, int) or isinstance(persists, bool) \
+                or persists < 1:
+            errors.append(
+                f"{where}: cold.persists missing/<1 ({persists!r}) — "
+                "the cold boot must have populated the store"
+            )
+    committed = flt.get("committed")
+    if not isinstance(committed, dict):
+        errors.append(f"{where}: committed block missing")
+    else:
+        if committed.get("duplicate_epochs") != 0:
+            errors.append(
+                f"{where}: committed.duplicate_epochs must be 0 "
+                f"({committed.get('duplicate_epochs')!r})"
+            )
+        if committed.get("lost") != 0:
+            errors.append(
+                f"{where}: committed.lost must be 0 "
+                f"({committed.get('lost')!r})"
+            )
+        rows = committed.get("rows")
+        if not isinstance(rows, int) or isinstance(rows, bool) \
+                or rows < 1:
+            errors.append(
+                f"{where}: committed.rows missing/<1 ({rows!r}) — an "
+                "exactly-once account over zero rows proves nothing"
+            )
+
+
 def validate_doc(
     doc, errors: List[str], where: str, require_stages: bool = False
 ) -> None:
@@ -1370,6 +1479,18 @@ def validate_doc(
         if key in doc and not isinstance(doc[key], _NUM):
             errors.append(f"{where}: {key} non-numeric")
     version = doc.get("schema_version", 1)
+    if "fleet" in doc:
+        validate_fleet(doc["fleet"], errors, where)
+        if not isinstance(doc.get("modes"), dict):
+            # a --fleet line carries fleet INSTEAD of modes (same
+            # shape as the serving exemption below); an optional
+            # recovery block present on it is still held to its
+            # contract
+            if "recovery" in doc:
+                validate_recovery(
+                    doc["recovery"], errors, where, version
+                )
+            return
     if "serving" in doc:
         validate_serving(doc["serving"], errors, where)
         if not isinstance(doc.get("modes"), dict):

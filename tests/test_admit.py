@@ -250,6 +250,57 @@ def test_signature_stable_across_process_restart(zoo):
     assert fresh == here
 
 
+def test_disk_tier_store_keys_stable_across_processes(zoo):
+    """The PR 11 property extended to the persistent warm-start store
+    (fleet/warmstore.py): the on-disk directory name derived from the
+    AOT cache key — and the platform namespace above it — must come
+    out identical in TWO independent fresh processes, or two replicas
+    sharing one store directory would miss each other's executables.
+    (That a store written by process A yields ZERO new lowerings in
+    process B is pinned end-to-end in tests/test_fleet.py.)"""
+    from flink_siddhi_tpu.control.aotcache import cache_key
+    from flink_siddhi_tpu.fleet.warmstore import (
+        store_key_dir,
+        store_namespace,
+    )
+
+    names = ["filter_select", "chain_pattern_within", "window_join"]
+    here = {}
+    for n in names:
+        key = cache_key(zoo[n])
+        if key is not None:
+            here[n] = f"{store_namespace()}/{store_key_dir(key)}"
+    assert here, "no zoo plan produced a cacheable store key"
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['FST_VERIFY_PLANS'] = '0'\n"
+        "from flink_siddhi_tpu.analysis.zoo import PLAN_ZOO, zoo_schemas\n"
+        "from flink_siddhi_tpu.compiler.plan import compile_plan\n"
+        "from flink_siddhi_tpu.control.aotcache import cache_key\n"
+        "from flink_siddhi_tpu.fleet.warmstore import (\n"
+        "    store_key_dir, store_namespace)\n"
+        f"for n in {names!r}:\n"
+        "    p = compile_plan(PLAN_ZOO[n], zoo_schemas(),\n"
+        "                     plan_id=f'zoo:{n}')\n"
+        "    key = cache_key(p)\n"
+        "    if key is not None:\n"
+        "        print(n, store_namespace() + '/' + store_key_dir(key))\n"
+    )
+    results = []
+    for _ in range(2):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, cwd=REPO, timeout=240,
+            check=True,
+        ).stdout
+        results.append(
+            dict(line.split() for line in out.strip().splitlines())
+        )
+    assert results[0] == here
+    assert results[1] == here
+
+
 # -- verdicts on the control plane ------------------------------------------
 
 

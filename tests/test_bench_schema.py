@@ -1493,7 +1493,7 @@ def test_fault_block_live_and_gate_accepts():
     assert errors == []
 
 
-def test_dryrun_emits_schema_complete_v11(tmp_path):
+def test_dryrun_emits_schema_complete_v12(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
     replay, short paced phase) exercises resident + streaming + sink,
     the out-of-process prober, the small-skew disorder sweep, the
@@ -1553,7 +1553,7 @@ def test_dryrun_emits_schema_complete_v11(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 11
+    assert doc["schema_version"] == 12
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]
@@ -1647,7 +1647,7 @@ def test_dryrun_emits_schema_complete_v11(tmp_path):
     )
 
 
-def test_serve_dryrun_emits_valid_v11_serving_line(tmp_path):
+def test_serve_dryrun_emits_valid_serving_line(tmp_path):
     """The live --serve contract: ``bench.py --serve --dryrun`` runs
     ONE fixed-load open-loop pass of the full serving observatory —
     mixed-tenant stack over shared ingest, disorder, mid-run broker
@@ -1685,7 +1685,7 @@ def test_serve_dryrun_emits_valid_v11_serving_line(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 11
+    assert doc["schema_version"] == 12
     srv = doc["serving"]
     # the headline number is the measured aggregate, sustained
     assert doc["value"] == srv["sustained_events_per_sec"] > 0
@@ -1795,3 +1795,154 @@ def test_wrapper_format_extraction(tmp_path):
     assert any(
         "no bench JSON lines" in e for e in CHECK.validate_file(str(p))
     )
+
+
+# -- schema v12: the fleet block (bench.py --fleet) --------------------------
+
+
+def _fleet_doc():
+    """A valid fleet-only v12 line (the shape ``bench.py --fleet
+    --dryrun`` prints; numbers from a real run)."""
+    return {
+        "metric": "cold-start to first row (warm store, 8 tenants)",
+        "value": 1.65,
+        "unit": "seconds",
+        "schema_version": 12,
+        "fleet": {
+            "tenants": 8,
+            "events_per_boot": 200,
+            "store_namespace": "cpu-cpu-n1-jax0.4.37",
+            "cold": {
+                "first_row_s": 4.68, "ready_s": 0.03, "compiles": 1,
+                "warm_hits": 0, "warm_misses": 2, "persists": 3,
+                "store_errors": 0,
+            },
+            "warm": {
+                "first_row_s": 1.65, "ready_s": 0.03, "compiles": 0,
+                "warm_hits": 3, "warm_misses": 0, "persists": 0,
+                "store_errors": 0,
+            },
+            "cold_to_warm_speedup": 2.84,
+            "handoff": {
+                "replica": "fleet-warm", "reason": "drain",
+                "boundary": "final_checkpoint",
+            },
+            "committed": {
+                "rows": 798, "epochs": 8, "duplicate_epochs": 0,
+                "lost": 0,
+            },
+            "wall_seconds": 9.8,
+        },
+    }
+
+
+def test_fleet_block_valid_line_passes(tmp_path):
+    p = tmp_path / "BENCH_fleet.json"
+    p.write_text(json.dumps(_fleet_doc()) + "\n")
+    assert CHECK.validate_file(str(p)) == []
+
+
+def test_fleet_line_exempt_from_replay_contracts(tmp_path):
+    """A --fleet line carries ``fleet`` INSTEAD of ``modes``: the v2
+    stage_breakdown .. v10 recovery-requirement contracts must not
+    fire on it (same early-return shape as the serving exemption)."""
+    doc = _fleet_doc()
+    assert "modes" not in doc and "stage_breakdown" not in doc
+    p = tmp_path / "BENCH_fleet.json"
+    p.write_text(json.dumps(doc) + "\n")
+    errors = CHECK.validate_file(str(p))
+    assert errors == []
+
+
+@pytest.mark.parametrize(
+    "mutate, needle",
+    [
+        (lambda f: f["warm"].__setitem__("compiles", 2),
+         "warm.compiles must be 0"),
+        (lambda f: f["warm"].__setitem__("warm_misses", 1),
+         "warm.warm_misses must be 0"),
+        (lambda f: f["warm"].__setitem__("warm_hits", 0),
+         "warm.warm_hits missing/<1"),
+        (lambda f: f["warm"].__setitem__("first_row_s", 9.9),
+         "must beat cold.first_row_s"),
+        (lambda f: f["cold"].__setitem__("persists", 0),
+         "cold.persists missing/<1"),
+        (lambda f: f["cold"].pop("first_row_s"),
+         "cold.first_row_s missing"),
+        (lambda f: f.pop("warm"), "warm boot block missing"),
+        (lambda f: f["committed"].__setitem__("duplicate_epochs", 1),
+         "duplicate_epochs must be 0"),
+        (lambda f: f["committed"].__setitem__("lost", 5),
+         "committed.lost must be 0"),
+        (lambda f: f["committed"].__setitem__("rows", 0),
+         "committed.rows missing/<1"),
+        (lambda f: f.pop("committed"), "committed block missing"),
+        (lambda f: f.__setitem__("tenants", 1), "tenants missing"),
+    ],
+)
+def test_fleet_block_rejects_broken_claims(tmp_path, mutate, needle):
+    doc = _fleet_doc()
+    mutate(doc["fleet"])
+    p = tmp_path / "BENCH_fleet.json"
+    p.write_text(json.dumps(doc) + "\n")
+    errors = CHECK.validate_file(str(p))
+    assert errors, "mutation should have failed the gate"
+    assert any(needle in e for e in errors), errors
+
+
+def test_fleet_block_validated_on_old_versions_when_present(tmp_path):
+    """Pre-v12 exemption shape: an old line need not carry the block,
+    but one that IS present is held to its contract regardless of the
+    stamped version."""
+    doc = _fleet_doc()
+    doc["schema_version"] = 11
+    doc["fleet"]["warm"]["compiles"] = 3
+    p = tmp_path / "BENCH_fleet.json"
+    p.write_text(json.dumps(doc) + "\n")
+    assert any(
+        "warm.compiles must be 0" in e
+        for e in CHECK.validate_file(str(p))
+    )
+
+
+def test_fleet_dryrun_emits_valid_v12_fleet_line(tmp_path):
+    """The live --fleet contract: ``bench.py --fleet --dryrun`` boots
+    a replica subprocess cold behind the key-hash router, admits the
+    tenant stack through the fan-out control plane, rolling-restarts
+    it into a warm successor booted from the persistent store + the
+    supervisor checkpoint, and the fleet-only JSON line passes the v12
+    gate in the tier-1 lane: warm first-row beats cold, the warm boot
+    lowered NOTHING, and the commit-log exactly-once account across
+    the handoff is clean."""
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu")
+    out = tmp_path / "BENCH_fleet_dryrun.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--fleet", "--dryrun"],
+        env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out.write_text(proc.stdout)
+    assert CHECK.validate_file(str(out)) == []
+    doc = [
+        json.loads(l)
+        for l in proc.stdout.splitlines()
+        if l.strip().startswith("{")
+    ][-1]
+    assert doc["schema_version"] == 12
+    flt = doc["fleet"]
+    # the headline number is the WARM boot's cold-start-to-first-row
+    assert doc["value"] == flt["warm"]["first_row_s"] > 0
+    assert flt["warm"]["first_row_s"] < flt["cold"]["first_row_s"]
+    # the successor lowered nothing: every executable came off disk
+    assert flt["warm"]["compiles"] == 0
+    assert flt["warm"]["warm_hits"] >= 1
+    assert flt["warm"]["warm_misses"] == 0
+    assert flt["cold"]["persists"] >= 1
+    # the handoff was journaled and the committed account is exact
+    assert flt["handoff"]["reason"] == "drain"
+    assert flt["committed"]["rows"] >= 1
+    assert flt["committed"]["duplicate_epochs"] == 0
+    assert flt["committed"]["lost"] == 0
