@@ -5,7 +5,7 @@ Replays N synthetic events through the compiled
 north star names) and reports steady-state events/sec, excluding warmup
 (jit compile) cycles.
 
-Prints ONE JSON line (``schema_version: 12``). One invocation measures
+Prints ONE JSON line (``schema_version: 13``). One invocation measures
 THREE execution modes and emits all of them in the same document, so a
 regression in any path stays a tracked number:
 
@@ -1637,6 +1637,212 @@ def _control_block(dryrun, full=False):
     return block
 
 
+def _subplan_fleet_mix(n_families, members_per_family, n_ids=50):
+    """The subplan-share fleet: ``n_families`` selective leading-
+    bracket predicates, each carried by ``members_per_family``
+    STRUCTURALLY DISTINCT tenant suffixes (non-constants-only — the
+    fleet the stack-join rung alone cannot collapse). Within a family
+    every query shares the exact prefix ``S[price < P]``; across
+    families the prefixes differ only in constants, so the unshared
+    A-side still enjoys the full existing ladder (equal-structure
+    members across families stack-join, hosts 2..N are AOT cache
+    hits) — the B-side's win is attributable to prefix sharing alone,
+    not to comparing against a strawman."""
+    mix = []
+    for f in range(n_families):
+        pred = f"price < {64 * (f + 1)}.0"  # ~3-10% of a 2k batch
+        a, b = (f * 11 + 3) % n_ids, (f * 7 + 1) % n_ids
+        shapes = [
+            f"from S[{pred}][id == {a}] "
+            f"select id, price insert into sh_eq{f}",
+            f"from S[{pred}][id > {a}] "
+            f"select id, price insert into sh_gt{f}",
+            f"from S[{pred}][id < {a + 1}] "
+            f"select id, price insert into sh_lt{f}",
+            f"from S[{pred}]#window.lengthBatch(128) "
+            f"select sum(price) as tot insert into sh_w{f}",
+            f"from S[{pred}][id == {a}][price > 1.0] "
+            f"select id insert into sh_ff{f}",
+            f"from every s1 = S[{pred} and id == {a}] -> "
+            f"s2 = S[{pred} and id == {b}] within 1 sec "
+            f"select s1.timestamp as t1, s2.timestamp as t2 "
+            f"insert into sh_p{f}",
+        ]
+        for m in range(members_per_family):
+            mix.append(
+                (f"f{f}m{m}", f"fam{f}", shapes[m % len(shapes)])
+            )
+    return mix
+
+
+def _subplan_share_block(dryrun, full=False):
+    """Schema v13: cross-tenant common-subplan sharing as a MEASURED
+    A/B (docs/control_plane.md decision ladder; analysis/share.py).
+
+    The same mixed non-constants-only tenant fleet is admitted twice
+    through the control plane over identical sustained load — once
+    with the share rung disabled (the full pre-existing ladder:
+    stack-join + AOT cache) and once with ``share_subplans`` on, where
+    every admit splits at its family's leading bracket and attaches as
+    a consumer suffix on one compiled ``@shr:`` prefix host. Gated by
+    scripts/check_bench_schema.py:
+
+    * both sides' steady-state ev/s finite (the headline ``speedup``
+      is re-derived from them);
+    * per shared host, lowerings stay SUB-LINEAR in members —
+      re-derived from the per-host counts
+      (``metrics()["compiles"].by_signature`` keyed by the host
+      runtime's compile-attribution label);
+    * the PR 14 conservation flag re-checked on the shared side (the
+      host is measured-only bookkeeping: every emitted row attributes
+      to a member tenant), and ``dropped_events`` must be 0.
+
+    ``--share`` (or ``full``) scales the fleet; the default — and the
+    --dryrun tier-1 gate — runs a small fleet so the block is always
+    present in a v13 line."""
+    from flink_siddhi_tpu.app.service import ControlQueueSource
+    from flink_siddhi_tpu.compiler.plan import compile_plan
+    from flink_siddhi_tpu.control import ControlPlane
+    from flink_siddhi_tpu.runtime.executor import Job
+    from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+    from flink_siddhi_tpu.schema.types import AttributeType
+
+    n_families = int(
+        os.environ.get("BENCH_SHARE_FAMILIES", 4 if full else 2)
+    )
+    members = int(
+        os.environ.get("BENCH_SHARE_MEMBERS", 6 if full else 6)
+    )
+    batch = 2_048 if dryrun and not full else 4_096
+    # warmup must be REPRESENTATIVE, not merely nonzero: the shared
+    # side's suffix state buckets reach terminal shape only once a
+    # full batch_size flush chunk has stepped through them, which
+    # takes enough cycles for the lowest-selectivity family to buffer
+    # batch_size mid rows — shorter warmups push those one-time
+    # re-lowerings into the timed window
+    warm_cycles = 36
+    # the window must be long enough for the steady-state advantage
+    # (hosts scan the tape once; suffixes step only per batch_size of
+    # MATCHES) to amortize the closing drain's fixed cost — the drain
+    # is included in the timed window (deferred suffix work), and its
+    # per-plan round trips + first-at-width pack lowerings are one-time
+    # costs a short window would mistake for steady-state throughput
+    steady_cycles = 96 if dryrun and not full else 240
+    schema = StreamSchema(
+        [
+            ("id", AttributeType.INT),
+            ("price", AttributeType.DOUBLE),
+            ("timestamp", AttributeType.LONG),
+        ]
+    )
+
+    def compiler(cql, pid):
+        return compile_plan(cql, {"S": schema}, plan_id=pid)
+
+    mix = _subplan_fleet_mix(n_families, members)
+
+    def side(share):
+        src = _CyclingSource(schema, batch, n_batches=1 << 20)
+        ctrl = ControlQueueSource()
+        job = Job(
+            [], [src], batch_size=batch, time_mode="processing",
+            control_sources=[ctrl], plan_compiler=compiler,
+            retain_results=False,
+        )
+        job.telemetry.enabled = True
+        job.share_subplans = share
+        plane = ControlPlane(job, ctrl)
+        for pid, tenant, cql in mix:
+            plane.admit(cql, plan_id=pid, tenant=tenant)
+        for _ in range(warm_cycles):
+            job.run_cycle()
+        job.drain_outputs()
+        served0 = src.served
+        # the timed window INCLUDES the closing drain: the shared
+        # side's suffix compute rides the loopback at drain time, so
+        # stopping the clock at the last cycle would credit the shared
+        # side with work it had merely deferred
+        t0 = time.perf_counter()
+        for _ in range(steady_cycles):
+            job.run_cycle()
+        job.drain_outputs()
+        elapsed = time.perf_counter() - t0
+        served = src.served - served0
+        comp = job.metrics()["compiles"]
+        counters = job.telemetry.snapshot()["counters"]
+        dropped = (
+            src.served
+            - job.processed_events
+            - int(job.shed_events)
+            - int(job.late_dropped)
+        )
+        sec = {
+            "events_per_sec": round(served / max(elapsed, 1e-9)),
+            "events": int(served),
+            "concurrent_plans": len(job.plan_ids),
+            "lowerings": int(comp["total_lowerings"]),
+            "dropped_events": int(dropped),
+        }
+        if share:
+            by_sig = comp["by_signature"]
+            hosts = {}
+            for entry in job._shared.values():
+                host_rt = job._plans.get(entry["host_id"])
+                label = getattr(host_rt, "sig_label", None)
+                hosts[entry["host_id"]] = {
+                    "members": len(entry["members"]),
+                    # lowerings attributed to this host's compile
+                    # label; structurally-equal hosts share one label
+                    # (AOT cache), so the count is the FLEET's total
+                    # spend on this host shape — sub-linearity gates
+                    # against members, the worst case for one host
+                    "lowerings": int(by_sig.get(label, 0)),
+                }
+            att = _attribution_block(job)
+            sec["hosts"] = hosts
+            sec["subplan_shares"] = int(
+                counters.get("control.subplan_share", 0)
+            )
+            sec["conserved"] = att["conserved"]
+            sec["rows_emitted_total"] = att["rows_emitted_total"]
+        else:
+            sec["stack_joins"] = int(
+                counters.get("control.stack_join", 0)
+            )
+        return sec
+
+    unshared = side(False)
+    shared = side(True)
+    speedup = round(
+        shared["events_per_sec"] / max(unshared["events_per_sec"], 1),
+        3,
+    )
+    block = {
+        "tenants": len(mix),
+        "families": n_families,
+        "members_per_family": members,
+        "mix": "non-constants-only structurally-distinct suffixes",
+        "unshared": unshared,
+        "shared": shared,
+        "speedup": speedup,
+        "dryrun": bool(dryrun and not full),
+    }
+    if not shared["conserved"]:
+        print(
+            "SUBPLAN SHARE NOT CONSERVED: per-plan scoped rows do not "
+            "sum to the shared side's job total",
+            file=sys.stderr,
+        )
+    if speedup < 1.0:
+        print(
+            f"SUBPLAN SHARE SLOWER: shared "
+            f"{shared['events_per_sec']} ev/s vs unshared "
+            f"{unshared['events_per_sec']} ev/s (speedup {speedup})",
+            file=sys.stderr,
+        )
+    return block
+
+
 def _attribution_block(job):
     """Schema v8: the per-plan/per-tenant attribution claims of one
     live job (runtime/executor.py scoped metric groups). Two gated
@@ -1649,8 +1855,8 @@ def _attribution_block(job):
 
     plans = {}
     for pid, reg in job.telemetry.scope_map("plan").items():
-        if pid.startswith("@dyn:"):
-            continue  # shared host scopes carry no emitted rows
+        if pid.startswith(("@dyn:", "@shr:")):
+            continue  # host scopes carry no emitted rows
         plans[pid] = {
             "tenant": job.tenant_of(pid),
             "rows_emitted": int(reg.counter_value("rows_emitted")),
@@ -2046,6 +2252,15 @@ def main():
     # scales to O(100s) of concurrent queries.
     out["control"] = _control_block(
         dryrun, full="--control" in sys.argv
+    )
+
+    # Phase 6 (schema v13): cross-tenant common-subplan sharing as a
+    # measured A/B — the same non-constants-only tenant fleet with the
+    # share rung off vs on, per-host lowerings sub-linear, the
+    # conservation flag re-checked under sharing (gated). ``--share``
+    # scales the fleet.
+    out["subplan_share"] = _subplan_share_block(
+        dryrun, full="--share" in sys.argv
     )
     print(json.dumps(out))
 
@@ -2478,6 +2693,26 @@ def _serve_mix(n_tenants, n_ids):
         f"from S[id == {n_ids // 2}] select id, price insert into out",
         "filter",
     ))
+    if n_tenants >= 3:
+        # a NON-constants-only shared-prefix family: two tenants agree
+        # on the exact leading bracket but keep structurally distinct
+        # residues (extra filter vs windowed aggregate), so a sharing
+        # job compiles the prefix once as a @shr host and rides both
+        # suffixes off its loopback — under the serve pass's churn,
+        # faults and storm. Attached to EXISTING tenants so the tenant
+        # count (and the per-tenant SLO/p99 maps) is unchanged.
+        mix.append((
+            "t1",
+            "from S[price < 48.0][id == 5] "
+            "select id, price insert into out",
+            "shared",
+        ))
+        mix.append((
+            "t2",
+            "from S[price < 48.0]#window.lengthBatch(64) "
+            "select sum(price) as total insert into out",
+            "shared",
+        ))
     return mix
 
 
@@ -2630,6 +2865,13 @@ def _serve_pass(rate, seconds, dryrun):
         # open-loop overload sheds loudly instead of growing unbounded
         job.max_pending_events = max(64 * batch, int(2 * rate))
         job.shed_policy = "drop_oldest"
+        # the mix's shared-prefix family must actually exercise the
+        # subplan-share path (host + loopback suffixes) under serve
+        # hazards; single-bracket plain-projection tenants (including
+        # the latency probe) stay unshared by the splitter's residue-
+        # structure rule, so enabling this does not put every filter
+        # tenant behind the loopback hop
+        job.share_subplans = True
         for tenant in {t for t, _c, _s in mix}:
             job.slo.set_policy(
                 SLOPolicy(
@@ -3304,7 +3546,19 @@ def _serve_pass(rate, seconds, dryrun):
         "slo": slo_block,
         "sustainable": sustainable,
         "limiting_leg": leg,
-        "churn": {**churn, "hostile_refused_rules": hostile_rules},
+        "churn": {
+            **churn,
+            "hostile_refused_rules": hostile_rules,
+            # the mix's shared-prefix family actually rode the share
+            # path (not merely admitted): the live counter, off the
+            # same public metrics surface as everything else (the
+            # control block strips the "control." prefix)
+            "subplan_shares": (
+                (((metrics or {}).get("control") or {})
+                 .get("counters") or {}).get("subplan_share")
+                if isinstance(metrics, dict) else None
+            ),
+        },
         "faults": {
             "kafka_retries": int(kafka_retries),
             "dups_injected": int(len(dup_log)),

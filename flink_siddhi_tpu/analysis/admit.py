@@ -425,6 +425,73 @@ def plan_signature(plan, capacity: int = 128) -> str:
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()
 
 
+def _query_segments(q) -> List:
+    """Ordered constants-masked segment descriptors for one query:
+    source, then each filter bracket, then each window (or each pattern
+    element), then the selector/output tail. The segment grain matches
+    the subplan-share split unit in ``analysis/share.py`` — the first
+    ``filter`` segment IS the shareable prefix's shape class."""
+    from ..query import ast as qast
+
+    segs: List = []
+    inp = q.input
+    if isinstance(inp, qast.StreamInput):
+        segs.append(["source", inp.stream_id])
+        for f in inp.filters:
+            segs.append(["filter", _canon_ast(f)])
+        for w in inp.windows:
+            segs.append(["window", _canon_ast(w)])
+    elif isinstance(inp, qast.PatternInput):
+        segs.append(
+            ["source", sorted({el.stream_id for el in inp.elements}),
+             inp.kind]
+        )
+        for el in inp.elements:
+            segs.append(["element", _canon_ast(el)])
+        segs.append(
+            ["pattern-tail", inp.every_, inp.every_grouped,
+             ["const?", inp.within is not None]]
+        )
+    else:
+        segs.append(["join", _canon_ast(inp)])
+    segs.append(
+        ["select", _canon_ast(q.selector), _canon_ast(q.output_rate),
+         q.output_events, q.output_action]
+    )
+    return segs
+
+
+def segment_signatures(plan) -> List[List[str]]:
+    """Per-query CUMULATIVE prefix signatures — the per-segment
+    extension of :func:`plan_signature`.
+
+    For each source query, entry ``i`` hashes segments ``0..i`` of that
+    query's constants-masked descriptor chain; two queries whose first
+    ``k`` segments are structurally equal (constants may differ) agree
+    on their first ``k`` keys regardless of what follows, and a
+    structural change at segment ``i`` changes keys ``i..n`` only.
+    Process-stable exactly like ``plan_signature`` (sha256 over
+    canonical JSON). The control plane's subplan-share ladder uses the
+    EXACT-constants key from ``analysis/share.py`` to pick a live host;
+    these masked keys are the shape-class bucket it reports against
+    (and the class the shared host's own AOT cache entry lands in)."""
+    out: List[List[str]] = []
+    for q in plan.source_ast.queries:
+        run: List = []
+        hashes: List[str] = []
+        for seg in _query_segments(q):
+            run.append(seg)
+            blob = json.dumps(
+                ["seg", _SIGNATURE_VERSION, run],
+                sort_keys=True, separators=(",", ":"), default=str,
+            )
+            hashes.append(
+                hashlib.sha256(blob.encode("utf-8")).hexdigest()
+            )
+        out.append(hashes)
+    return out
+
+
 # --------------------------------------------------------------------------
 # verdicts
 # --------------------------------------------------------------------------

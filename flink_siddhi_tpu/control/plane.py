@@ -202,9 +202,16 @@ class ControlPlane:
         job = self.job
         plans = {}
         for pid, rt in list(job._plans.items()):
-            if pid.startswith("@dyn:"):
+            if pid.startswith(("@dyn:", "@shr:")):
                 continue
             plans[pid] = {"enabled": rt.enabled, "folded": None}
+        for pid, skey in list(job._shared_member.items()):
+            e = job._shared.get(skey)
+            if e is not None and pid in plans:
+                plans[pid]["shared"] = {
+                    "host": e["host_id"],
+                    "members": len(e["members"]),
+                }
         for pid, (host, slot) in list(job._folded.items()):
             plans[pid] = {
                 "enabled": job._folded_enabled.get(pid, True),

@@ -212,6 +212,7 @@ class WarmStartStore:
         self.misses = 0
         self.persists = 0
         self.errors = 0
+        self.evictions = 0
 
     def bind_telemetry(self, registry) -> None:
         self._telemetry = registry
@@ -262,6 +263,7 @@ class WarmStartStore:
                 "misses": self.misses,
                 "persists": self.persists,
                 "errors": self.errors,
+                "evictions": self.evictions,
             }
 
     # -- paths ------------------------------------------------------------
@@ -309,6 +311,117 @@ class WarmStartStore:
             )
             self._count_error()
             return None
+
+    # -- eviction / garbage collection ------------------------------------
+    def _count_evict(self, entry: str, nbytes: int, reason: str):
+        self._count("evictions")
+        self._inc("fleet.warm_evict")
+        if self._flightrec is not None:
+            self._flightrec.record(
+                "fleet.warm_evict", entry=entry,
+                bytes=int(nbytes), reason=reason,
+            )
+
+    def _entry_readable(self, path: str) -> bool:
+        """Cheap validity probe: the pickled triple unpickles and its
+        first element is the serialized-executable byte blob. Does NOT
+        deserialize the XLA executable (that is the load path's job)."""
+        try:
+            with open(path, "rb") as f:
+                blob, _in_tree, _out_tree = pickle.load(f)
+            return isinstance(blob, (bytes, bytearray))
+        except Exception:  # noqa: BLE001 — any failure = corrupt
+            return False
+
+    def gc(
+        self,
+        max_bytes: Optional[int] = None,
+        max_entries: Optional[int] = None,
+        sweep_corrupt: bool = True,
+    ) -> Dict[str, int]:
+        """Bound the store (ROADMAP: eviction policy): size-bounded LRU
+        over whole key-dir entries ordered by their newest file mtime
+        (an entry any replica recently persisted into is recent), plus
+        a sweep of corrupt/torn files — unreadable ``.exe`` payloads
+        and leftover ``.tmp-<pid>`` writes. Evicting is always safe:
+        a future lookup of an evicted key is an ordinary cold miss that
+        recompiles and re-persists (the never-wrong store contract).
+        Each removal counts ``fleet.warm_evict`` and journals a
+        flight-recorder entry with the reason (``lru``/``corrupt``)."""
+        import shutil
+
+        removed_corrupt = 0
+        entries = []  # (newest mtime, bytes, dir name, dir path)
+        try:
+            names = os.listdir(self._dir)
+        except OSError:
+            names = []
+        for name in sorted(names):
+            path = os.path.join(self._dir, name)
+            if not os.path.isdir(path):
+                continue
+            size = 0
+            newest = 0.0
+            for fn in sorted(os.listdir(path)):
+                fp = os.path.join(path, fn)
+                try:
+                    st = os.stat(fp)
+                except OSError:
+                    continue
+                if ".tmp-" in fn:
+                    # torn write leftover (a crash between open and the
+                    # atomic rename): never referenced, always swept
+                    if sweep_corrupt:
+                        try:
+                            os.unlink(fp)
+                        except OSError:
+                            continue
+                        removed_corrupt += 1
+                        self._count_evict(
+                            f"{name}/{fn}", st.st_size, "corrupt"
+                        )
+                    continue
+                if (
+                    sweep_corrupt
+                    and fn.endswith(".exe")
+                    and not self._entry_readable(fp)
+                ):
+                    try:
+                        os.unlink(fp)
+                    except OSError:
+                        continue
+                    removed_corrupt += 1
+                    self._count_evict(
+                        f"{name}/{fn}", st.st_size, "corrupt"
+                    )
+                    continue
+                size += st.st_size
+                newest = max(newest, st.st_mtime)
+            if not os.listdir(path):
+                try:
+                    os.rmdir(path)
+                except OSError:
+                    pass
+                continue
+            entries.append((newest, size, name, path))
+        entries.sort()  # oldest newest-mtime first = LRU order
+        total = sum(e[1] for e in entries)
+        evicted = 0
+        while entries and (
+            (max_entries is not None and len(entries) > max_entries)
+            or (max_bytes is not None and total > max_bytes)
+        ):
+            _mt, size, name, path = entries.pop(0)
+            shutil.rmtree(path, ignore_errors=True)
+            total -= size
+            evicted += 1
+            self._count_evict(name, size, "lru")
+        return {
+            "evicted": evicted,
+            "corrupt_removed": removed_corrupt,
+            "kept": len(entries),
+            "bytes": int(total),
+        }
 
     def _listing(self, key) -> Dict[str, list]:
         """slot name -> [aval sig, ...] currently on disk for key."""

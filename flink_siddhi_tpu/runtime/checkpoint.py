@@ -170,6 +170,22 @@ def snapshot_job(job) -> Dict[str, Any]:
                 getattr(job, "_plan_admitted_bytes", {})
             ),
         },
+        # cross-tenant shared subplans (analysis/share.py): the share
+        # table — key -> producer host id, loopback mid stream, prefix
+        # CQL, member list. Restore re-forms each host from its prefix
+        # CQL BEFORE the dynamic replay re-admits the member suffixes
+        # (kept in dynamic.cql), then the per-plan state overlay above
+        # restores the host's device state like any runtime's.
+        "shared": {
+            key: {
+                "host_id": e["host_id"],
+                "mid": e["mid"],
+                "prefix_cql": e["prefix_cql"],
+                "src": e["src"],
+                "members": list(e["members"]),
+            }
+            for key, e in getattr(job, "_shared", {}).items()
+        },
         # flight-recorder journal (telemetry/flightrec.py): seq +
         # entries ride the snapshot so the journal survives restore
         # exactly once — entries after this snapshot roll back with a
@@ -253,16 +269,25 @@ def restore_job(job, snap: Dict[str, Any]) -> None:
     job._plan_admitted_bytes.update(
         {k: int(v) for k, v in (dyn.get("admitted_bytes") or {}).items()}
     )
-    if dyn.get("cql"):
+    shared = snap.get("shared") or {}
+    if shared or dyn.get("cql"):
         if job._plan_compiler is None:
             raise ValueError(
                 "checkpoint contains dynamically-added queries but the "
                 "job has no plan compiler; rebuild it through the "
                 "dynamic cql() path"
             )
-        job._replay_dynamic(
-            dyn["cql"], dyn.get("folded", {}), dyn.get("enabled", {})
-        )
+        # shared-subplan hosts re-form FIRST (from their prefix CQL) so
+        # the loopback routing exists — and the hosts precede their
+        # member suffixes in runtime insertion order, the drain-order
+        # invariant the loopback fan-out relies on — before the dynamic
+        # replay re-admits the suffixes from dynamic.cql
+        if shared:
+            job._replay_shared(shared)
+        if dyn.get("cql"):
+            job._replay_dynamic(
+                dyn["cql"], dyn.get("folded", {}), dyn.get("enabled", {})
+            )
 
     # 1. shared string dictionary (identity-preserving, every schema of the
     # environment references the same object)

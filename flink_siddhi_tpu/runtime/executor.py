@@ -555,6 +555,40 @@ class Job:
         # fst:threadsafe single-writer (run loop); service reads are GIL-atomic get()/list() snapshots
         self._folded_enabled: Dict[str, bool] = {}  # host-side mirror
         self._dynamic_cql: Dict[str, str] = {}  # for checkpoint replay
+        # cross-tenant shared subplans (analysis/share.py + the admit
+        # ladder in add_plan, docs/control_plane.md): exact-predicate
+        # share key -> {host_id, mid, prefix_cql, members}. The host
+        # (@shr:<key>) runs the shared prefix ONCE and its mid-stream
+        # rows loop back host-side into every member's suffix runtime;
+        # retire reference-counts members and drops the host with the
+        # last one. All three checkpointed via the "shared" block
+        # (runtime/checkpoint.py) and re-formed by _replay_shared.
+        # fst:threadsafe single-writer (run loop); off-thread readers take dict() snapshots
+        self._shared: Dict[str, Dict] = {}
+        # member plan id -> share key (the refcount's edge list)
+        # fst:threadsafe single-writer (run loop); service reads are GIL-atomic get() only
+        self._shared_member: Dict[str, str] = {}
+        # loopback routing: mid stream id -> share key. _emit_rows
+        # intercepts these streams BEFORE any counter/trace/sink so a
+        # mid row is pure plumbing — per-tenant conservation (PR 14)
+        # only ever counts member-suffix emissions.
+        # fst:threadsafe single-writer (run loop); the emit path reads get() only
+        self._loopback: Dict[str, str] = {}
+        # mid stream id -> ([timestamps], [rows]) accumulated across a
+        # drain: consumer suffixes are stepped ONCE per flush with one
+        # coalesced batch, not once per drained host payload — the
+        # per-dispatch fixed cost on fragmented mid batches would
+        # otherwise dominate the shared side's drain wall clock
+        # fst:ephemeral pending plumbing rows; flushed within the same drain pass
+        self._loopback_buf: Dict[str, tuple] = {}
+        # ladder gate: subplan sharing changes the runtime layout of a
+        # dynamic admit (host + suffix instead of one runtime), so it
+        # is opt-in — FST_SUBPLAN_SHARE=1 or job.share_subplans = True
+        import os as _os
+
+        self.share_subplans = _os.environ.get(
+            "FST_SUBPLAN_SHARE", "0"
+        ).lower() not in ("0", "", "false")
         # shape-keyed AOT executable cache (control/aotcache.py): a
         # dynamic add whose shape class was compiled before reuses the
         # whole jit wrapper set — the ~3.4s first-compile cost is paid
@@ -878,6 +912,13 @@ class Job:
                     stack_join=True,
                 )
                 return
+            if self.share_subplans and self._try_share(plan, tenant):
+                # shared-prefix admit: the prefix predicate already
+                # runs as a live producer host (or was just compiled
+                # once for this admit) and the tenant rode in as a
+                # chained consumer suffix — counters + journal were
+                # recorded by _try_share's inner dynamic add
+                return
             self._frec(
                 "control.admit", plan=plan.plan_id, tenant=tenant,
                 stack_join=False,
@@ -952,6 +993,13 @@ class Job:
         latency is each member's truth, while tenant rollups merging
         them see the shared drain once per member (documented)."""
         pid = rt.plan.plan_id
+        if pid.startswith("@shr:"):
+            # shared-prefix host: every member's matches waited through
+            # its drain — same per-member truth as dyn-group hosts
+            for e in self._shared.values():
+                if e["host_id"] == pid:
+                    return list(e["members"]) or [pid]
+            return [pid]
         if not pid.startswith("@dyn:"):
             return [pid]
         from ..compiler.nfa import DynamicChainGroup
@@ -1390,6 +1438,245 @@ class Job:
         self._folded_enabled[plan.plan_id] = True
         return new_plan, admit0
 
+    # -- cross-tenant shared subplans (analysis/share.py) -------------------
+    def _try_share(self, plan: CompiledPlan, tenant) -> bool:
+        """Subplan-share ladder rung (below stack-join, above the AOT
+        cache): split a shareable filter prefix off the candidate,
+        attach the tenant's residue as a consumer suffix, and run the
+        prefix ONCE as a producer host shared by every tenant whose
+        predicate is exactly equal (analysis/share.py has the two key
+        spaces). Both halves are re-parsed + verified before any state
+        mutates; any failure returns False and the admit falls through
+        to the unshared rungs — never to a wrong program."""
+        from ..analysis import share as shr
+        from ..analysis.plancheck import verify_plan
+
+        if self._plan_compiler is None:
+            return False
+        src = plan.source_ast
+        if (
+            len(src.queries) != 1
+            or src.stream_defs
+            or src.table_defs
+            or plan.chained
+        ):
+            return False
+        sp = shr.split_shared_prefix(src.queries[0])
+        if sp is None:
+            return False
+        src_schema = plan.schemas.get(sp.stream_id)
+        if src_schema is None:
+            return False
+        key = sp.key()
+        mid = shr.mid_stream_of(key)
+        host_id = shr.host_id_of(key)
+        entry = self._shared.get(key)
+        pid = plan.plan_id
+        try:
+            s_cql = shr.suffix_cql(
+                src.queries[0], sp, mid, src_schema
+            )
+            suffix_plan = self._plan_compiler(s_cql, pid)
+            if verify_plan(
+                suffix_plan, trace=False, raise_on_error=False
+            ):
+                return False
+            host_plan = None
+            if entry is None:
+                p_cql = shr.prefix_cql(sp, mid)
+                host_plan = self._plan_compiler(p_cql, host_id)
+                if verify_plan(
+                    host_plan, trace=False, raise_on_error=False
+                ):
+                    return False
+        except Exception:  # noqa: BLE001 — renderer/compiler fell over:
+            # this predicate is outside the faithful subset; the admit
+            # simply proceeds unshared (fail closed, never wrong)
+            return False
+        if entry is None:
+            # the producer host is an ordinary cacheable runtime: its
+            # executables land in the AOT cache and the warm store, so
+            # a drop/re-form (or a replica bootstrap) pays no lowering
+            self._create_runtime(host_plan, None, cacheable=True)
+            entry = {
+                "host_id": host_id,
+                "mid": mid,
+                "prefix_cql": p_cql,
+                "src": sp.stream_id,
+                # loopback encode schema: the prefix is `select *`, so
+                # mid rows carry the SOURCE stream's fields in source
+                # order — encode them with the source StreamSchema
+                # (shared env string dictionary, codes comparable with
+                # every suffix's DDL schema). Runtime-only; restore
+                # re-derives it from the host plan.
+                "mid_schema": src_schema,
+                "members": [],
+            }
+            self._shared[key] = entry
+            self._loopback[mid] = key
+        if entry["members"]:
+            host_rt = self._plans.get(entry["host_id"])
+            if host_rt is not None:
+                # flush the live host's pending loopback rows to the
+                # EXISTING members before this one attaches: host
+                # drains are deferred, and a late joiner must never
+                # receive mid rows produced before its admit (the
+                # unshared oracle's suffix would not have seen them)
+                self._drain_plan(host_rt)
+        entry["members"].append(pid)
+        self._shared_member[pid] = key
+        # checkpoint replay re-admits the SUFFIX verbatim (the host is
+        # re-formed from the "shared" block first) — _apply_control's
+        # setdefault leaves this in place
+        self._dynamic_cql[pid] = s_cql
+        self._inc_control("control.subplan_share")
+        self._inc_tenant(tenant, "control.subplan_share")
+        self._frec(
+            "control.subplan_share", plan=pid, tenant=tenant,
+            host=host_id, mid=mid, key=key,
+            members=len(entry["members"]),
+        )
+        # the suffix rides the rest of the ladder itself: structurally-
+        # equal suffixes stack-join into one dynamic group, so per-host
+        # lowerings stay sub-linear in tenants; recursion is safe —
+        # split_shared_prefix refuses _shr_ readers
+        self.add_plan(suffix_plan, dynamic=True)
+        rt = self._plans.get(pid)
+        if rt is not None:
+            # pre-size the suffix tape to the flush chunk bound
+            # (_flush_loopback chunks at batch_size): the first trace
+            # happens at the terminal bucket, so a large barrier flush
+            # never regrows capacity and re-lowers mid-drain
+            rt.tape_capacity = max(
+                rt.tape_capacity, bucket_size(self.batch_size)
+            )
+        return True
+
+    def _feed_loopback(self, schema, rows) -> None:
+        """Host-side fan-out of a shared prefix's mid-stream rows into
+        every consumer suffix: re-encode the decoded drain rows as an
+        EventBatch (the mid DDL schema shares the environment string
+        dictionary, so codes stay comparable) and step each enabled
+        suffix runtime directly — no reorder buffer, no source path.
+        Reached from _emit_rows BEFORE counters/traces/sinks: mid rows
+        are plumbing, not output."""
+        mid = schema.stream_id
+        if mid not in self._loopback:
+            return
+        epoch = self._epoch_ms or 0
+        pend = self._loopback_buf.get(mid)
+        if pend is None:
+            # third slot: wall age of the OLDEST buffered row — the
+            # freshness bound for jobs that never take blocking drains
+            pend = self._loopback_buf[mid] = ([], [], time.monotonic())
+        pend[0].extend(epoch + rel_ts for rel_ts, _ in rows)
+        pend[1].extend(row for _, row in rows)
+
+    def _flush_loopback(self, force: bool = False) -> None:
+        """Step consumer suffixes with their mid streams' coalesced
+        pending rows. Two regimes:
+
+        * **threshold** (``force=False``, the steady-state drain
+          polls): a mid flushes only once it has buffered a full
+          ``batch_size`` of rows — the suffix dispatch rate scales
+          with the prefix's MATCH volume, not the host's tape volume,
+          which is the entire economics of sharing (a per-drain flush
+          was measured 7x SLOWER than unshared: per-dispatch fixed
+          cost on fragmented mid batches swamped the saved scans)
+        * **barrier** (``force=True``, every ``block=True`` drain:
+          results/snapshot/retire/attach): flush everything — rows the
+          host already produced must be visible to member suffixes
+          before state is read, a member retires, or a late joiner
+          attaches
+
+        A supervised/serving job drains on interval deadlines and
+        never blocks, so the threshold alone would let a trickle mid
+        sit unboundedly; an AGE bound (one drain interval since the
+        oldest buffered row) caps the added visibility latency at
+        ~one extra interval without giving up coalescing under load.
+
+        Flushes chunk to ``batch_size`` so the suffix tape capacity
+        (and therefore its lowering bucket) stabilizes at the same
+        bound the source path uses."""
+        if not self._loopback_buf:
+            return
+        limit = max(
+            1,
+            int(self.batch_size) if self.batch_size is not None else 1,
+        )
+        age_s = (self.drain_interval_ms or 0.0) / 1e3
+        now = time.monotonic()
+        ready = [
+            mid
+            for mid, (_, rows, t0) in list(self._loopback_buf.items())
+            if force
+            or len(rows) >= limit
+            or (age_s and now - t0 >= age_s)
+        ]
+        for mid in ready:
+            pending = self._loopback_buf.pop(mid, None)
+            if pending is None:
+                continue  # a nested barrier flush beat us to it
+            entry = self._shared.get(self._loopback.get(mid, ""))
+            if entry is None or not pending[1]:
+                continue
+            # time-order once across the whole accumulation (stable:
+            # equal timestamps keep emission order), then chunk
+            pairs = sorted(
+                zip(pending[0], pending[1]), key=lambda p: p[0]
+            )
+            consumers = [
+                rt for rt in list(self._plans.values())
+                if rt.enabled and mid in rt.plan.spec.stream_codes
+            ]
+            for i in range(0, len(pairs), limit):
+                part = pairs[i:i + limit]
+                batch = EventBatch.from_records(
+                    mid, entry["mid_schema"],
+                    [row for _, row in part],
+                    timestamps=[t for t, _ in part],
+                )
+                for rt in consumers:
+                    self._step_plan(rt, [batch])
+
+    def _replay_shared(self, shared: Dict[str, Dict]) -> None:
+        """Checkpoint-restore replay of the share table: re-form every
+        producer host from its recorded prefix CQL (cacheable — the
+        warm store serves the lowerings) and rebuild the loopback
+        routing BEFORE _replay_dynamic re-admits the member suffixes,
+        so hosts precede their consumers in runtime insertion order
+        (the drain-ordering invariant the loopback relies on)."""
+        for key, info in sorted(shared.items()):
+            members = [str(m) for m in info.get("members", ())]
+            if not members:
+                continue
+            host_id = str(info["host_id"])
+            try:
+                host_plan = self._plan_compiler(
+                    str(info["prefix_cql"]), host_id
+                )
+            except Exception:  # noqa: BLE001
+                _LOG.warning(
+                    "shared host %r could not be re-formed from its "
+                    "prefix CQL; its members restore unshared-broken "
+                    "(no producer) — retire and re-admit them", host_id,
+                )
+                continue
+            self._create_runtime(host_plan, None, cacheable=True)
+            mid = str(info["mid"])
+            src = str(info["src"])
+            self._shared[key] = {
+                "host_id": host_id,
+                "mid": mid,
+                "prefix_cql": str(info["prefix_cql"]),
+                "src": src,
+                "mid_schema": host_plan.schemas[src],
+                "members": members,
+            }
+            self._loopback[mid] = key
+            for pid in members:
+                self._shared_member[pid] = key
+
     def _replay_dynamic(
         self,
         dynamic_cql: Dict[str, str],
@@ -1485,6 +1772,34 @@ class Job:
                 "control.retire", plan=plan_id,
                 tenant=self._plan_tenant.get(plan_id),
             )
+        skey = self._shared_member.pop(plan_id, None)
+        if skey is not None:
+            entry = self._shared.get(skey)
+            if entry is not None:
+                host_rt = self._plans.get(entry["host_id"])
+                if host_rt is not None:
+                    # surface the host's pending matches FIRST: its
+                    # loopback rows step into this member's suffix,
+                    # whose own drain below then carries them out —
+                    # nothing produced before the retire is lost
+                    self._drain_plan(host_rt)
+                entry["members"] = [
+                    m for m in entry["members"] if m != plan_id
+                ]
+                if not entry["members"]:
+                    # last member retired: drop the producer host too
+                    # (group.evict discipline — its executables stay
+                    # warm in the AOT cache / warm store, so a later
+                    # admit of this predicate re-forms it compile-free)
+                    self._plans.pop(entry["host_id"], None)
+                    self._drain_hints.pop(entry["host_id"], None)
+                    self._loopback.pop(entry["mid"], None)
+                    self._shared.pop(skey, None)
+                    self._inc_control("control.subplan_unshare")
+                    self._frec(
+                        "control.subplan_unshare",
+                        plan=plan_id, host=entry["host_id"], key=skey,
+                    )
         folded = self._folded.pop(plan_id, None)
         self._folded_enabled.pop(plan_id, None)
         self._dynamic_cql.pop(plan_id, None)
@@ -1566,7 +1881,7 @@ class Job:
         return [
             pid
             for pid in list(self._plans)
-            if not pid.startswith("@dyn:")
+            if not pid.startswith(("@dyn:", "@shr:"))
         ] + list(self._folded)
 
     def _apply_control(self, ev) -> None:
@@ -1659,7 +1974,10 @@ class Job:
                     continue
                 _note_admission(plan_id, plan)
                 self.add_plan(plan, dynamic=True)
-                self._dynamic_cql[plan_id] = cql
+                # setdefault: a subplan-share admit already recorded
+                # the tenant's SUFFIX CQL (what replay must re-admit —
+                # the host is re-formed from the "shared" block)
+                self._dynamic_cql.setdefault(plan_id, cql)
             for plan_id, cql in ev.updated_plans.items():
                 if _rejected(plan_id):
                     continue  # the running plan stays as-is
@@ -1672,7 +1990,7 @@ class Job:
                 self.remove_plan(plan_id)
                 _note_admission(plan_id, plan)
                 self.add_plan(plan, dynamic=True)
-                self._dynamic_cql[plan_id] = cql
+                self._dynamic_cql.setdefault(plan_id, cql)
             for plan_id in ev.deleted_plan_ids:
                 self.remove_plan(plan_id)
         elif isinstance(ev, OperationControlEvent):
@@ -2023,9 +2341,38 @@ class Job:
             # every checkpoint land on a segment boundary
             self._dispatch_segment(rt)
         with self.telemetry.span("drain"):
-            for rt in self._plans.values():
+            for rt in list(self._plans.values()):
                 self._drain_request(rt)
                 self._drain_poll(rt, block=wait)
+        if self._loopback and wait:
+            # shared-prefix fan-out: host drains above may have stepped
+            # loopback rows into member suffixes AFTER those suffixes'
+            # own drain passed (and, fused, staged without dispatch) —
+            # a synchronous drain must settle them too, or snapshot()/
+            # results() would miss rows the host already produced.
+            # Hosts precede members in insertion order, so one extra
+            # pass over the loopback consumers suffices.
+            mids = set(self._loopback)
+            with self.telemetry.span("drain"):
+                for rt in list(self._plans.values()):
+                    if not (mids & set(rt.plan.spec.stream_codes)):
+                        continue
+                    # hosts precede members in insertion order, so in
+                    # streaming mode the first pass usually already
+                    # drained the flushed rows — a consumer with no
+                    # staged tape, no undrained dispatch, and no
+                    # in-flight fetch has nothing left to surface, and
+                    # skipping it spares a full drain round trip per
+                    # suffix per drain_outputs
+                    if (
+                        not rt.seg_pending
+                        and rt.dirty_since is None
+                        and not rt.drain_q
+                    ):
+                        continue
+                    self._dispatch_segment(rt)
+                    self._drain_request(rt)
+                    self._drain_poll(rt, block=True)
 
     def _drain_plan(self, rt: _PlanRuntime) -> None:
         """Synchronous per-plan drain (checkpoint / removal paths)."""
@@ -2202,6 +2549,13 @@ class Job:
         """Whether any host-side consumer observes this plan's rows."""
         if self.retain_results:
             return True
+        if self._loopback and any(
+            sid in self._loopback for sid in rt.plan.output_streams()
+        ):
+            # a shared-prefix host's consumers are its member suffixes:
+            # without this, the counts-only drain path would skip the
+            # data fetch + decode and the loopback would starve
+            return True
         return any(
             self._sinks.get(sid)
             for sid in rt.plan.output_streams()
@@ -2303,6 +2657,19 @@ class Job:
         """Complete finished fetches in FIFO order and emit the decoded
         rows (decode already happened on the fetch thread) to
         collectors/sinks. Without ``block`` this never stalls the host."""
+        try:
+            self._drain_poll_inner(rt, block, limit)
+        finally:
+            # coalesced suffix dispatch; a blocking poll is a barrier
+            # (results/snapshot/retire/attach all route through here
+            # via _drain_plan / drain_outputs with block=True), a
+            # non-blocking one only flushes mids at the batch-size
+            # threshold. The finally covers every early return above.
+            self._flush_loopback(force=block)
+
+    def _drain_poll_inner(
+        self, rt: _PlanRuntime, block: bool = False, limit: int = 0
+    ) -> None:
         self._advance_ready(rt)
         done = 0
         while rt.drain_q:
@@ -2439,6 +2806,12 @@ class Job:
         if not rows:
             return
         sid = schema.stream_id
+        if self._loopback and sid in self._loopback:
+            # shared-prefix mid stream: pure host-side plumbing into
+            # the consumer suffixes — no counters, no traces, no sinks
+            # (per-tenant conservation counts member emissions only)
+            self._feed_loopback(schema, rows)
+            return
         if rate_limit:
             limiter = self._rate_limiters.get(sid)
             if limiter is not None:
@@ -3742,7 +4115,7 @@ class Job:
                         "tenant": self.tenant_of(pid),
                     }
                     for pid, rt in list(self._plans.items())
-                    if not pid.startswith("@dyn:")
+                    if not pid.startswith(("@dyn:", "@shr:"))
                 },
                 **{
                     pid: {
@@ -3848,6 +4221,18 @@ class Job:
             },
             "aot_cache": self.aot_cache.stats(),
             "rejections": rejections,
+            # shared-subplan table (analysis/share.py): per share key,
+            # the producer host + member refcount — what the retire
+            # refcounting and the bench's sub-linear-lowerings claim
+            # are checked against
+            "shared": {
+                key: {
+                    "host": e["host_id"],
+                    "mid": e["mid"],
+                    "members": list(e["members"]),
+                }
+                for key, e in dict(self._shared).items()
+            },
         }
 
     def query_listing(self) -> List[Dict[str, object]]:
@@ -3858,6 +4243,7 @@ class Job:
         out: List[Dict[str, object]] = []
         folded = dict(self._folded)
         folded_enabled = dict(self._folded_enabled)
+        shared_member = dict(self._shared_member)
         for pid in self.plan_ids:
             f = folded.get(pid)
             if f is not None:
@@ -3867,12 +4253,18 @@ class Job:
                 rt = self._plans.get(pid)
                 enabled = bool(rt.enabled) if rt is not None else False
                 fold = None
+            skey = shared_member.get(pid)
+            se = self._shared.get(skey) if skey is not None else None
             out.append(
                 {
                     "id": pid,
                     "tenant": self.tenant_of(pid),
                     "enabled": enabled,
                     "folded": fold,
+                    "shared": (
+                        None if se is None
+                        else {"host": se["host_id"], "key": skey}
+                    ),
                 }
             )
         return out
@@ -3917,7 +4309,7 @@ class Job:
         by_tenant: Dict[str, List[str]] = {}
         plan_scopes = reg.scope_map("plan")
         for pid in plan_scopes:
-            if pid.startswith("@dyn:"):
+            if pid.startswith(("@dyn:", "@shr:")):
                 continue
             by_tenant.setdefault(self.tenant_of(pid), []).append(pid)
         for pid in self.plan_ids:  # live but not-yet-scoped plans

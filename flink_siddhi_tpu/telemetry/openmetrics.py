@@ -221,9 +221,10 @@ def render_openmetrics(metrics: Dict) -> str:
 
     def plan_labels(pid: str) -> Dict[str, str]:
         pid = str(pid)
-        if pid.startswith("@dyn:"):
-            # a dynamic-group host is SHARED device state — its scope
-            # (footprint, drain legs) is not one tenant's to claim
+        if pid.startswith(("@dyn:", "@shr:")):
+            # a dynamic-group or shared-prefix host is SHARED device
+            # state — its scope (footprint, drain legs) is not one
+            # tenant's to claim
             return {"plan": pid, "tenant": "shared"}
         return {"plan": pid, "tenant": tenant_of.get(pid, "default")}
 

@@ -167,7 +167,7 @@ class SLOWatchdog:
             pids = [
                 pid
                 for pid in reg.scope_map("plan")
-                if not pid.startswith("@dyn:")
+                if not pid.startswith(("@dyn:", "@shr:"))
                 and job.tenant_of(pid) == tenant
             ]
             if pids:

@@ -191,6 +191,32 @@ A ``--fleet`` line carries ``fleet`` INSTEAD of ``modes`` (same shape
 as ``serving``). Pre-v12 files need not carry the block; a present one
 is validated in any version.
 
+Schema v13 (subplan-sharing round, bench.py ``schema_version: 13``)
+adds the ``subplan_share`` contract — the shared-vs-unshared A/B over
+a mixed tenant fleet whose members share a common filter prefix but
+are structurally distinct past it (NOT foldable by constants-only
+stack-joins alone):
+
+* both sides publish finite positive ``events_per_sec`` over the SAME
+  event count, with ``dropped_events == 0`` on each (a side that
+  sheds load wins its A/B by cheating), and the timed window includes
+  the closing drain (the shared side's suffix compute is deferred to
+  drain time — stopping the clock earlier would credit it with work
+  it merely postponed);
+* the declared ``speedup`` must RE-DERIVE from the two sides'
+  published ev/s, and sharing must actually win: >= 1.0 on a full
+  fleet (a dryrun's small fleet gets a 0.8 regression backstop —
+  the failure modes this gate exists to catch measured <= 0.5);
+* the shared side's per-tenant attribution must still CONSERVE
+  (``conserved: true`` — host scopes are measured-only, member rows
+  sum exactly to the job total), and each ``@shr:`` host must show
+  compile spend SUB-LINEAR in members: ``lowerings < members``, since
+  one-lowering-per-tenant is precisely the unshared cost.
+
+Replay lines only (``--serve``/``--fleet`` lines early-return above);
+pre-v13 files need not carry the block, a present one is validated in
+any version.
+
 Usage:
     python scripts/check_bench_schema.py [FILES...]
     python scripts/check_bench_schema.py --require-stages FILES...
@@ -1454,6 +1480,137 @@ def validate_fleet(flt, errors: List[str], where: str) -> None:
             )
 
 
+def validate_subplan_share(blk, errors: List[str], where: str) -> None:
+    """The schema-v13 ``subplan_share`` block: the shared-vs-unshared
+    A/B over a mixed non-constants-only tenant fleet. The gate
+    RE-DERIVES the speedup from the two sides' published ev/s, holds
+    both sides to zero dropped events, requires the shared side's
+    per-tenant attribution to conserve, and requires per-host compile
+    spend to be SUB-LINEAR in members (< 1 lowering per member — the
+    point of sharing the prefix)."""
+    where = f"{where}:subplan_share"
+    if not isinstance(blk, dict):
+        errors.append(f"{where}: must be an object")
+        return
+    nt = blk.get("tenants")
+    if not isinstance(nt, int) or isinstance(nt, bool) or nt < 2:
+        errors.append(
+            f"{where}: tenants missing/non-int/<2 ({nt!r}) — a "
+            "single-tenant fleet cannot claim cross-tenant sharing"
+        )
+    sides = {}
+    for name in ("unshared", "shared"):
+        sec = blk.get(name)
+        if not isinstance(sec, dict):
+            errors.append(f"{where}: {name} side missing")
+            continue
+        evs = sec.get("events_per_sec")
+        if not _finite(evs) or evs <= 0:
+            errors.append(
+                f"{where}: {name}.events_per_sec missing/non-positive "
+                f"({evs!r})"
+            )
+        if sec.get("dropped_events") != 0:
+            errors.append(
+                f"{where}: {name}.dropped_events must be 0 "
+                f"({sec.get('dropped_events')!r}) — a side that sheds "
+                "load wins its A/B by cheating"
+            )
+        sides[name] = sec
+    shared = sides.get("shared")
+    if shared:
+        if shared.get("conserved") is not True:
+            errors.append(
+                f"{where}: shared.conserved must be true — per-plan "
+                "scoped rows must still sum exactly to the job total "
+                "when tenants ride a shared host"
+            )
+        hosts = shared.get("hosts")
+        if not isinstance(hosts, dict) or not hosts:
+            errors.append(
+                f"{where}: shared.hosts missing/empty — an A/B where "
+                "no prefix host formed measured nothing"
+            )
+        else:
+            for hid, h in hosts.items():
+                if not isinstance(h, dict):
+                    errors.append(f"{where}: hosts[{hid}] not an object")
+                    continue
+                members = h.get("members")
+                lows = h.get("lowerings")
+                if not isinstance(members, int) \
+                        or isinstance(members, bool) or members < 2:
+                    errors.append(
+                        f"{where}: hosts[{hid}].members missing/<2 "
+                        f"({members!r}) — a host with one member "
+                        "shares nothing"
+                    )
+                if not isinstance(lows, int) or isinstance(lows, bool) \
+                        or lows < 0:
+                    errors.append(
+                        f"{where}: hosts[{hid}].lowerings "
+                        f"missing/negative ({lows!r})"
+                    )
+                elif isinstance(members, int) and members >= 2 \
+                        and lows >= members:
+                    errors.append(
+                        f"{where}: hosts[{hid}].lowerings ({lows}) must "
+                        f"be sub-linear in members ({members}) — "
+                        "one-lowering-per-tenant is the unshared cost"
+                    )
+        shares = shared.get("subplan_shares")
+        if not isinstance(shares, int) or isinstance(shares, bool) \
+                or shares < 2:
+            errors.append(
+                f"{where}: shared.subplan_shares missing/<2 "
+                f"({shares!r})"
+            )
+    speedup = blk.get("speedup")
+    if not _finite(speedup) or speedup <= 0:
+        errors.append(
+            f"{where}: speedup missing/non-positive ({speedup!r})"
+        )
+    else:
+        un = sides.get("unshared", {}).get("events_per_sec")
+        sh = sides.get("shared", {}).get("events_per_sec")
+        if _finite(un) and _finite(sh) and un > 0:
+            derived = sh / un
+            if abs(derived - speedup) > max(0.011, derived * 0.01):
+                errors.append(
+                    f"{where}: speedup ({speedup}) does not re-derive "
+                    f"from the published sides ({derived:.3f}) — a "
+                    "declared ratio cannot lie"
+                )
+        # the headline claim: sharing must actually WIN. The dryrun
+        # fleet is small (its closing-drain fixed costs weigh more),
+        # so it gets a regression backstop instead of the full bar —
+        # the broken states this gate exists to catch (per-payload
+        # suffix dispatch, in-window re-lowering) measured <= 0.5
+        floor = 0.8 if blk.get("dryrun") else 1.0
+        if speedup < floor:
+            errors.append(
+                f"{where}: speedup ({speedup}) below {floor} — the "
+                "shared fleet must not lose to the unshared one"
+            )
+        INFO.append(
+            f"{where}: shared/unshared speedup {speedup} "
+            f"({'dryrun' if blk.get('dryrun') else 'full'} fleet)"
+        )
+
+
+def validate_v13(doc, errors: List[str], where: str) -> None:
+    """The cross-tenant subplan-sharing contract: a v13 replay line
+    must carry the shared-vs-unshared A/B block."""
+    blk = doc.get("subplan_share")
+    if blk is None:
+        errors.append(
+            f"{where}: subplan_share block missing (schema v13 "
+            "requires the shared-vs-unshared fleet A/B)"
+        )
+    else:
+        validate_subplan_share(blk, errors, where)
+
+
 def validate_doc(
     doc, errors: List[str], where: str, require_stages: bool = False
 ) -> None:
@@ -1553,6 +1710,12 @@ def validate_doc(
         validate_attribution(
             doc["control"]["attribution"], errors, f"{where}:control"
         )
+    if version >= 13:
+        validate_v13(doc, errors, where)
+    elif "subplan_share" in doc:
+        # pre-v13 exemption (same shape as disorder/control): a block
+        # present in an older line is still held to its contract
+        validate_subplan_share(doc["subplan_share"], errors, where)
     if "recovery" in doc:
         validate_recovery(doc["recovery"], errors, where, version)
 

@@ -26,6 +26,7 @@ from flink_siddhi_tpu.analysis.admit import (
     admit_plan,
     analyze_plan,
     plan_signature,
+    segment_signatures,
 )
 from flink_siddhi_tpu.analysis.zoo import (
     HOSTILE_ZOO,
@@ -247,6 +248,84 @@ def test_signature_stable_across_process_restart(zoo):
         check=True,
     ).stdout
     fresh = dict(line.split() for line in out.strip().splitlines())
+    assert fresh == here
+
+
+# -- per-segment prefix signatures (the subplan-share key space) -------------
+
+
+def _segsigs(cql, plan_id="p"):
+    plan = compile_plan(cql, zoo_schemas(), plan_id=plan_id)
+    return segment_signatures(plan)
+
+
+def test_segment_signatures_share_prefix_split_at_divergence():
+    """Two tenants whose queries agree on the leading filter bracket
+    but diverge after it must agree on every prefix segment key up to
+    the divergence and split exactly there — the property the
+    control plane's subplan-share decision keys on."""
+    a = _segsigs(
+        "from S[price > 2.0][id == 1] select id insert into out"
+    )[0]
+    b = _segsigs(
+        "from S[price > 2.0][id > 3] select name insert into o2",
+        plan_id="other-tenant",
+    )[0]
+    assert len(a) == len(b) == 4  # source, filter, filter, select
+    assert a[0] == b[0]  # same source stream
+    assert a[1] == b[1]  # same shared leading filter
+    assert a[2] != b[2]  # == vs > is structure: keys diverge here
+    assert a[3] != b[3]  # cumulative: divergence never heals
+
+
+def test_segment_signatures_constants_only_change_collides():
+    a = _segsigs(
+        "from S[price > 2.0][id == 1] select id insert into out"
+    )[0]
+    b = _segsigs(
+        "from S[price > 9.0][id == 7] select id insert into out"
+    )[0]
+    assert a == b  # literals are masked, exactly like plan_signature
+
+
+def test_segment_signatures_structural_prefix_change_splits():
+    a = _segsigs("from S[price > 2.0] select id insert into out")[0]
+    b = _segsigs("from S[price >= 2.0] select id insert into out")[0]
+    assert a[0] == b[0]  # source segment agrees
+    assert a[1] != b[1]  # operator change splits the filter segment
+
+
+def test_segment_signatures_stable_across_process_restart():
+    """Same contract as plan_signature: a fresh process must derive
+    byte-identical segment keys, or a restarted control plane would
+    stop recognizing live shared prefixes."""
+    cqls = [
+        "from S[price > 2.0][id == 1] select id insert into out",
+        "from S[price > 2.0] select sum(price) as t insert into o2",
+        "from every s1 = S[id == 1] -> s2 = S[id == 2] "
+        "within 5 sec select s1.price as a insert into o3",
+    ]
+    here = [_segsigs(c, plan_id=f"q{i}") for i, c in enumerate(cqls)]
+    code = (
+        "import os, json\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['FST_VERIFY_PLANS'] = '0'\n"
+        "from flink_siddhi_tpu.analysis.zoo import zoo_schemas\n"
+        "from flink_siddhi_tpu.analysis.admit import "
+        "segment_signatures\n"
+        "from flink_siddhi_tpu.compiler.plan import compile_plan\n"
+        f"for i, c in enumerate({cqls!r}):\n"
+        "    p = compile_plan(c, zoo_schemas(), plan_id=f'q{i}')\n"
+        "    print(json.dumps(segment_signatures(p)))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, cwd=REPO, timeout=240,
+        check=True,
+    ).stdout
+    import json
+
+    fresh = [json.loads(line) for line in out.strip().splitlines()]
     assert fresh == here
 
 

@@ -1493,7 +1493,7 @@ def test_fault_block_live_and_gate_accepts():
     assert errors == []
 
 
-def test_dryrun_emits_schema_complete_v12(tmp_path):
+def test_dryrun_emits_schema_complete_v13(tmp_path):
     """The live contract: ``bench.py --dryrun`` (small events, one
     replay, short paced phase) exercises resident + streaming + sink,
     the out-of-process prober, the small-skew disorder sweep, the
@@ -1553,7 +1553,7 @@ def test_dryrun_emits_schema_complete_v12(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 12
+    assert doc["schema_version"] == 13
     assert set(doc["modes"]) == {"resident", "streaming", "sink"}
     for name, sec in doc["modes"].items():
         lat = sec["latency"]
@@ -1645,6 +1645,21 @@ def test_dryrun_emits_schema_complete_v12(tmp_path):
         math.isfinite(ent.get("utilization", float("nan")))
         for ent in att["footprint"].values()
     )
+    # the v13 additions: the shared-vs-unshared fleet A/B really ran —
+    # hosts formed, each serving >= 2 members with sub-linear compile
+    # spend, attribution conserved with tenants riding shared prefixes,
+    # and neither side shed load (the gate re-derives the speedup and
+    # holds the dryrun fleet to its regression backstop)
+    shr = doc["subplan_share"]
+    assert shr["tenants"] >= 12
+    assert shr["dryrun"] is True
+    assert shr["shared"]["conserved"] is True
+    assert shr["shared"]["subplan_shares"] >= shr["tenants"]
+    assert shr["unshared"]["dropped_events"] == 0
+    assert shr["shared"]["dropped_events"] == 0
+    for h in shr["shared"]["hosts"].values():
+        assert h["members"] >= 2
+        assert h["lowerings"] < h["members"]
 
 
 def test_serve_dryrun_emits_valid_serving_line(tmp_path):
@@ -1685,7 +1700,7 @@ def test_serve_dryrun_emits_valid_serving_line(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 12
+    assert doc["schema_version"] == 13
     srv = doc["serving"]
     # the headline number is the measured aggregate, sustained
     assert doc["value"] == srv["sustained_events_per_sec"] > 0
@@ -1712,6 +1727,12 @@ def test_serve_dryrun_emits_valid_serving_line(tmp_path):
         for k in ("admitted", "retired", "disabled", "enabled")
     )
     assert churn["hostile_refused_rules"]
+    # the mix's shared-prefix family (two structurally distinct
+    # residues behind one exact bracket) was admitted AND actually
+    # rode the subplan-share path under churn/faults — real coverage
+    # of the share ladder rung on the serving line, no new gate
+    assert srv["mix"].get("shared") == 2
+    assert churn["subplan_shares"] >= 2
     # the prober ran out of process under serving load
     sus = srv["sustainable"]
     assert math.isfinite(sus["probe_p99_ms"])
@@ -1931,7 +1952,7 @@ def test_fleet_dryrun_emits_valid_v12_fleet_line(tmp_path):
         for l in proc.stdout.splitlines()
         if l.strip().startswith("{")
     ][-1]
-    assert doc["schema_version"] == 12
+    assert doc["schema_version"] == 13
     flt = doc["fleet"]
     # the headline number is the WARM boot's cold-start-to-first-row
     assert doc["value"] == flt["warm"]["first_row_s"] > 0
@@ -1946,3 +1967,196 @@ def test_fleet_dryrun_emits_valid_v12_fleet_line(tmp_path):
     assert flt["committed"]["rows"] >= 1
     assert flt["committed"]["duplicate_epochs"] == 0
     assert flt["committed"]["lost"] == 0
+
+
+# -- schema v13: the subplan_share block (cross-tenant sharing A/B) ----------
+
+
+def _share_blk(**over):
+    """A valid v13 ``subplan_share`` block (the shape bench.py's
+    replay line carries; numbers from a real dryrun)."""
+    blk = {
+        "tenants": 12,
+        "families": 2,
+        "members_per_family": 6,
+        "mix": "non-constants-only structurally-distinct suffixes",
+        "unshared": {
+            "events_per_sec": 100_000, "events": 196_608,
+            "concurrent_plans": 12, "lowerings": 11,
+            "dropped_events": 0, "stack_joins": 1,
+        },
+        "shared": {
+            "events_per_sec": 180_000, "events": 196_608,
+            "concurrent_plans": 12, "lowerings": 14,
+            "dropped_events": 0,
+            "hosts": {
+                "@shr:aaaa0000aaaa0000": {"members": 6, "lowerings": 1},
+                "@shr:bbbb1111bbbb1111": {"members": 6, "lowerings": 1},
+            },
+            "subplan_shares": 12,
+            "conserved": True,
+            "rows_emitted_total": 27_258,
+        },
+        "speedup": 1.8,
+        "dryrun": False,
+    }
+    blk.update(over)
+    return blk
+
+
+def _v13_doc(**over):
+    doc = _v10_doc()
+    doc["schema_version"] = 13
+    doc["subplan_share"] = _share_blk(**over)
+    return doc
+
+
+def test_valid_v13_doc_passes():
+    errors = []
+    CHECK.validate_doc(_v13_doc(), errors, "doc")
+    assert errors == []
+
+
+def test_v13_requires_subplan_share_block():
+    doc = _v13_doc()
+    del doc["subplan_share"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("subplan_share block missing" in e for e in errors)
+
+
+def test_pre_v13_exempt_but_present_block_validated():
+    # a v12-era replay line need not carry the block...
+    doc = _v10_doc()
+    doc["schema_version"] = 12
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []
+    # ...but one that IS present is held to its contract
+    doc["subplan_share"] = _share_blk(speedup=9.9)
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("does not re-derive" in e for e in errors)
+
+
+def test_v13_speedup_must_rederive_from_sides():
+    errors = []
+    CHECK.validate_doc(_v13_doc(speedup=2.5), errors, "doc")
+    assert any("does not re-derive" in e for e in errors)
+
+
+def test_v13_sharing_must_not_lose():
+    # a full-fleet line below 1.0 fails outright
+    doc = _v13_doc()
+    doc["subplan_share"]["unshared"]["events_per_sec"] = 200_000
+    doc["subplan_share"]["speedup"] = 0.9
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("must not lose" in e for e in errors)
+    # the dryrun fleet gets the 0.8 regression backstop: 0.9 passes...
+    doc["subplan_share"]["dryrun"] = True
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []
+    # ...but the broken-coalescing regime (<= 0.5) still fails
+    doc["subplan_share"]["unshared"]["events_per_sec"] = 400_000
+    doc["subplan_share"]["speedup"] = 0.45
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("must not lose" in e for e in errors)
+
+
+def test_v13_shared_side_must_conserve():
+    doc = _v13_doc()
+    doc["subplan_share"]["shared"]["conserved"] = False
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("conserved must be true" in e for e in errors)
+
+
+def test_v13_host_lowerings_must_be_sublinear():
+    # one lowering per member is exactly the unshared cost: rejected
+    doc = _v13_doc()
+    doc["subplan_share"]["shared"]["hosts"][
+        "@shr:aaaa0000aaaa0000"]["lowerings"] = 6
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("sub-linear" in e for e in errors)
+    # a host nobody shares proves nothing
+    doc = _v13_doc()
+    doc["subplan_share"]["shared"]["hosts"][
+        "@shr:aaaa0000aaaa0000"]["members"] = 1
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("shares nothing" in e for e in errors)
+
+
+def test_v13_dropped_events_fail_either_side():
+    doc = _v13_doc()
+    doc["subplan_share"]["shared"]["dropped_events"] = 17
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("cheating" in e for e in errors)
+
+
+def test_v13_nonfinite_throughput_rejected():
+    doc = _v13_doc()
+    doc["subplan_share"]["shared"]["events_per_sec"] = float("nan")
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "shared.events_per_sec missing/non-positive" in e
+        for e in errors
+    )
+    doc = _v13_doc()
+    del doc["subplan_share"]["unshared"]["events_per_sec"]
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any(
+        "unshared.events_per_sec missing/non-positive" in e
+        for e in errors
+    )
+
+
+def test_v13_missing_hosts_rejected():
+    doc = _v13_doc()
+    doc["subplan_share"]["shared"]["hosts"] = {}
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert any("hosts missing/empty" in e for e in errors)
+
+
+@pytest.mark.slow
+def test_subplan_share_block_live_and_gate_accepts():
+    """The live producer: bench._subplan_share_block(True) runs the
+    real shared-vs-unshared A/B (two families x six structurally-
+    distinct members over one Job each) and the resulting block
+    passes the v13 gate. Subprocess-isolated like the --fault live
+    test, and slow-marked: the block also rides the main --dryrun
+    line, whose live test gate-validates it in the tier-1 lane — this
+    test exists to debug the producer in isolation."""
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import json, bench; "
+            "print(json.dumps(bench._subplan_share_block(True)))",
+        ],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    block = json.loads(proc.stdout.splitlines()[-1])
+    assert block["tenants"] >= 12
+    assert block["shared"]["conserved"] is True
+    assert block["shared"]["subplan_shares"] >= block["tenants"]
+    for h in block["shared"]["hosts"].values():
+        assert h["members"] >= 2
+        assert h["lowerings"] < h["members"]
+    # attached to a v13 replay line the REQUIRED contract holds
+    doc = _v10_doc()
+    doc["schema_version"] = 13
+    doc["subplan_share"] = block
+    errors = []
+    CHECK.validate_doc(doc, errors, "doc")
+    assert errors == []

@@ -136,6 +136,105 @@ def test_warm_slot_falls_back_to_wrapper_on_broken_executable(
     assert store.stats()["errors"] == 1
 
 
+# -- store eviction: size-bounded LRU + corrupt-entry sweep ------------------
+
+
+def _seed_store_entry(store, name, sig, nbytes, age_s):
+    """Fabricate an on-disk store entry (valid pickled triple) whose
+    newest-file mtime is ``age_s`` seconds in the past."""
+    import pickle
+
+    kd = os.path.join(store._dir, name)
+    os.makedirs(kd, exist_ok=True)
+    path = os.path.join(kd, f"jitted@{sig}.exe")
+    with open(path, "wb") as f:
+        pickle.dump((b"x" * nbytes, None, None), f)
+    t = time.time() - age_s
+    os.utime(path, (t, t))
+    return path
+
+
+def test_warm_store_gc_evicts_lru_under_byte_budget(tmp_path):
+    store = WarmStartStore(str(tmp_path))
+    _seed_store_entry(store, "k-old", "s1", 1000, 300)
+    _seed_store_entry(store, "k-mid", "s1", 1000, 200)
+    _seed_store_entry(store, "k-new", "s1", 1000, 100)
+    out = store.gc(max_bytes=2500)
+    assert out["evicted"] == 1 and out["corrupt_removed"] == 0
+    assert sorted(os.listdir(store._dir)) == ["k-mid", "k-new"]
+    assert out["bytes"] <= 2500
+    assert store.stats()["evictions"] == 1
+    # idempotent: already under budget → nothing further
+    assert store.gc(max_bytes=2500)["evicted"] == 0
+
+
+def test_warm_store_gc_entry_count_bound(tmp_path):
+    store = WarmStartStore(str(tmp_path))
+    for i, age in enumerate((400, 300, 200, 100)):
+        _seed_store_entry(store, f"k-{i}", "s1", 10, age)
+    out = store.gc(max_entries=2)
+    assert out["evicted"] == 2 and out["kept"] == 2
+    assert sorted(os.listdir(store._dir)) == ["k-2", "k-3"]
+
+
+def test_warm_store_gc_sweeps_corrupt_and_torn_entries(tmp_path):
+    """Unreadable ``.exe`` payloads and leftover ``.tmp-<pid>`` files
+    are removed regardless of budget; an emptied key dir disappears;
+    every removal is counted and journaled with a reason."""
+    from flink_siddhi_tpu.telemetry.flightrec import FlightRecorder
+
+    store = WarmStartStore(str(tmp_path))
+    frec = FlightRecorder()
+    store.bind_flightrec(frec)
+    keep = _seed_store_entry(store, "k-good", "s1", 100, 100)
+    bad = os.path.join(store._dir, "k-bad")
+    os.makedirs(bad)
+    with open(os.path.join(bad, "jitted@sX.exe"), "wb") as f:
+        f.write(b"\x00not-a-pickle")
+    with open(keep + ".tmp-99999", "wb") as f:
+        f.write(b"torn write")
+    out = store.gc()  # no budget: sweep only
+    assert out["evicted"] == 0 and out["corrupt_removed"] == 2
+    assert sorted(os.listdir(store._dir)) == ["k-good"]
+    assert store.stats()["evictions"] == 2
+    evs = [e for e in frec.events() if e["kind"] == "fleet.warm_evict"]
+    assert len(evs) == 2
+    assert {e["reason"] for e in evs} == {"corrupt"}
+
+
+def test_warm_store_gc_lru_eviction_is_journaled(tmp_path):
+    from flink_siddhi_tpu.telemetry.flightrec import FlightRecorder
+
+    store = WarmStartStore(str(tmp_path))
+    frec = FlightRecorder()
+    store.bind_flightrec(frec)
+    _seed_store_entry(store, "k-a", "s1", 500, 200)
+    _seed_store_entry(store, "k-b", "s1", 500, 100)
+    store.gc(max_entries=1)
+    evs = [e for e in frec.events() if e["kind"] == "fleet.warm_evict"]
+    assert len(evs) == 1
+    assert evs[0]["reason"] == "lru" and evs[0]["entry"] == "k-a"
+    assert evs[0]["bytes"] > 0
+
+
+def test_warm_store_gc_evicted_key_recompiles_as_cold_miss(tmp_path):
+    """The never-wrong contract: after eviction a lookup is an ordinary
+    miss — the slot compiles live and re-persists, results unchanged."""
+    import jax
+
+    from flink_siddhi_tpu.fleet.warmstore import WarmSlot
+
+    store = WarmStartStore(str(tmp_path))
+    wrapper = jax.jit(lambda x: x + 1)
+    slot = WarmSlot(wrapper, store, ("dyn", "sig-gc"), "jitted")
+    assert slot(3) == 4  # cold miss, compiles via wrapper
+    out = store.gc(max_entries=0)
+    assert store.stats()["evictions"] == out["evicted"]
+    slot2 = WarmSlot(wrapper, store, ("dyn", "sig-gc"), "jitted")
+    assert slot2(3) == 4
+    assert store.stats()["misses"] >= 2  # second cold miss, not a hit
+
+
 # -- the commit log: two-phase exactness across handoffs ---------------------
 
 

@@ -253,6 +253,122 @@ def test_query_listing_one_poll_shows_fleet():
     assert isinstance(qb["folded"]["slot"], int)
 
 
+# -- conservation UNDER SUBPLAN SHARING (PR 20 satellite) --------------------
+#
+# Two structurally-distinct tenants ride ONE shared @shr: prefix host
+# across an admit / retire / re-admit timeline. The PR 14 gate must
+# hold EXACTLY: the host is measured-only bookkeeping, every emitted
+# row is attributed to a member tenant, in all three modes.
+
+_SHR_A = "from S[price > 2.0][id == 1] select id, price insert into oa"
+_SHR_B = ("from S[price > 2.0]#window.lengthBatch(2) "
+          "select sum(price) as tot insert into ob")
+
+
+def _share_timeline():
+    def add(pid, cql, t, tenant):
+        b = MetadataControlEvent.builder()
+        b.add_execution_plan(cql, plan_id=pid)
+        ev = b.build()
+        ev.tenant = tenant
+        return (t, ev)
+
+    def drop(pid, t):
+        b = MetadataControlEvent.builder()
+        b.remove_execution_plan(pid)
+        return (t, b.build())
+
+    # sa+sb share a host; sa retires (host survives on sb), then a
+    # re-admit sa2 rejoins the still-live host — the slot-reclaim path
+    return [
+        add("sa", _SHR_A, 0, "acme"),
+        add("sb", _SHR_B, 100, "bobcorp"),
+        drop("sa", 9_500),
+        add("sa2", _SHR_A, 17_500, "acme"),
+    ]
+
+
+def _run_share_mode(mode):
+    batches = [_mk_batches(8, s) for s in (1000, 9000, 17000, 25000)]
+    job = Job(
+        [], [BatchSource("S", SCHEMA, iter(batches))], batch_size=8,
+        time_mode="event",
+        control_sources=[ControlListSource(_share_timeline())],
+        plan_compiler=compiler,
+    )
+    job.share_subplans = True
+    if mode == "fused":
+        job.fused_segment_len = 2
+    if mode == "resident":
+        from flink_siddhi_tpu.runtime.replay import ResidentReplay
+
+        ResidentReplay(job).execute()
+    else:
+        job.run()
+    return job
+
+
+_SHARE_JOBS = {}
+
+
+def _share_job_for(mode):
+    if mode not in _SHARE_JOBS:
+        _SHARE_JOBS[mode] = _run_share_mode(mode)
+    return _SHARE_JOBS[mode]
+
+
+def _per_plan_rows_shared(job):
+    """Per-plan scopes excluding BOTH host kinds (@dyn: groups and
+    @shr: prefix hosts) — only tenant-attributed scopes may count."""
+    return {
+        pid: reg.counter_value("rows_emitted")
+        for pid, reg in job.telemetry.scope_map("plan").items()
+        if not pid.startswith(("@dyn:", "@shr:"))
+    }
+
+
+@pytest.mark.parametrize("mode", ["streaming", "fused", "resident"])
+def test_rows_conserve_under_subplan_sharing(mode):
+    job = _share_job_for(mode)
+    # the share really formed, and survived sa's retire on refcount
+    assert job.control_status()["counters"]["subplan_share"] == 3
+    per_plan = _per_plan_rows_shared(job)
+    total = _job_total(job)
+    assert total > 0
+    assert sum(per_plan.values()) == total, (per_plan, total)
+    # every phase of the timeline really contributed rows
+    assert per_plan.get("sa", 0) > 0      # pre-retire
+    assert per_plan.get("sb", 0) > 0      # rides the host throughout
+    assert per_plan.get("sa2", 0) > 0     # post-readmit
+    # and no @shr: scope leaked rows_emitted attribution
+    assert all(
+        reg.counter_value("rows_emitted") == 0
+        for pid, reg in job.telemetry.scope_map("plan").items()
+        if pid.startswith("@shr:")
+    )
+
+
+@pytest.mark.parametrize("mode", ["fused", "resident"])
+def test_shared_attribution_parity_with_streaming(mode):
+    assert _per_plan_rows_shared(
+        _share_job_for(mode)
+    ) == _per_plan_rows_shared(_share_job_for("streaming"))
+
+
+def test_shared_tenant_rollup_conserves():
+    """The tenant rollup covers the whole job total with the @shr host
+    mapped onto its members (tenant 'shared' never owns rows)."""
+    job = _share_job_for("streaming")
+    tenants = job.metrics()["tenants"]
+    assert (
+        sum(t["rows_emitted"] for t in tenants.values())
+        == _job_total(job)
+    )
+    assert sorted(tenants["acme"]["plans"]) == ["sa", "sa2"]
+    assert tenants["bobcorp"]["plans"] == ["sb"]
+    assert tenants.get("shared", {}).get("rows_emitted", 0) == 0
+
+
 # -- the admitted-vs-measured footprint meter --------------------------------
 
 

@@ -1,0 +1,280 @@
+"""Cross-tenant common-subplan sharing (analysis/share.py + the
+executor's ``@shr:`` prefix hosts — docs/control_plane.md).
+
+Contracts pinned here:
+
+* **Split + key semantics** — ``split_shared_prefix`` lifts exactly the
+  leading filter bracket (stream queries) / the conjuncts common to
+  EVERY pattern element (pattern queries); the execution share key
+  includes constants (sharing a running filter is only sound for
+  semantically identical predicates) and is renderer-stable: rendering
+  the prefix back to CQL and re-splitting reproduces the key.
+* **Row exactness** — a fleet of structurally-distinct tenants riding
+  one shared prefix produces byte-identical sorted rows versus the
+  unshared run, in streaming, fused, and resident modes.
+* **Refcounted retire** — members retire individually; the host
+  outlives all but the last member, drops with it (``subplan_unshare``),
+  and re-forms for a later re-admit through the AOT cache.
+* **Checkpoint** — the share table rides the snapshot: a restored job
+  re-forms hosts + loopback before replaying member suffixes, and the
+  continued run is row-exact against a continuous oracle.
+"""
+
+import numpy as np
+import pytest
+
+from flink_siddhi_tpu.analysis.share import (
+    MID_STREAM_PREFIX,
+    SHARE_HOST_PREFIX,
+    prefix_cql,
+    render_expr,
+    split_shared_prefix,
+    suffix_cql,
+)
+from flink_siddhi_tpu.compiler.plan import compile_plan
+from flink_siddhi_tpu.control import MetadataControlEvent
+from flink_siddhi_tpu.runtime.executor import Job
+from flink_siddhi_tpu.runtime.replay import ResidentReplay
+from flink_siddhi_tpu.runtime.sources import (
+    BatchSource,
+    ControlListSource,
+)
+from flink_siddhi_tpu.schema.batch import EventBatch
+from flink_siddhi_tpu.schema.stream_schema import StreamSchema
+from flink_siddhi_tpu.schema.types import AttributeType
+
+SCHEMA = StreamSchema([
+    ("id", AttributeType.INT),
+    ("price", AttributeType.DOUBLE),
+    ("timestamp", AttributeType.LONG),
+])
+
+# three STRUCTURALLY distinct tenants behind one exact leading-bracket
+# predicate: plain filter chain, windowed aggregate, pattern whose
+# every element carries the shared conjunct
+T1 = "from S[price > 2.0][id == 1] select id, price insert into o1"
+T2 = ("from S[price > 2.0]#window.lengthBatch(2) "
+      "select sum(price) as tot insert into o2")
+T3 = ("from every s1 = S[price > 2.0 and id == 1] -> "
+      "s2 = S[price > 2.0 and id == 2] within 60 sec "
+      "select s1.timestamp as t1, s2.timestamp as t2 insert into o3")
+
+
+def compiler(cql, pid):
+    return compile_plan(cql, {"S": SCHEMA}, plan_id=pid)
+
+
+def _query_of(cql):
+    return compiler(cql, "probe").source_ast.queries[0]
+
+
+def _mk(n, start):
+    ids = (np.arange(n) % 4).astype(np.int64)
+    ts = (start + np.arange(n) * 1000).astype(np.int64)
+    return EventBatch(
+        "S", SCHEMA,
+        {"id": ids, "price": np.arange(n, dtype=np.float64),
+         "timestamp": ts},
+        ts,
+    )
+
+
+def _add(pid, cql, t, tenant=None):
+    b = MetadataControlEvent.builder()
+    b.add_execution_plan(cql, plan_id=pid)
+    ev = b.build()
+    ev.tenant = tenant or pid
+    return (t, ev)
+
+
+def _drop(pid, t):
+    b = MetadataControlEvent.builder()
+    b.remove_execution_plan(pid)
+    return (t, b.build())
+
+
+def _job(batches, timeline, share=True, fused=False):
+    job = Job(
+        [], [BatchSource("S", SCHEMA, iter(batches))], batch_size=8,
+        time_mode="event",
+        control_sources=[ControlListSource(timeline)],
+        plan_compiler=compiler,
+    )
+    job.share_subplans = share
+    if fused:
+        job.fused_segment_len = 2
+    return job
+
+
+def _rows(job):
+    return {
+        sid: sorted(rows) for sid, rows in job.collected.items() if rows
+    }
+
+
+# -- split + key semantics ----------------------------------------------------
+
+
+def test_split_lifts_leading_bracket_and_pattern_common_conjuncts():
+    s1 = split_shared_prefix(_query_of(T1))
+    s2 = split_shared_prefix(_query_of(T2))
+    s3 = split_shared_prefix(_query_of(T3))
+    assert s1 and s2 and s3
+    # all three land on the SAME running prefix: S[price > 2.0]
+    assert s1.key() == s2.key() == s3.key()
+    assert render_expr(s1.predicate) == render_expr(s3.predicate)
+
+
+def test_share_key_includes_constants():
+    """Unlike the AOT shape key, the EXECUTION key must split on
+    constants: S[price > 2.0] and S[price > 9.0] select different rows
+    and can never ride one running host."""
+    a = split_shared_prefix(_query_of(T1))
+    b = split_shared_prefix(_query_of(
+        "from S[price > 9.0][id == 1] select id, price insert into o1"
+    ))
+    assert a.key() != b.key()
+
+
+def test_share_key_is_renderer_stable():
+    """Render the prefix host back to CQL, re-split what a tenant of
+    the rendered mid would look like — the key must reproduce (the
+    property checkpoint replay of the share table depends on)."""
+    sp = split_shared_prefix(_query_of(T1))
+    cql = prefix_cql(sp, MID_STREAM_PREFIX + "x")
+    host_q = compile_plan(
+        cql, {"S": SCHEMA}, plan_id="h"
+    ).source_ast.queries[0]
+    assert render_expr(host_q.input.filters[0]) == render_expr(
+        sp.predicate
+    )
+
+
+def test_split_refusals():
+    # no filters: nothing to lift
+    assert split_shared_prefix(_query_of(
+        "from S select id, price insert into o1"
+    )) is None
+    # a query already reading a mid stream must never split again
+    # (recursion guard); mid streams only exist inside a sharing job,
+    # so probe via the suffix the splitter itself emits
+    sp = split_shared_prefix(_query_of(T1))
+    mid = MID_STREAM_PREFIX + "x"
+    s_cql = suffix_cql(_query_of(T1), sp, mid, SCHEMA)
+    plan = compile_plan(s_cql, {"S": SCHEMA}, plan_id="sfx")
+    assert split_shared_prefix(plan.source_ast.queries[0]) is None
+    # pattern with NO conjunct common to every element
+    assert split_shared_prefix(_query_of(
+        "from every s1 = S[id == 1] -> s2 = S[price > 2.0] "
+        "within 60 sec select s1.timestamp as t1 insert into o3"
+    )) is None
+    # single-bracket filter + plain projection: the residue would keep
+    # no structure, so a split buys nothing and costs a loopback hop —
+    # refuse (matters for serving fleets full of [id == a] tenants)
+    assert split_shared_prefix(_query_of(
+        "from S[price > 2.0] select id, price insert into o1"
+    )) is None
+    # ...but the same bracket is still shareable when the residue keeps
+    # a window or a stateful selector
+    assert split_shared_prefix(_query_of(
+        "from S[price > 2.0] select sum(price) as tot insert into o1"
+    )) is not None
+
+
+# -- row exactness: shared vs unshared, all three modes ----------------------
+
+
+def _fleet_timeline():
+    return [
+        _add("t1", T1, 0, "ten0"),
+        _add("t2", T2, 100, "ten1"),
+        _add("t3", T3, 200, "ten2"),
+    ]
+
+
+@pytest.fixture(scope="module")
+def unshared_oracle():
+    job = _job(
+        [_mk(8, s) for s in (1000, 9000, 17000, 25000)],
+        _fleet_timeline(), share=False,
+    )
+    job.run()
+    return _rows(job)
+
+
+@pytest.mark.parametrize("mode", ["streaming", "fused", "resident"])
+def test_shared_fleet_row_exact_vs_unshared(mode, unshared_oracle):
+    job = _job(
+        [_mk(8, s) for s in (1000, 9000, 17000, 25000)],
+        _fleet_timeline(), share=True, fused=(mode == "fused"),
+    )
+    if mode == "resident":
+        ResidentReplay(job).execute()
+    else:
+        job.run()
+    st = job.control_status()["shared"]
+    assert len(st) == 1
+    entry = list(st.values())[0]
+    assert sorted(entry["members"]) == ["t1", "t2", "t3"]
+    assert entry["host"].startswith(SHARE_HOST_PREFIX)
+    assert _rows(job) == unshared_oracle
+    # the host is bookkeeping, not a tenant: hidden from plan listings
+    assert not any(
+        p.startswith(SHARE_HOST_PREFIX) for p in job.plan_ids
+    )
+
+
+# -- refcounted retire / re-admit --------------------------------------------
+
+
+def test_retire_refcounts_host_and_readmit_reforms_it():
+    tl = [
+        _add("t1", T1, 0),
+        _add("t2", T2, 100),
+        _drop("t1", 9_500),     # host survives on t2
+        _drop("t2", 17_500),    # last member: host drops
+        _add("t1b", T1, 25_500),  # host re-forms via the AOT cache
+    ]
+    job = _job([_mk(8, s) for s in (1000, 9000, 17000, 25000)], tl)
+    job.run()
+    cs = job.control_status()
+    assert cs["counters"].get("subplan_share") == 3
+    assert cs["counters"].get("subplan_unshare") == 1
+    assert len(cs["shared"]) == 1
+    assert list(cs["shared"].values())[0]["members"] == ["t1b"]
+    # t1b really serves rows after the re-form
+    assert job.collected.get("o1")
+    # share traffic is tenant-attributed (PR 14 scoping)
+    scopes = job.telemetry.snapshot()["scopes"]["tenant"]
+    assert scopes["t1"]["counters"]["control.subplan_share"] == 1
+
+
+# -- checkpoint: the share table rides the snapshot --------------------------
+
+
+def test_checkpoint_restores_share_table_row_exact():
+    b_all = [_mk(8, s) for s in (1000, 9000, 17000, 25000)]
+    tl = [_add("t1", T1, 0), _add("t2", T2, 100)]
+    j1 = _job(b_all[:2], tl)
+    j1.run()
+    snap = j1.snapshot()
+    assert snap["shared"], "snapshot missing the shared block"
+    j2 = _job(b_all[2:], [])
+    j2.restore(snap)
+    assert j2.control_status()["shared"], "share table not restored"
+    j2.run()
+    oracle = _job(b_all, tl)
+    oracle.run()
+    merged = {}
+    for j in (j1, j2):
+        for sid, rows in j.collected.items():
+            merged.setdefault(sid, []).extend(rows)
+    assert {s: sorted(r) for s, r in merged.items() if r} == _rows(
+        oracle
+    )
+    # the listing shows each member's host + key after restore
+    listing = {q["id"]: q for q in j2.query_listing()}
+    for pid in ("t1", "t2"):
+        assert listing[pid]["shared"]["host"].startswith(
+            SHARE_HOST_PREFIX
+        )
